@@ -1,0 +1,38 @@
+"""distributed_processor_tpu: a TPU-native (JAX/XLA/Pallas) framework with
+the capabilities of the QubiC distributed-processor stack.
+
+Layers:
+
+* :mod:`.isa` — the 128-bit distributed-processor ISA (encode / decode /
+  structure-of-arrays programs)
+* :mod:`.qchip`, :mod:`.hwconfig`, :mod:`.elements`, :mod:`.envelopes` —
+  calibration + hardware configuration
+* :mod:`.ir`, :mod:`.compiler`, :mod:`.assembler`, :mod:`.decoder` — the
+  compiler stack (CFG IR, 12-pass pipeline, machine-code assembly)
+* :mod:`.sim` (planned this layer up) — the JAX lax.scan ISA interpreter
+  (per-qubit cores, measurement feedback, sync barriers) batched over shots
+* :mod:`.ops` — DSP kernels: pulse synthesis, readout demod, state
+  discrimination (Pallas on TPU)
+* :mod:`.parallel` — shot/sweep sharding over the TPU mesh
+* :mod:`.models` — canned experiments (randomized benchmarking, sweeps)
+"""
+
+__version__ = '0.1.0'
+
+from . import isa
+from . import hwconfig
+from . import envelopes
+from . import elements
+from . import qchip
+from . import ir
+from . import compiler
+from . import assembler
+from . import decoder
+
+from .hwconfig import FPGAConfig, ChannelConfig, FPROCChannel, load_channel_configs
+from .elements import TPUElementConfig
+from .qchip import QChip
+from .compiler import Compiler, CompiledProgram, CompilerFlags, get_passes, \
+    load_compiled_program
+from .assembler import SingleCoreAssembler, GlobalAssembler
+from .decoder import decode_assembled_program, MachineProgram

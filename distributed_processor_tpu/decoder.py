@@ -1,0 +1,139 @@
+"""Decode assembled binaries into the tensorised machine program consumed
+by the JAX interpreter.
+
+The assembler's output (per-core ``cmd_buf`` bytes + env/freq buffers) is
+the same artifact the reference writes to FPGA BRAM.  Here it is decoded
+once, on the host, into:
+
+* a stacked :class:`~distributed_processor_tpu.isa.SoAProgram`
+  (``[n_cores, n_instr]`` int32 field arrays) with two derived fields the
+  simulator needs — ``p_elem`` (element index from the cfg word) and
+  ``p_dur`` (pulse duration in FPGA clocks, derived from the env word and
+  the element's sample geometry);
+* dense element tables (envelope IQ samples, NCO frequency entries) for
+  the DSP pipeline.
+
+Nothing here is traced by JAX; the interpreter gathers from these arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import isa
+from .elements import (TPUElementConfig, parse_env_buffer, parse_freq_buffer,
+                       ENV_BANKS, FREQ_BUF_WORDS)
+
+
+@dataclass
+class CoreTables:
+    """Per-core decoded element tables (one entry per element)."""
+    envs: list        # list of complex arrays (envelope samples per element)
+    freqs: list       # list of {'freq': array, 'iq15': array}
+    elem_cfgs: list   # list of TPUElementConfig
+
+
+@dataclass
+class MachineProgram:
+    """A decoded multi-core machine program, ready for the interpreter."""
+    soa: isa.SoAProgram          # [n_cores, n_instr]
+    p_elem: np.ndarray           # [n_cores, n_instr] element index of pulses
+    p_dur: np.ndarray            # [n_cores, n_instr] pulse duration (clks)
+    tables: list                 # CoreTables per core
+    core_inds: list              # original core indices (sorted)
+
+    @property
+    def n_cores(self) -> int:
+        return self.soa.kind.shape[0]
+
+    @property
+    def n_instr(self) -> int:
+        return self.soa.kind.shape[1]
+
+    @property
+    def has_fproc(self) -> bool:
+        return bool(np.any((self.soa.kind == isa.K_ALU_FPROC)
+                           | (self.soa.kind == isa.K_JUMP_FPROC)))
+
+    @property
+    def has_sync(self) -> bool:
+        return bool(np.any(self.soa.kind == isa.K_SYNC))
+
+    @property
+    def sync_participants(self) -> np.ndarray:
+        """Bool[n_cores]: cores whose program contains a SYNC instruction."""
+        return np.any(self.soa.kind == isa.K_SYNC, axis=1)
+
+    def max_pulses_per_core(self, loop_bound: int = 1024) -> int:
+        """Static upper bound on emitted pulses per core (loops bounded)."""
+        n_pulse_instr = int(np.max(np.sum(self.soa.kind == isa.K_PULSE_TRIG, axis=1)))
+        has_backjump = bool(np.any(
+            (self.soa.kind == isa.K_JUMP_COND) | (self.soa.kind == isa.K_JUMP_I)
+            | (self.soa.kind == isa.K_JUMP_FPROC)))
+        return n_pulse_instr * (loop_bound if has_backjump else 1)
+
+
+def _pulse_duration_clks(env_word: int, cfg: TPUElementConfig) -> int:
+    """Pulse duration in FPGA clocks from the env word length field."""
+    _, n_samples, is_cw = cfg.env_word_fields(env_word)
+    if is_cw:
+        return 0
+    # env samples are consumed at sample_freq / interp_ratio; one clock
+    # covers samples_per_clk / interp_ratio of them
+    return int(np.ceil(n_samples * cfg.interp_ratio / cfg.samples_per_clk))
+
+
+def decode_assembled_program(assembled: dict, channel_configs: dict = None,
+                             elem_cfgs_by_core: dict = None,
+                             pad_to: int = None) -> MachineProgram:
+    """Decode a ``GlobalAssembler.get_assembled_program()`` result.
+
+    Element configs are needed to derive pulse durations and decode the
+    env/freq buffers; provide them either via ``channel_configs`` (the same
+    dict handed to GlobalAssembler, TPUElementConfig is assumed) or as an
+    explicit ``{core_ind: [ElementConfig, ...]}`` mapping.
+    """
+    core_inds = sorted(assembled, key=lambda k: int(k))
+    if elem_cfgs_by_core is None:
+        elem_cfgs_by_core = {}
+        if channel_configs is not None:
+            for chan, cfg in channel_configs.items():
+                if not hasattr(cfg, 'elem_ind'):
+                    continue
+                per_core = elem_cfgs_by_core.setdefault(str(cfg.core_ind), {})
+                per_core[cfg.elem_ind] = TPUElementConfig(**cfg.elem_params)
+            elem_cfgs_by_core = {
+                core: [cfgs[i] for i in sorted(cfgs)]
+                for core, cfgs in elem_cfgs_by_core.items()}
+
+    soas, tables = [], []
+    for core in core_inds:
+        entry = assembled[core]
+        soas.append(isa.decode_soa(entry['cmd_buf']))
+        cfgs = elem_cfgs_by_core.get(str(core), [])
+        envs, freqs = [], []
+        for e, cfg in enumerate(cfgs):
+            env_buf = entry['env_buffers'][e] if e < len(entry['env_buffers']) else b''
+            freq_buf = entry['freq_buffers'][e] if e < len(entry['freq_buffers']) else b''
+            envs.append(parse_env_buffer(env_buf))
+            freqs.append(parse_freq_buffer(freq_buf, cfg.sample_freq)
+                         if len(freq_buf) >= 4 * FREQ_BUF_WORDS
+                         else {'freq': np.zeros(0), 'iq15': np.zeros((0, 15))})
+        tables.append(CoreTables(envs=envs, freqs=freqs, elem_cfgs=cfgs))
+
+    soa = isa.stack_soa(soas, pad_to=pad_to)
+    n_cores, n_instr = soa.kind.shape
+    p_elem = np.zeros((n_cores, n_instr), dtype=np.int32)
+    p_dur = np.zeros((n_cores, n_instr), dtype=np.int32)
+    for c, core in enumerate(core_inds):
+        cfgs = tables[c].elem_cfgs
+        is_pulse = (soa.kind[c] == isa.K_PULSE_TRIG) | (soa.kind[c] == isa.K_PULSE_WRITE)
+        for i in np.nonzero(is_pulse)[0]:
+            elem = int(soa.p_cfg[c, i]) & 0b11   # cfg word low bits = element
+            p_elem[c, i] = elem
+            if elem < len(cfgs) and (soa.p_wen[c, i] >> 0) & 1:  # env written
+                p_dur[c, i] = _pulse_duration_clks(int(soa.p_env[c, i]), cfgs[elem])
+    return MachineProgram(soa=soa, p_elem=p_elem, p_dur=p_dur,
+                          tables=tables, core_inds=[int(c) for c in core_inds])

@@ -1,0 +1,2 @@
+from .patterns import match_pattern, format_pattern
+from .safe_eval import eval_numeric

@@ -1,0 +1,62 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (the driver separately
+dry-runs the multi-chip path); set platform flags before jax ever imports.
+"""
+
+import os
+import sys
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+REFERENCE_ROOT = '/root/reference'
+
+
+@pytest.fixture(scope='session')
+def reference_root():
+    if not os.path.isdir(REFERENCE_ROOT):
+        pytest.skip('reference checkout not available')
+    return REFERENCE_ROOT
+
+
+@pytest.fixture(scope='session')
+def qchipcfg_path(reference_root):
+    return os.path.join(reference_root, 'python/test/qubitcfg.json')
+
+
+@pytest.fixture(scope='session')
+def channelcfg_path(reference_root):
+    return os.path.join(reference_root, 'python/test/channel_config.json')
+
+
+def assert_close_tree(actual, expected, path='$'):
+    """Recursively compare nested dict/list/tuple structures; numeric leaves
+    compare with np.isclose (golden files print full float repr)."""
+    if isinstance(expected, dict):
+        assert isinstance(actual, dict), f'{path}: {type(actual)} != dict'
+        assert set(actual.keys()) == set(expected.keys()), \
+            f'{path}: keys {sorted(map(str, actual.keys()))} != {sorted(map(str, expected.keys()))}'
+        for k in expected:
+            assert_close_tree(actual[k], expected[k], f'{path}.{k}')
+    elif isinstance(expected, (list, tuple)):
+        assert isinstance(actual, (list, tuple)), f'{path}: {type(actual)} != list'
+        assert len(actual) == len(expected), \
+            f'{path}: length {len(actual)} != {len(expected)}\n{actual}\n{expected}'
+        for i, (a, e) in enumerate(zip(actual, expected)):
+            assert_close_tree(a, e, f'{path}[{i}]')
+    elif isinstance(expected, bool) or expected is None:
+        assert actual == expected, f'{path}: {actual} != {expected}'
+    elif isinstance(expected, (int, float, np.integer, np.floating)):
+        assert np.isclose(actual, expected, rtol=1e-12, atol=0), \
+            f'{path}: {actual} != {expected}'
+    else:
+        assert actual == expected, f'{path}: {actual!r} != {expected!r}'

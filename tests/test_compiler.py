@@ -1,0 +1,393 @@
+"""Compiler pipeline tests.
+
+Two families:
+
+* semantic unit tests (z-phase accumulation, schedule start times, core
+  grouping) with expectations derived from the timing model;
+* golden-parity tests: compiled per-core asm compared against the
+  reference implementation's expected outputs (parsed from
+  /root/reference/python/test/test_outputs/*.txt as data oracles).
+"""
+
+import ast
+import os
+
+import numpy as np
+import pytest
+
+import distributed_processor_tpu as dp
+from distributed_processor_tpu import compiler as cm
+from distributed_processor_tpu.ir import passes as ps
+from distributed_processor_tpu.ir import instructions as iri
+
+from conftest import assert_close_tree
+
+FAST_CLOCKS = {'alu_instr_clks': 2, 'fpga_clk_period': 2.e-9,
+               'jump_cond_clks': 3, 'jump_fproc_clks': 4,
+               'pulse_regwrite_clks': 1}
+
+
+class MockElement(dp.hwconfig.ElementConfig):
+    """Hardware-independent element (constant words) for golden tests,
+    mirroring the reference test mock (python/test/test_compiler.py:18-47)."""
+
+    def __init__(self, samples_per_clk, interp_ratio):
+        super().__init__(2.e-9, samples_per_clk)
+
+    def get_phase_word(self, phase):
+        return 0
+
+    def get_env_word(self, env_start_ind, env_length):
+        return 0
+
+    def get_cw_env_word(self, env_start_ind):
+        return 0
+
+    def get_env_buffer(self, env):
+        return np.zeros(10)
+
+    def get_freq_buffer(self, freqs):
+        return np.zeros(10)
+
+    def get_freq_addr(self, freq_ind):
+        return 0
+
+    def get_amp_word(self, amplitude):
+        return 0
+
+    def length_nclks(self, tlength):
+        return int(np.ceil(tlength / self.fpga_clk_period))
+
+    def get_cfg_word(self, elem_ind, mode_bits):
+        return elem_ind
+
+
+def load_golden(reference_root, name):
+    """Parse a reference golden file (python-literal dict, possibly with
+    numpy array reprs) into plain python structures."""
+    path = os.path.join(reference_root, 'python/test/test_outputs', name)
+    with open(path) as f:
+        text = f.read().rstrip('\n')
+    try:
+        return ast.literal_eval(text)
+    except (ValueError, SyntaxError):
+        return eval(text, {'__builtins__': {}},
+                    {'array': lambda x, dtype=None: list(x),
+                     'float32': 'float32', 'dtype': lambda x: x})
+
+
+def compile_program(program, qchip, fpga_config=None):
+    if fpga_config is None:
+        fpga_config = dp.FPGAConfig(**FAST_CLOCKS)
+    elif isinstance(fpga_config, dict):
+        fpga_config = dp.FPGAConfig(**fpga_config)
+    compiler = dp.Compiler(program)
+    compiler.run_ir_passes(cm.get_passes(fpga_config, qchip))
+    return compiler
+
+
+@pytest.fixture(scope='module')
+def qchip(qchipcfg_path):
+    return dp.QChip(qchipcfg_path)
+
+
+def sorted_prog_dict(prog):
+    """Reference-golden shape: dict keyed by sorted proc-group tuples."""
+    return {key: prog.program[key] for key in sorted(prog.program.keys())}
+
+
+def test_phase_resolve(qchip):
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']},
+               {'name': 'X90Z90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'virtual_z', 'qubit': ['Q0'], 'phase': np.pi / 4},
+               {'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']}]
+    compiler = compile_program(program, qchip)
+    pulses = compiler.ir_prog.blocks['block_0']['instructions']
+    assert pulses[0].phase == 0
+    assert pulses[1].phase == 0
+    assert pulses[3].phase == np.pi / 2
+    assert pulses[4].phase == 3 * np.pi / 4
+    assert pulses[5].phase == 0
+
+
+def test_basic_schedule(qchip):
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']},
+               {'name': 'X90Z90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']},
+               {'name': 'read', 'qubit': ['Q0']}]
+    compiler = compile_program(program, qchip)
+    pulses = compiler.ir_prog.blocks['block_0']['instructions']
+    assert [p.start_time for p in pulses] == [5, 5, 21, 37, 13, 53, 353]
+
+
+def test_linear_compile_golden(qchip, reference_root):
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']},
+               {'name': 'read', 'qubit': ['Q0']}]
+    prog = compile_program(program, qchip).compile()
+    golden = load_golden(reference_root, 'test_linear_compile_out.txt')
+    assert_close_tree(sorted_prog_dict(prog), golden)
+
+
+def test_pulse_compile_golden(qchip, reference_root):
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']},
+               {'name': 'X90Z90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']},
+               {'name': 'pulse', 'phase': np.pi / 2, 'freq': 'Q0.freq',
+                'env': np.ones(100), 'twidth': 24.e-9, 'amp': 0.5,
+                'dest': 'Q0.qdrv'},
+               {'name': 'read', 'qubit': ['Q0']}]
+    prog = compile_program(program, qchip).compile()
+    golden = load_golden(reference_root, 'test_pulse_compile_out.txt')
+    actual = sorted_prog_dict(prog)
+    # numpy envelope arrays serialize as lists in the golden file
+    for core in actual:
+        for instr in actual[core]:
+            if isinstance(instr.get('env'), np.ndarray):
+                instr['env'] = list(instr['env'])
+    assert_close_tree(actual, golden)
+
+
+def test_pulse_compile_ir_input(qchip, reference_root):
+    program = [iri.Gate('X90', 'Q0'),
+               iri.Gate('X90', 'Q1'),
+               iri.Gate('X90Z90', 'Q0'),
+               iri.Gate('X90', 'Q0'),
+               iri.Gate('X90', 'Q1'),
+               iri.Pulse(phase=np.pi / 2, freq='Q0.freq', env=np.ones(100),
+                         twidth=24.e-9, amp=0.5, dest='Q0.qdrv'),
+               iri.Gate('read', 'Q0')]
+    prog = compile_program(program, qchip).compile()
+    golden = load_golden(reference_root, 'test_pulse_compile_out.txt')
+    actual = sorted_prog_dict(prog)
+    for core in actual:
+        for instr in actual[core]:
+            if isinstance(instr.get('env'), np.ndarray):
+                instr['env'] = list(instr['env'])
+    assert_close_tree(actual, golden)
+
+
+def test_multirst_golden(qchip, reference_root):
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+                'func_id': 1, 'true': [],
+                'false': [{'name': 'X90', 'qubit': ['Q0']}], 'scope': ['Q0']},
+               {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+                'func_id': 0, 'true': [],
+                'false': [{'name': 'X90', 'qubit': ['Q1']}], 'scope': ['Q1']},
+               {'name': 'X90', 'qubit': ['Q1']}]
+    prog = compile_program(program, qchip).compile()
+    golden = load_golden(reference_root, 'test_multirst_cfg.txt')
+    assert_close_tree(sorted_prog_dict(prog), golden)
+
+
+MULTIRST_FPROC_PROGRAM = [
+    {'name': 'X90', 'qubit': ['Q0']},
+    {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+     'func_id': 'Q0.meas', 'true': [],
+     'false': [{'name': 'X90', 'qubit': ['Q0']}], 'scope': ['Q0']},
+    {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+     'func_id': 'Q1.meas', 'true': [],
+     'false': [{'name': 'X90', 'qubit': ['Q1']}], 'scope': ['Q1']},
+    {'name': 'X90', 'qubit': ['Q1']}]
+
+
+def test_multirst_fproc_res_golden(qchip, reference_root, channelcfg_path):
+    prog = compile_program(MULTIRST_FPROC_PROGRAM, qchip, dp.FPGAConfig()).compile()
+    golden = load_golden(reference_root, 'test_multirst_fproc_res_cfg.txt')
+    assert_close_tree(sorted_prog_dict(prog), golden)
+    # the assembled result must build without error
+    channel_configs = dp.load_channel_configs(channelcfg_path)
+    asm = dp.GlobalAssembler(prog, channel_configs, MockElement)
+    asm.get_assembled_program()
+
+
+def test_fproc_hold_golden(qchip, reference_root, channelcfg_path):
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'read', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'read', 'qubit': ['Q1']},
+               {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+                'func_id': 'Q0.meas', 'true': [],
+                'false': [{'name': 'X90', 'qubit': ['Q0']}], 'scope': ['Q0']},
+               {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+                'func_id': 'Q1.meas', 'true': [],
+                'false': [{'name': 'X90', 'qubit': ['Q1']}], 'scope': ['Q1']},
+               {'name': 'X90', 'qubit': ['Q1']}]
+    prog = compile_program(program, qchip, dp.FPGAConfig()).compile()
+    golden = load_golden(reference_root, 'test_fproc_hold.txt')
+    assert_close_tree(sorted_prog_dict(prog), golden)
+    channel_configs = dp.load_channel_configs(channelcfg_path)
+    dp.GlobalAssembler(prog, channel_configs, MockElement).get_assembled_program()
+
+
+def test_simple_loop_golden(qchip, reference_root):
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'read', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']},
+               {'name': 'Z90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'declare', 'var': 'loopind', 'dtype': 'int', 'scope': ['Q0']},
+               {'name': 'loop', 'cond_lhs': 10, 'cond_rhs': 'loopind',
+                'alu_cond': 'ge', 'scope': ['Q0'],
+                'body': [{'name': 'X90', 'qubit': ['Q0']},
+                         {'name': 'X90', 'qubit': ['Q0']}]},
+               {'name': 'read', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']}]
+    prog = compile_program(program, qchip).compile()
+    golden = load_golden(reference_root, 'test_simple_loop.txt')
+    assert_close_tree(sorted_prog_dict(prog), golden)
+
+
+def test_compound_loop_golden(qchip, reference_root):
+    fpga_config = dict(FAST_CLOCKS, pulse_load_clks=4)
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'read', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']},
+               {'name': 'declare', 'var': 'loopind', 'dtype': 'int', 'scope': ['Q0']},
+               {'name': 'loop', 'cond_lhs': 10, 'cond_rhs': 'loopind',
+                'alu_cond': 'ge', 'scope': ['Q0', 'Q1'],
+                'body': [{'name': 'X90', 'qubit': ['Q0']},
+                         {'name': 'X90', 'qubit': ['Q0']}]},
+               {'name': 'CR', 'qubit': ['Q1', 'Q0']},
+               {'name': 'X90', 'qubit': ['Q1']}]
+    prog = compile_program(program, qchip, fpga_config).compile()
+    golden = load_golden(reference_root, 'test_compound_loop.txt')
+    assert_close_tree(sorted_prog_dict(prog), golden)
+
+
+def test_nested_loop_golden(qchip, reference_root):
+    fpga_config = dict(FAST_CLOCKS, pulse_load_clks=4)
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'read', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']},
+               {'name': 'declare', 'var': 'loopind', 'dtype': 'int', 'scope': ['Q0']},
+               {'name': 'declare', 'var': 'loopind2', 'dtype': 'int', 'scope': ['Q0']},
+               {'name': 'loop', 'cond_lhs': 10, 'cond_rhs': 'loopind',
+                'alu_cond': 'ge', 'scope': ['Q0', 'Q1'],
+                'body': [{'name': 'X90', 'qubit': ['Q0']},
+                         {'name': 'X90', 'qubit': ['Q0']},
+                         {'name': 'loop', 'cond_lhs': 10, 'cond_rhs': 'loopind2',
+                          'alu_cond': 'ge', 'scope': ['Q0', 'Q1'],
+                          'body': [{'name': 'X90', 'qubit': ['Q1']},
+                                   {'name': 'read', 'qubit': ['Q0']}]}]},
+               {'name': 'CR', 'qubit': ['Q1', 'Q0']},
+               {'name': 'X90', 'qubit': ['Q1']}]
+    prog = compile_program(program, qchip, fpga_config).compile()
+    golden = load_golden(reference_root, 'test_nested_loop.txt')
+    assert_close_tree(sorted_prog_dict(prog), golden)
+
+
+def test_hw_virtualz_golden(qchip, reference_root, channelcfg_path):
+    program = [{'name': 'declare', 'var': 'q0_phase', 'scope': ['Q0'],
+                'dtype': 'phase'},
+               {'name': 'bind_phase', 'var': 'q0_phase', 'freq': 'Q0.freq'},
+               {'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']},
+               {'name': 'virtual_z', 'qubit': 'Q0', 'phase': np.pi / 2},
+               {'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'read', 'qubit': ['Q0']}]
+    prog = compile_program(program, qchip).compile()
+    golden = load_golden(reference_root, 'test_hw_virtualz_out.txt')
+    assert_close_tree(sorted_prog_dict(prog), golden)
+    channel_configs = dp.load_channel_configs(channelcfg_path)
+    dp.GlobalAssembler(prog, channel_configs, MockElement).get_assembled_program()
+
+
+def test_linear_compile_globalasm_golden(qchip, reference_root, channelcfg_path):
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'X90', 'qubit': ['Q1']},
+               {'name': 'read', 'qubit': ['Q0']}]
+    prog = compile_program(program, qchip).compile()
+    channel_configs = dp.load_channel_configs(channelcfg_path)
+    asm_prog = dp.GlobalAssembler(prog, channel_configs, MockElement) \
+        .get_assembled_program()
+    sorted_prog = {ci: {b: asm_prog[ci][b] for b in sorted(asm_prog[ci])}
+                   for ci in sorted(asm_prog)}
+    golden = load_golden(reference_root, 'test_linear_compile_globalasm.txt')
+    assert sorted_prog == golden
+
+
+def test_core_scoper_groupings():
+    scoper = dp.ir.CoreScoper(
+        ('Q0.rdrv', 'Q0.rdlo', 'Q0.qdrv', 'Q1.rdrv', 'Q1.qdrv', 'Q1.rdlo'))
+    expected = {dest: ('Q0.qdrv', 'Q0.rdrv', 'Q0.rdlo')
+                for dest in ('Q0.rdrv', 'Q0.rdlo', 'Q0.qdrv')}
+    expected.update({dest: ('Q1.qdrv', 'Q1.rdrv', 'Q1.rdlo')
+                     for dest in ('Q1.rdrv', 'Q1.rdlo', 'Q1.qdrv')})
+    assert scoper.proc_groupings == expected
+
+
+def test_core_scoper_bychan():
+    scoper = dp.ir.CoreScoper(
+        ('Q0.rdrv', 'Q0.rdlo', 'Q0.qdrv', 'Q1.rdrv', 'Q1.qdrv', 'Q1.rdlo'),
+        proc_grouping=[('{qubit}.qdrv',), ('{qubit}.rdrv', '{qubit}.rdlo')])
+    assert scoper.proc_groupings['Q0.qdrv'] == ('Q0.qdrv',)
+    assert scoper.proc_groupings['Q0.rdlo'] == ('Q0.rdrv', 'Q0.rdlo')
+    assert scoper.proc_groupings['Q1.rdrv'] == ('Q1.rdrv', 'Q1.rdlo')
+
+
+def test_user_schedule_lints(qchip):
+    program = [{'name': 'pulse', 'phase': 0., 'freq': 'Q0.freq',
+                'env': np.ones(100), 'twidth': 24.e-9, 'amp': 0.5,
+                'dest': 'Q0.qdrv', 'start_time': 5},
+               {'name': 'pulse', 'phase': 0., 'freq': 'Q0.freq',
+                'env': np.ones(100), 'twidth': 24.e-9, 'amp': 0.5,
+                'dest': 'Q0.rdrv', 'start_time': 8},
+               {'name': 'pulse', 'phase': 0., 'freq': 'Q0.freq',
+                'env': np.ones(100), 'twidth': 24.e-9, 'amp': 0.5,
+                'dest': 'Q0.qdrv', 'start_time': 11}]
+    compiler = dp.Compiler(program)
+    fpga_config = dp.FPGAConfig(**FAST_CLOCKS)
+    compiler.run_ir_passes(cm.get_passes(
+        fpga_config, qchip, compiler_flags=cm.CompilerFlags(schedule=False)))
+    compiler.compile()
+
+
+def test_user_wrong_schedule_raises(qchip):
+    program = [{'name': 'pulse', 'phase': 0., 'freq': 'Q0.freq',
+                'env': np.ones(100), 'twidth': 24.e-9, 'amp': 0.5,
+                'dest': 'Q0.qdrv', 'start_time': 5},
+               {'name': 'pulse', 'phase': 0., 'freq': 'Q0.freq',
+                'env': np.ones(100), 'twidth': 24.e-9, 'amp': 0.5,
+                'dest': 'Q0.rdrv', 'start_time': 6}]
+    compiler = dp.Compiler(program)
+    fpga_config = dp.FPGAConfig(**FAST_CLOCKS)
+    with pytest.raises(Exception):
+        compiler.run_ir_passes(cm.get_passes(
+            fpga_config, qchip, compiler_flags=cm.CompilerFlags(schedule=False)))
+
+
+def test_serialize_roundtrip_every_pass(qchip, reference_root, channelcfg_path):
+    """Re-serialise the IR after every pass and recompile: same golden."""
+    program = MULTIRST_FPROC_PROGRAM
+    fpga_config = dp.FPGAConfig()
+    pass_list = cm.get_passes(fpga_config, qchip)
+    compiler = None
+    for ir_pass in pass_list:
+        compiler = dp.Compiler(program)
+        compiler.run_ir_passes([ir_pass])
+        program = compiler.ir_prog.serialize()
+    prog = compiler.compile()
+    golden = load_golden(reference_root, 'test_multirst_fproc_res_cfg.txt')
+    assert_close_tree(sorted_prog_dict(prog), golden)
+
+
+def test_compiled_program_save_load(qchip, tmp_path):
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'read', 'qubit': ['Q0']}]
+    prog = compile_program(program, qchip).compile()
+    path = str(tmp_path / 'prog.json')
+    prog.save(path)
+    loaded = dp.load_compiled_program(path)
+    assert set(loaded.program.keys()) == set(prog.program.keys())
+    for grp in prog.program:
+        assert_close_tree(loaded.program[grp], prog.program[grp])
+    assert loaded.fpga_config.alu_instr_clks == prog.fpga_config.alu_instr_clks

@@ -1,0 +1,153 @@
+"""ISA encode/decode round-trip and bit-layout tests.
+
+Layout constants are cross-checked against the gateware contract
+(BASELINE.md): opcode at bits 123-127, immediate at 88, jump addr at 68,
+fproc id at 52, pulse fields per hdl/pulse_reg.sv.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu import isa
+
+
+def test_twos_complement_roundtrip():
+    rng = np.random.default_rng(0)
+    vals = rng.integers(-2**31, 2**31 - 1, size=100)
+    for v in vals:
+        enc = isa.twos_complement(int(v))
+        assert 0 <= enc < 2**32
+        assert isa.from_twos_complement(enc) == int(v)
+    with pytest.raises(ValueError):
+        isa.twos_complement(2**31)
+
+
+def test_pulse_cmd_layout():
+    cmd = isa.pulse_cmd(freq_word=0x155, phase_word=0x1aaaa, amp_word=0xbeef,
+                        env_word=0xabcdef, cfg_word=0x5, cmd_time=1234)
+    # opcode pulse_write_trig
+    assert (cmd >> 123) & 0x1f == 0b10010
+    assert (cmd >> 5) & 0xffffffff == 1234
+    assert (cmd >> 37) & 0xf == 0x5
+    assert (cmd >> 42) & 0xffff == 0xbeef
+    assert (cmd >> 60) & 0x1ff == 0x155
+    assert (cmd >> 71) & 0x1ffff == 0x1aaaa
+    assert (cmd >> 90) & 0xffffff == 0xabcdef
+    # all write enables set, no reg selects ({wen, sel} with wen high)
+    assert (cmd >> 41) & 1 == 1           # cfg wen
+    assert (cmd >> 58) & 0b11 == 0b10     # amp ctl
+    assert (cmd >> 114) & 0b11 == 0b10    # env ctl
+
+
+def test_pulse_cmd_reg_param():
+    cmd = isa.pulse_cmd(freq_regaddr=7, phase_word=3, cmd_time=10)
+    assert (cmd >> 116) & 0xf == 7
+    assert (cmd >> 69) & 0b11 == 0b11     # freq ctl bits = {reg, wen}
+    with pytest.raises(ValueError):
+        isa.pulse_cmd(freq_regaddr=1, phase_regaddr=2)
+
+
+def test_pulse_write_without_time():
+    cmd = isa.pulse_cmd(freq_word=5)
+    assert (cmd >> 123) & 0x1f == 0b10000
+
+
+def test_alu_cmd_layouts():
+    cmd = isa.alu_cmd('reg_alu', 'i', -5, 'add', 3, write_reg_addr=9)
+    assert (cmd >> 120) & 0xff == (0b00010 << 3) | 0b001
+    assert (cmd >> 88) & 0xffffffff == isa.twos_complement(-5)
+    assert (cmd >> 84) & 0xf == 3
+    assert (cmd >> 80) & 0xf == 9
+
+    cmd = isa.alu_cmd('reg_alu', 'r', 4, 'sub', 3, write_reg_addr=1)
+    assert (cmd >> 120) & 0xff == (0b00011 << 3) | 0b010
+    assert (cmd >> 116) & 0xf == 4
+
+    cmd = isa.alu_cmd('jump_cond', 'i', 7, 'eq', 2, jump_cmd_ptr=99)
+    assert (cmd >> 120) & 0xff == (0b00110 << 3) | 0b011
+    assert (cmd >> 68) & 0xff == 99
+
+    cmd = isa.alu_cmd('jump_fproc', 'i', 1, 'ge', jump_cmd_ptr=42, func_id=6)
+    assert (cmd >> 120) & 0xff == (0b01010 << 3) | 0b101
+    assert (cmd >> 52) & 0xff == 6
+    assert (cmd >> 68) & 0xff == 42
+
+    cmd = isa.alu_cmd('inc_qclk', 'i', -100)
+    assert (cmd >> 120) & 0xff == (0b01100 << 3) | 0b001
+
+    cmd = isa.sync(17)
+    assert (cmd >> 123) & 0x1f == 0b01110
+    assert (cmd >> 112) & 0xff == 17
+
+
+def test_bytes_roundtrip():
+    cmds = [isa.pulse_cmd(freq_word=1, cmd_time=5), isa.done_cmd(),
+            isa.alu_cmd('reg_alu', 'i', 123, 'id0', 0, write_reg_addr=2)]
+    buf = isa.cmds_to_bytes(cmds)
+    assert len(buf) == 48
+    assert isa.bytes_to_cmds(buf) == cmds
+
+
+def test_decode_soa_roundtrip():
+    cmds = [
+        isa.pulse_cmd(freq_word=0x12, phase_word=0x345, amp_word=0x6789,
+                      env_word=0x00abc, cfg_word=2, cmd_time=77),
+        isa.pulse_cmd(phase_regaddr=5),
+        isa.alu_cmd('reg_alu', 'i', -42, 'sub', 3, write_reg_addr=9),
+        isa.alu_cmd('reg_alu', 'r', 11, 'ge', 3, write_reg_addr=1),
+        isa.alu_cmd('jump_cond', 'i', 1, 'eq', 4, jump_cmd_ptr=13),
+        isa.alu_cmd('jump_fproc', 'i', 0, 'le', jump_cmd_ptr=2, func_id=3),
+        isa.alu_cmd('alu_fproc', 'i', 0, 'id1', write_reg_addr=6, func_id=1),
+        isa.alu_cmd('inc_qclk', 'i', -1000),
+        isa.jump_i(200),
+        isa.sync(3),
+        isa.idle(4096),
+        isa.pulse_reset(),
+        isa.done_cmd(),
+    ]
+    soa = isa.decode_soa(isa.cmds_to_bytes(cmds))
+    k = soa.kind
+    assert list(k) == [isa.K_PULSE_TRIG, isa.K_PULSE_WRITE, isa.K_REG_ALU,
+                       isa.K_REG_ALU, isa.K_JUMP_COND, isa.K_JUMP_FPROC,
+                       isa.K_ALU_FPROC, isa.K_INC_QCLK, isa.K_JUMP_I,
+                       isa.K_SYNC, isa.K_IDLE, isa.K_PULSE_RESET, isa.K_DONE]
+    assert soa.p_freq[0] == 0x12 and soa.p_phase[0] == 0x345
+    assert soa.p_amp[0] == 0x6789 and soa.p_env[0] == 0x00abc
+    assert soa.p_cfg[0] == 2 and soa.cmd_time[0] == 77
+    assert soa.p_wen[0] == 0b11111 and soa.p_regsel[0] == 0
+    # reg-sourced phase
+    assert soa.p_wen[1] == 0b00010 and soa.p_regsel[1] == 0b00010
+    assert soa.p_reg[1] == 5
+    assert soa.imm[2] == -42 and soa.in1_reg[2] == 3 and soa.out_reg[2] == 9
+    assert soa.in0_is_reg[3] == 1 and soa.in0_reg[3] == 11
+    assert soa.jump_addr[4] == 13
+    assert soa.func_id[5] == 3 and soa.jump_addr[5] == 2
+    assert soa.out_reg[6] == 6 and soa.func_id[6] == 1
+    assert soa.imm[7] == -1000
+    assert soa.jump_addr[8] == 200
+    assert soa.barrier[9] == 3
+    assert soa.cmd_time[10] == 4096
+    # all-zero word halts like DONE
+    soa0 = isa.decode_soa(b'\x00' * 16)
+    assert soa0.kind[0] == isa.K_DONE
+
+
+def test_stack_soa_padding():
+    a = isa.decode_soa(isa.cmds_to_bytes([isa.done_cmd()]))
+    b = isa.decode_soa(isa.cmds_to_bytes([isa.jump_i(1), isa.done_cmd()]))
+    stacked = isa.stack_soa([a, b], pad_to=4)
+    assert stacked.kind.shape == (2, 4)
+    assert stacked.kind[0, 0] == isa.K_DONE
+    assert np.all(stacked.kind[:, 2:] == isa.K_DONE)
+
+
+def test_disassemble():
+    cmds = [isa.pulse_cmd(freq_word=9, env_word=(3 << 12) | 2, cfg_word=1,
+                          cmd_time=55),
+            isa.alu_cmd('reg_alu', 'i', 5, 'add', 2, write_reg_addr=3)]
+    dis = isa.disassemble(isa.cmds_to_bytes(cmds))
+    assert dis[0]['op'] == 'pulse_write_trig'
+    assert dis[0]['cmd_time'] == 55 and dis[0]['freq'] == 9
+    assert dis[0]['env_start'] == 2 and dis[0]['env_length'] == 3
+    assert dis[1] == {'op': 'reg_alu', 'alu_op': 'add', 'in0': 5,
+                      'in1_reg': 2, 'out_reg': 3}
