@@ -75,6 +75,38 @@ class MachineProgram:
         return n_pulse_instr * (loop_bound if has_backjump else 1)
 
 
+def machine_program_from_cmds(cmds_per_core, elem_cfgs=None,
+                              pad_to: int = None) -> MachineProgram:
+    """Build a MachineProgram directly from per-core 128-bit command lists.
+
+    The raw-command analog of the reference's cocotb `load_commands` path
+    (reference: cocotb/proc/test_proc.py:29-38): tests hand-assemble
+    commands and run them without the compiler.  ``elem_cfgs``: element
+    configs shared by every core; defaults to the standard qdrv/rdrv/rdlo
+    geometry (16/16/4 samples per clock).
+    """
+    if elem_cfgs is None:
+        elem_cfgs = [TPUElementConfig(samples_per_clk=16),
+                     TPUElementConfig(samples_per_clk=16),
+                     TPUElementConfig(samples_per_clk=4)]
+    soas = []
+    for cmds in cmds_per_core:
+        if isinstance(cmds, (bytes, bytearray)):
+            soas.append(isa.decode_soa(cmds))
+        else:
+            soas.append(isa.decode_soa(isa.cmds_to_bytes(cmds)))
+    soa = isa.stack_soa(soas, pad_to=pad_to)
+    n_cores, n_instr = soa.kind.shape
+    tables = [CoreTables(envs=[np.zeros(0, complex)] * len(elem_cfgs),
+                         freqs=[{'freq': np.zeros(0), 'iq15': np.zeros((0, 15))}] * len(elem_cfgs),
+                         elem_cfgs=list(elem_cfgs))
+              for _ in range(n_cores)]
+    return MachineProgram(soa=soa,
+                          p_elem=np.zeros((n_cores, n_instr), dtype=np.int32),
+                          p_dur=np.zeros((n_cores, n_instr), dtype=np.int32),
+                          tables=tables, core_inds=list(range(n_cores)))
+
+
 def _pulse_duration_clks(env_word: int, cfg: TPUElementConfig) -> int:
     """Pulse duration in FPGA clocks from the env word length field."""
     _, n_samples, is_cw = cfg.env_word_fields(env_word)

@@ -1,0 +1,5 @@
+from .interpreter import (InterpreterConfig, simulate, simulate_batch,
+                          ERR_MISSED_TRIG, ERR_PULSE_OVERFLOW,
+                          ERR_MEAS_OVERFLOW, ERR_FPROC_DEADLOCK,
+                          ERR_SYNC_DONE)
+from .oracle import OracleCore, run_oracle

@@ -1,0 +1,363 @@
+"""Vectorised JAX interpreter for the distributed-processor ISA.
+
+This is the TPU-native replacement for the reference's per-qubit RTL
+cores (reference: hdl/proc.sv + hdl/ctrl.v): instead of N soft CPUs
+stepping an FSM, every core of every shot advances one *instruction* per
+``lax.while_loop`` iteration, with all per-core state held in int32
+arrays shaped ``[n_cores, ...]`` (``vmap`` adds the shot axis).  Cross-
+core coupling — the sync barrier and the measurement (fproc) fabric — is
+computed with masked reductions over the core axis each step, which is
+the lockstep-convergence equivalent of the reference's `sync_iface` /
+`fproc_iface` wiring (reference: hdl/sync_iface.sv, hdl/fproc_meas.sv,
+hdl/core_state_mgr.sv).
+
+Timing semantics match :mod:`.oracle` (the scalar golden model) exactly;
+see that module's docstring for the contract.  The instruction-cost
+model is the Schedule pass's (`ir/passes.py _TimedPass`), so any program
+the compiler schedules executes without trigger misses by construction;
+a program that *would* stall the hardware issue pipeline sets an error
+bit instead of silently sliding the pulse (the runtime analog of
+LintSchedule — reference: python/distproc/ir/passes.py:785-791).
+
+Measurement bits are injected per (shot, core, measurement-index) —
+exactly the strategy the reference's cocotb testbench uses to stand in
+for the readout chain (reference: cocotb/proc/test_proc.py:441-446,
+sim_modules/toplevel_sim.sv:16-18).  The DSP path (ops/) produces these
+bits from demodulated waveforms when physics-in-the-loop is wanted.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, replace
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import isa
+from ..hwconfig import FPGAConfig
+from .oracle import START_NCLKS, QCLK_RST_DELAY, MEAS_LATENCY
+
+INT32_MAX = np.int32(2**31 - 1)
+
+# error bits (per core)
+ERR_MISSED_TRIG = 1      # pulse/idle trigger time already passed at issue
+ERR_PULSE_OVERFLOW = 2   # more pulses than the static record buffer
+ERR_MEAS_OVERFLOW = 4    # more measurements than meas_bits provides
+ERR_FPROC_DEADLOCK = 8   # fproc read with producer halted and no data
+ERR_SYNC_DONE = 16       # barrier released with a participant already done
+ERR_FPROC_ID = 32        # fproc func_id out of range
+
+_PMASKS = np.array([0xffffff, 0x1ffff, 0x1ff, 0xffff, 0xf], dtype=np.int32)
+# field order matches isa.PULSE_PARAM_ORDER = (env, phase, freq, amp, cfg)
+
+
+@dataclass(frozen=True)
+class InterpreterConfig:
+    """Static execution parameters (all shape-determining or trace-constant)."""
+    max_steps: int = 4096
+    max_pulses: int = 256
+    max_meas: int = 64
+    max_resets: int = 8
+    fabric: str = 'sticky'        # 'sticky' | 'fresh'
+    meas_elem: int = 2            # element index whose pulses are readouts
+    meas_latency: int = MEAS_LATENCY
+    alu_instr_clks: int = 5
+    jump_cond_clks: int = 5
+    jump_fproc_clks: int = 8
+    pulse_regwrite_clks: int = 3
+    pulse_load_clks: int = 3
+
+    @classmethod
+    def from_fpga_config(cls, fpga_config: FPGAConfig, **kw) -> 'InterpreterConfig':
+        return cls(alu_instr_clks=fpga_config.alu_instr_clks,
+                   jump_cond_clks=fpga_config.jump_cond_clks,
+                   jump_fproc_clks=fpga_config.jump_fproc_clks,
+                   pulse_regwrite_clks=fpga_config.pulse_regwrite_clks,
+                   pulse_load_clks=fpga_config.pulse_load_clks, **kw)
+
+
+def _alu_vec(op, in0, in1):
+    """Vectorised 8-op ALU on int32 lanes (reference: hdl/alu.v:31-51)."""
+    return jnp.select(
+        [op == 0, op == 1, op == 2, op == 3, op == 4, op == 5, op == 6],
+        [in0, in0 + in1, in0 - in1,
+         (in0 == in1).astype(jnp.int32), (in0 <= in1).astype(jnp.int32),
+         (in0 >= in1).astype(jnp.int32), in1],
+        jnp.zeros_like(in0))
+
+
+def _program_constants(mp, cfg: InterpreterConfig):
+    """Host-side: freeze the decoded program into device constants."""
+    soa = {f: jnp.asarray(getattr(mp.soa, f)) for f in (
+        'kind', 'alu_op', 'in0_is_reg', 'imm', 'in0_reg', 'in1_reg', 'out_reg',
+        'jump_addr', 'func_id', 'cmd_time',
+        'p_env', 'p_phase', 'p_freq', 'p_amp', 'p_cfg',
+        'p_wen', 'p_regsel', 'p_reg')}
+    n_cores = mp.n_cores
+    max_elems = max((len(t.elem_cfgs) for t in mp.tables), default=0) or 1
+    spc = np.ones((n_cores, max_elems), dtype=np.int32)
+    interp = np.zeros((n_cores, max_elems), dtype=np.int32)
+    for c, t in enumerate(mp.tables):
+        for e, ec in enumerate(t.elem_cfgs):
+            spc[c, e] = ec.samples_per_clk
+            interp[c, e] = ec.interp_ratio
+    return soa, jnp.asarray(spc), jnp.asarray(interp), \
+        jnp.asarray(mp.sync_participants)
+
+
+def _init_state(n_cores: int, cfg: InterpreterConfig) -> dict:
+    C, P, M, R = n_cores, cfg.max_pulses, cfg.max_meas, cfg.max_resets
+    z = lambda *s: jnp.zeros(s, dtype=jnp.int32)
+    return dict(
+        pc=z(C), regs=z(C, isa.N_REGS),
+        time=jnp.full((C,), START_NCLKS, jnp.int32), offset=z(C),
+        done=jnp.zeros((C,), bool), err=z(C), pp=z(C, 5),
+        n_pulses=z(C),
+        rec_qtime=z(C, P), rec_gtime=z(C, P), rec_env=z(C, P),
+        rec_phase=z(C, P), rec_freq=z(C, P), rec_amp=z(C, P),
+        rec_cfg=z(C, P), rec_elem=z(C, P), rec_dur=z(C, P),
+        n_resets=z(C), rst_time=z(C, R),
+        n_meas=z(C), meas_avail=jnp.full((C, M), INT32_MAX, jnp.int32),
+    )
+
+
+def _step(st: dict, soa: dict, spc, interp, sync_part, meas_bits,
+          cfg: InterpreterConfig) -> dict:
+    C = st['pc'].shape[0]
+    cidx = jnp.arange(C)
+    pc = jnp.clip(st['pc'], 0, soa['kind'].shape[1] - 1)
+    g = lambda f: soa[f][cidx, pc]
+    kind = g('kind')
+    live = ~st['done']
+    time, offset, regs = st['time'], st['offset'], st['regs']
+
+    # ---- operand fetch -------------------------------------------------
+    in0 = jnp.where(g('in0_is_reg') == 1, regs[cidx, g('in0_reg')], g('imm'))
+    qclk = time - offset
+    is_fproc = (kind == isa.K_ALU_FPROC) | (kind == isa.K_JUMP_FPROC)
+
+    # ---- fproc fabric (reference: hdl/fproc_meas.sv / core_state_mgr.sv)
+    fid = g('func_id')
+    fid_bad = fid >= C
+    prod = jnp.clip(fid, 0, C - 1)
+    req = time
+    mavail_p = st['meas_avail'][prod]                       # [C, M]
+    nmeas_p = st['n_meas'][prod]
+    prod_done = st['done'][prod]
+    if cfg.fabric == 'sticky':
+        # bit latched at read time; producer must have simulated past `req`
+        f_ready = prod_done | (st['time'][prod] >= req)
+        m_cnt = jnp.sum(mavail_p <= req[:, None], axis=1)
+        f_data = jnp.where(m_cnt > 0,
+                           meas_bits[prod, jnp.maximum(m_cnt - 1, 0)], 0)
+        f_tready = req
+        f_deadlock = jnp.zeros((C,), bool)
+    else:
+        # fresh: first measurement completing strictly after the request
+        fresh = (mavail_p > req[:, None]) & \
+            (jnp.arange(cfg.max_meas)[None, :] < nmeas_p[:, None])
+        exists = jnp.any(fresh, axis=1)
+        j = jnp.argmax(fresh, axis=1)
+        f_data = jnp.where(exists, meas_bits[prod, j], 0)
+        f_tready = jnp.where(exists, jnp.maximum(req, mavail_p[cidx, j]), req)
+        f_deadlock = ~exists & prod_done
+        f_ready = exists | f_deadlock
+    f_ready = f_ready | fid_bad
+    f_data = jnp.where(fid_bad, 0, f_data)
+
+    # ---- ALU (in1 mux per reference: hdl/proc.sv:111) ------------------
+    in1 = jnp.where(is_fproc, f_data,
+                    jnp.where(kind == isa.K_INC_QCLK, qclk,
+                              regs[cidx, g('in1_reg')]))
+    alu_res = _alu_vec(g('alu_op'), in0, in1)
+
+    # ---- sync barrier (reference: ctrl.v:510-552 + qclk reset) ---------
+    at_sync = live & (kind == isa.K_SYNC)
+    live_part = sync_part & live
+    sync_ready = jnp.any(at_sync) & jnp.all(~live_part | at_sync)
+    release = jnp.max(jnp.where(at_sync, time, -INT32_MAX)) + QCLK_RST_DELAY
+    sync_adv = at_sync & sync_ready
+    sync_err = sync_ready & jnp.any(sync_part & st['done'])
+
+    # ---- stall mask ----------------------------------------------------
+    stalled = (is_fproc & ~f_ready) | (at_sync & ~sync_ready)
+    adv = live & ~stalled                     # cores executing this step
+
+    # ---- pulse-register latch + trigger --------------------------------
+    is_pw = kind == isa.K_PULSE_WRITE
+    is_pt = kind == isa.K_PULSE_TRIG
+    is_pulse = (is_pw | is_pt) & adv
+    imm_vals = jnp.stack([g('p_env'), g('p_phase'), g('p_freq'),
+                          g('p_amp'), g('p_cfg')], axis=1)       # [C, 5]
+    wen = (g('p_wen')[:, None] >> jnp.arange(5)[None, :]) & 1
+    rsel = (g('p_regsel')[:, None] >> jnp.arange(5)[None, :]) & 1
+    regval = regs[cidx, g('p_reg')]
+    cand = jnp.where(rsel == 1, regval[:, None], imm_vals) & _PMASKS[None, :]
+    pp = jnp.where(is_pulse[:, None] & (wen == 1), cand, st['pp'])
+
+    cmd_time = g('cmd_time')                  # uint32 bit pattern
+    trig = offset + cmd_time
+    missed_trig = is_pt & adv & (trig < time)
+    trig = jnp.maximum(trig, time)
+    elem = pp[:, 4] & 0b11
+    elem_c = jnp.minimum(elem, spc.shape[1] - 1)
+    envw = pp[:, 0]
+    env_len = (envw >> 12) & 0xfff
+    nsamp = env_len * 4 * interp[cidx, elem_c]
+    dur = jnp.where(env_len == 0xfff, 0,
+                    (nsamp + spc[cidx, elem_c] - 1) // spc[cidx, elem_c])
+
+    fire = is_pt & adv
+    slot = jnp.minimum(st['n_pulses'], cfg.max_pulses - 1)
+    rec_of = jnp.where(fire & (st['n_pulses'] >= cfg.max_pulses),
+                       ERR_PULSE_OVERFLOW, 0)
+    new_rec = {}
+    for name, val in (('qtime', cmd_time), ('gtime', trig),
+                      ('env', pp[:, 0]), ('phase', pp[:, 1]),
+                      ('freq', pp[:, 2]), ('amp', pp[:, 3]),
+                      ('cfg', pp[:, 4]), ('elem', elem), ('dur', dur)):
+        arr = st['rec_' + name]
+        new_rec['rec_' + name] = arr.at[cidx, slot].set(
+            jnp.where(fire, val, arr[cidx, slot]))
+    n_pulses = st['n_pulses'] + fire.astype(jnp.int32)
+
+    is_meas_pulse = fire & (elem == cfg.meas_elem)
+    mslot = jnp.minimum(st['n_meas'], cfg.max_meas - 1)
+    meas_of = jnp.where(is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
+                        ERR_MEAS_OVERFLOW, 0)
+    meas_avail = st['meas_avail'].at[cidx, mslot].set(
+        jnp.where(is_meas_pulse, trig + dur + cfg.meas_latency,
+                  st['meas_avail'][cidx, mslot]))
+    n_meas = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
+
+    # ---- phase reset record --------------------------------------------
+    is_rst = (kind == isa.K_PULSE_RESET) & adv
+    rslot = jnp.minimum(st['n_resets'], cfg.max_resets - 1)
+    rst_time = st['rst_time'].at[cidx, rslot].set(
+        jnp.where(is_rst, time, st['rst_time'][cidx, rslot]))
+    n_resets = st['n_resets'] + is_rst.astype(jnp.int32)
+
+    # ---- idle ----------------------------------------------------------
+    is_idle = (kind == isa.K_IDLE) & adv
+    idle_end = offset + cmd_time
+    missed_idle = is_idle & (time > idle_end)
+    idle_end = jnp.maximum(idle_end, time)
+
+    # ---- register writeback --------------------------------------------
+    wr_reg = ((kind == isa.K_REG_ALU) | (kind == isa.K_ALU_FPROC)) & adv
+    out_reg = g('out_reg')
+    regs = regs.at[cidx, out_reg].set(
+        jnp.where(wr_reg, alu_res, regs[cidx, out_reg]))
+
+    # ---- next pc -------------------------------------------------------
+    branch_taken = (alu_res & 1) == 1
+    pc_next = jnp.select(
+        [kind == isa.K_JUMP_I,
+         (kind == isa.K_JUMP_COND) | (kind == isa.K_JUMP_FPROC)],
+        [g('jump_addr'),
+         jnp.where(branch_taken, g('jump_addr'), st['pc'] + 1)],
+        st['pc'] + 1)
+    pc_next = jnp.where(sync_adv, st['pc'] + 1, pc_next)
+    is_done = (kind == isa.K_DONE) & adv
+    pc_next = jnp.where(adv & ~is_done, pc_next, st['pc'])
+
+    # ---- next time / qclk offset ---------------------------------------
+    time_next = jnp.select(
+        [is_pt, is_pw | is_rst, is_idle,
+         (kind == isa.K_REG_ALU) | (kind == isa.K_INC_QCLK),
+         (kind == isa.K_JUMP_I) | (kind == isa.K_JUMP_COND),
+         is_fproc],
+        [trig + cfg.pulse_load_clks,
+         time + cfg.pulse_regwrite_clks,
+         idle_end + cfg.pulse_load_clks,
+         time + cfg.alu_instr_clks,
+         time + cfg.jump_cond_clks,
+         f_tready + cfg.jump_fproc_clks],
+        time)
+    time_next = jnp.where(sync_adv, release, time_next)
+    time_next = jnp.where(adv, time_next, time)
+
+    # inc_qclk loads qclk = alu_res (with hardware pipeline compensation,
+    # reference: hdl/qclk.v:17); sync resets qclk to 0 at release
+    offset_next = jnp.where((kind == isa.K_INC_QCLK) & adv,
+                            time - alu_res, offset)
+    offset_next = jnp.where(sync_adv, release, offset_next)
+
+    err = st['err'] | rec_of | meas_of \
+        | jnp.where(missed_trig | missed_idle, ERR_MISSED_TRIG, 0) \
+        | jnp.where(is_fproc & adv & fid_bad, ERR_FPROC_ID, 0) \
+        | jnp.where(is_fproc & adv & f_deadlock, ERR_FPROC_DEADLOCK, 0) \
+        | jnp.where(sync_adv & sync_err, ERR_SYNC_DONE, 0)
+
+    return dict(st, pc=pc_next, regs=regs, time=time_next, offset=offset_next,
+                done=st['done'] | is_done, err=err, pp=pp, n_pulses=n_pulses,
+                n_resets=n_resets, rst_time=rst_time,
+                n_meas=n_meas, meas_avail=meas_avail, **new_rec)
+
+
+def _run(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
+         n_cores: int) -> dict:
+    st0 = _init_state(n_cores, cfg)
+    st0['_steps'] = jnp.int32(0)
+
+    def cond(st):
+        return (~jnp.all(st['done'])) & (st['_steps'] < cfg.max_steps)
+
+    def body(st):
+        steps = st.pop('_steps')
+        # detect global deadlock: every live core stalled => no state change
+        st2 = _step(st, soa, spc, interp, sync_part, meas_bits, cfg)
+        same = jnp.all(jnp.array(
+            [jnp.all(st2[k] == st[k]) for k in ('pc', 'time', 'done')]))
+        st2['err'] = jnp.where(same & ~st2['done'],
+                               st2['err'] | ERR_FPROC_DEADLOCK, st2['err'])
+        st2['done'] = st2['done'] | same
+        st2['_steps'] = steps + 1
+        return st2
+
+    st = jax.lax.while_loop(cond, body, st0)
+    steps = st.pop('_steps')
+    st['qclk'] = st['time'] - st['offset']
+    st['steps'] = steps
+    st['incomplete'] = ~jnp.all(st['done'])
+    return st
+
+
+@functools.partial(jax.jit, static_argnames=('cfg', 'n_cores'))
+def _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, n_cores):
+    return _run(soa, spc, interp, sync_part, meas_bits, cfg, n_cores)
+
+
+def simulate(mp, meas_bits=None, cfg: InterpreterConfig = None, **kw) -> dict:
+    """Execute a decoded :class:`~..decoder.MachineProgram` on one shot.
+
+    Returns the final machine state: pulse records (``rec_*`` arrays of
+    shape ``[n_cores, max_pulses]`` valid up to ``n_pulses``), final
+    registers, qclk values, per-core error bits, and completion flags.
+    """
+    cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    soa, spc, interp, sync_part = _program_constants(mp, cfg)
+    if meas_bits is None:
+        meas_bits = jnp.zeros((mp.n_cores, cfg.max_meas), jnp.int32)
+    meas_bits = jnp.asarray(meas_bits, jnp.int32)
+    if meas_bits.shape[1] < cfg.max_meas:
+        meas_bits = jnp.pad(meas_bits,
+                            ((0, 0), (0, cfg.max_meas - meas_bits.shape[1])))
+    return _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, mp.n_cores)
+
+
+def simulate_batch(mp, meas_bits, cfg: InterpreterConfig = None, **kw) -> dict:
+    """vmap :func:`simulate` over a leading shot axis of ``meas_bits``
+    (``[n_shots, n_cores, n_meas]``) — the reference re-runs shots on the
+    host; here shots are a vectorised batch axis on the accelerator."""
+    cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    soa, spc, interp, sync_part = _program_constants(mp, cfg)
+    meas_bits = jnp.asarray(meas_bits, jnp.int32)
+    if meas_bits.shape[2] < cfg.max_meas:
+        meas_bits = jnp.pad(
+            meas_bits, ((0, 0), (0, 0), (0, cfg.max_meas - meas_bits.shape[2])))
+    fn = jax.jit(jax.vmap(
+        lambda mb: _run(soa, spc, interp, sync_part, mb, cfg, mp.n_cores)))
+    return fn(meas_bits)
