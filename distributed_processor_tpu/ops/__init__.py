@@ -1,0 +1,5 @@
+from .waveform import (synthesize_element, pulse_window_weights,
+                       resolve_pulse_freqs, iq_to_complex, complex_to_iq)
+from .demod import (demod_iq, demod_iq_pallas, discriminate,
+                    demod_and_discriminate, stack_window_weights)
+from .fabric import MeasLUT

@@ -1,0 +1,135 @@
+"""Readout demodulation + state discrimination.
+
+The reference's readout chain (IQ demod accumulator + state discriminator
+producing the ``meas``/``meas_valid`` bits consumed by the fproc fabric)
+lives in the out-of-repo gateware project; this repo only consumes its
+output bits (reference: hdl/fproc_meas.sv meas inputs, SURVEY §1).  Here
+the chain is implemented the TPU way:
+
+* demod is a matmul: ``acc[shot, 2m:2m+2] = adc[shot, :] @ W[:, 2m:2m+2]``
+  with the conj-reference weights from
+  :func:`..ops.waveform.pulse_window_weights` — shots × samples on the
+  MXU instead of a per-sample accumulator FSM;
+* a Pallas kernel (:func:`demod_iq_pallas`) tiles the same contraction
+  through VMEM for long traces, fusing the I/Q pair into one pass;
+* discrimination projects IQ onto a separation axis and thresholds —
+  one fused elementwise op.
+
+I/Q results are real float32 with a trailing axis of 2 (no complex
+dtypes on device — see :mod:`.waveform`).  All entry points are
+jit/vmap/shard_map-friendly; the shot axis is the framework's
+data-parallel axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    _HAS_PALLAS = True
+except ImportError:      # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _as_iq_centers(c):
+    """Accept complex [M] or real [M, 2] calibration centroids."""
+    c = np.asarray(c)
+    if np.iscomplexobj(c) or c.ndim == 1:
+        return jnp.asarray(
+            np.stack([np.real(c), np.imag(c)], axis=-1).astype(np.float32))
+    return jnp.asarray(c, jnp.float32)
+
+
+def demod_iq(adc, weights):
+    """Demod ``[S, N]`` ADC traces against ``[N, 2M]`` window weights.
+
+    Returns float32 ``[S, M, 2]`` I/Q accumulations (columns ``2m``/
+    ``2m+1`` of ``weights`` are measurement m's I and Q references).
+    """
+    adc = jnp.asarray(adc, jnp.float32)
+    weights = jnp.asarray(weights, jnp.float32)
+    acc = adc @ weights                       # [S, 2M]
+    return acc.reshape(acc.shape[0], -1, 2)
+
+
+def stack_window_weights(weight_list, n_samples: int,
+                         starts=None) -> np.ndarray:
+    """Stack per-measurement ``[n, 2]`` window weights into the dense
+    ``[n_samples, 2M]`` demod matrix (zero outside each window)."""
+    M = len(weight_list)
+    W = np.zeros((n_samples, 2 * M), dtype=np.float32)
+    for m, w in enumerate(weight_list):
+        s = 0 if starts is None else int(starts[m])
+        n = min(len(w), n_samples - s)
+        W[s:s + n, 2 * m] = w[:n, 0]
+        W[s:s + n, 2 * m + 1] = w[:n, 1]
+    return W
+
+
+def _demod_kernel(adc_ref, w_ref, out_ref):
+    out_ref[:] = jnp.dot(adc_ref[:], w_ref[:],
+                         preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=('block_s', 'interpret'))
+def demod_iq_pallas(adc, weights, block_s: int = 256, interpret: bool = False):
+    """Pallas-tiled demod: shots blocked through VMEM, full contraction
+    per block (readout windows are short; N fits VMEM comfortably).
+
+    Matches :func:`demod_iq` in float32.  Set ``interpret=True`` off-TPU
+    (tests run it on the CPU interpreter).
+    """
+    if not _HAS_PALLAS:   # pragma: no cover
+        return demod_iq(adc, weights)
+    adc = jnp.asarray(adc, jnp.float32)
+    weights = jnp.asarray(weights, jnp.float32)
+    S, N = adc.shape
+    M2 = weights.shape[1]
+    pad_s = (-S) % block_s
+    if pad_s:
+        adc = jnp.pad(adc, ((0, pad_s), (0, 0)))
+    Sp = adc.shape[0]
+    acc = pl.pallas_call(
+        _demod_kernel,
+        grid=(Sp // block_s,),
+        in_specs=[
+            pl.BlockSpec((block_s, N), lambda i: (i, 0)),
+            pl.BlockSpec((N, M2), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_s, M2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((Sp, M2), jnp.float32),
+        interpret=interpret,
+    )(adc, weights)
+    acc = acc[:S]
+    return acc.reshape(S, -1, 2)
+
+
+def discriminate(iq, centers0, centers1, threshold: float = 0.0):
+    """Binary state discrimination by projection onto the |0>-|1> axis.
+
+    ``iq``: ``[S, M, 2]`` I/Q points; ``centers0``/``centers1``: per-
+    channel calibration centroids (complex ``[M]`` or real ``[M, 2]``).
+    Returns int32 bits ``[S, M]``.
+    """
+    iq = jnp.asarray(iq, jnp.float32)
+    c0, c1 = _as_iq_centers(centers0), _as_iq_centers(centers1)
+    axis = c1 - c0                            # [M, 2]
+    mid = (c0 + c1) / 2
+    proj = jnp.sum((iq - mid[None]) * axis[None], axis=-1)
+    return (proj > threshold).astype(jnp.int32)
+
+
+def demod_and_discriminate(adc, weights, centers0, centers1,
+                           use_pallas: bool = False,
+                           interpret: bool = False):
+    """Fused ADC trace -> discriminated bits (the full readout chain)."""
+    if use_pallas:
+        iq = demod_iq_pallas(adc, weights, interpret=interpret)
+    else:
+        iq = demod_iq(adc, weights)
+    return discriminate(iq, centers0, centers1), iq

@@ -1,0 +1,49 @@
+"""Measurement-distribution fabric: the syndrome LUT.
+
+TPU-native equivalent of the reference's ``meas_lut`` gateware
+(reference: hdl/meas_lut.sv, hdl/fproc_lut.sv): measurement bits from a
+masked set of input cores form a table address; the table returns one
+output bit per core.  Where the gateware hard-codes the mask and table
+contents (reference: hdl/meas_lut.sv:16-20, TODO "make these writable"),
+this implementation takes them as arrays — a batched table-gather over
+the shot axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class MeasLUT:
+    """Configurable syndrome LUT over ``n_cores`` measurement bits.
+
+    ``input_mask``: bool ``[n_cores]`` — which cores' bits form the
+    address (bit i of the address is the i-th set core, LSB first).
+    ``table``: int ``[2^k]`` — each entry is an n_cores-wide bitmask of
+    output bits (one per core), matching the gateware's ``lut_mem``.
+    """
+
+    def __init__(self, input_mask, table):
+        self.input_mask = np.asarray(input_mask, bool)
+        self.table = jnp.asarray(table, jnp.int32)
+        k = int(self.input_mask.sum())
+        if len(table) != 1 << k:
+            raise ValueError(f'table must have 2^{k} entries, got {len(table)}')
+        # address bit position per core (0 for unmasked cores)
+        self._addr_shift = np.zeros(len(self.input_mask), dtype=np.int32)
+        self._addr_shift[self.input_mask] = np.arange(k)
+
+    def address(self, bits):
+        """bits ``[..., n_cores]`` -> table address ``[...]``."""
+        bits = jnp.asarray(bits, jnp.int32)
+        shifts = jnp.asarray(self._addr_shift)
+        mask = jnp.asarray(self.input_mask, jnp.int32)
+        return jnp.sum(bits * mask * (1 << shifts), axis=-1)
+
+    def __call__(self, bits):
+        """bits ``[..., n_cores]`` -> per-core LUT output bits, same shape."""
+        addr = self.address(bits)
+        entry = self.table[addr]                        # [...]
+        n = len(self.input_mask)
+        return (entry[..., None] >> jnp.arange(n)) & 1

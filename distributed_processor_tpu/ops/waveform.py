@@ -1,0 +1,145 @@
+"""Signal-generator element: DAC waveform synthesis from pulse records.
+
+The reference keeps the DDS/envelope element out-of-repo (the separate
+LBL-QubiC/gateware project; this repo only fixes its interface — reference:
+hdl/pulse_iface.sv:1-6 — and its buffer word formats — reference:
+python/distproc/asmparse.py:46-86).  This module implements the element
+numerically so the simulation loop closes: given the interpreter's pulse
+records and the assembler's envelope/frequency tables, produce the
+baseband output of one element.
+
+I/Q values are carried as a trailing axis of size 2 (``[..., 0]`` = I,
+``[..., 1]`` = Q) in float32 — complex dtypes are avoided on the device
+compute path (TPU backends vectorise real pairs; complex views are a
+host-side convenience via :func:`iq_to_complex`).
+
+Numeric contract (defined here, consistent with
+:mod:`distributed_processor_tpu.elements`):
+
+* carrier is phase-coherent: phase at DAC sample ``n`` (counted from the
+  last phase reset) is ``2*pi*freq*n/fsamp + phase_offset`` — this is the
+  invariant the compiler's virtual-z accumulation relies on;
+* envelope memory holds ``interp_ratio``-decimated samples; sample ``n``
+  of a pulse starting at DAC sample ``s`` reads envelope index
+  ``env_start + (n - s) // interp_ratio``;
+* a continuous-wave pulse (length sentinel 0xfff) holds the envelope
+  sample at its start address until the next pulse on the element or the
+  end of the trace;
+* output = ``amp_frac * env_iq * exp(i*phase)`` with
+  ``amp_frac = amp_word / (2^16 - 1)`` and envelope scaled to [-1, 1].
+
+Everything is static-shape and vmappable over shots; the per-sample
+formulation is a sum over pulse windows, which XLA fuses into a single
+elementwise pipeline over the trace.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..elements import ENV_CW_SENTINEL
+
+PHASE_BITS = 17
+AMP_SCALE = float(2 ** 16 - 1)
+
+
+def iq_to_complex(x):
+    """Host-side view: ``[..., 2]`` I/Q pairs -> complex array."""
+    x = np.asarray(x)
+    return x[..., 0] + 1j * x[..., 1]
+
+
+def complex_to_iq(z) -> np.ndarray:
+    z = np.asarray(z)
+    return np.stack([np.real(z), np.imag(z)], axis=-1).astype(np.float32)
+
+
+def synthesize_element(rec: dict, env_table, spc: int, interp: int,
+                       n_clks: int, elem: int = 0):
+    """Render one element's baseband trace from pulse records.
+
+    ``rec``: dict with 1-D arrays ``gtime, env, phase, freq_rel, amp, elem``
+    (one entry per emitted pulse; ``freq_rel = freq/fsamp`` already
+    resolved from the frequency table) and scalar ``n_pulses``.
+    ``env_table``: envelope memory for this element — complex array or
+    ``[n, 2]`` I/Q array (fractional, i.e. raw int15 / IQ_SCALE).
+    Returns ``float32[n_clks * spc, 2]`` I/Q samples.
+    """
+    n_samples = n_clks * spc
+    n = jnp.arange(n_samples)
+    env_table = np.asarray(env_table)
+    if env_table.ndim == 1:          # complex -> I/Q pairs
+        env_table = complex_to_iq(env_table)
+    env_len_mem = max(len(env_table), 1)
+    env_table = jnp.asarray(
+        np.pad(env_table.astype(np.float32), ((0, 1), (0, 0))))  # zero slot
+
+    P = rec['gtime'].shape[0]
+    valid = (jnp.arange(P) < rec['n_pulses']) & (rec['elem'] == elem)
+    start = rec['gtime'] * spc                        # [P] DAC start sample
+    env_word = rec['env']
+    env_addr = (env_word & 0xfff) * 4
+    env_nw = (env_word >> 12) & 0xfff
+    is_cw = env_nw == ENV_CW_SENTINEL
+    length = jnp.where(is_cw, n_samples, env_nw * 4 * interp)  # in DAC samples
+
+    # CW pulses end at the next valid pulse on this element
+    big = jnp.int32(2 ** 30)
+    starts_sorted = jnp.where(valid, start, big)
+    next_start = jnp.min(
+        jnp.where(starts_sorted[None, :] > start[:, None],
+                  starts_sorted[None, :], big), axis=1)
+    end = jnp.where(is_cw, jnp.minimum(next_start, n_samples), start + length)
+
+    amp = rec['amp'].astype(jnp.float32) / AMP_SCALE
+    phase0 = 2 * jnp.pi * (rec['phase'].astype(jnp.float32)
+                           / (1 << PHASE_BITS))
+    freq_rel = rec['freq_rel'].astype(jnp.float32)    # freq / fsamp
+
+    # [P, N] windowed contributions; pulses on one element never overlap
+    # (the Schedule pass serialises them per dest channel), so a sum is an
+    # exclusive select.
+    in_win = valid[:, None] & (n[None, :] >= start[:, None]) \
+        & (n[None, :] < end[:, None])
+    k = (n[None, :] - start[:, None]) // interp
+    env_idx = jnp.where(is_cw[:, None], env_addr[:, None],
+                        env_addr[:, None] + k)
+    env_idx = jnp.where(in_win, jnp.clip(env_idx, 0, env_len_mem - 1),
+                        env_len_mem)                  # padded zero slot
+    env_i = env_table[env_idx, 0]                     # [P, N]
+    env_q = env_table[env_idx, 1]
+    theta = 2 * jnp.pi * freq_rel[:, None] * n[None, :].astype(jnp.float32) \
+        + phase0[:, None]
+    c, s = jnp.cos(theta), jnp.sin(theta)
+    out_i = amp[:, None] * (env_i * c - env_q * s)
+    out_q = amp[:, None] * (env_i * s + env_q * c)
+    zero = jnp.float32(0)
+    out_i = jnp.sum(jnp.where(in_win, out_i, zero), axis=0)
+    out_q = jnp.sum(jnp.where(in_win, out_q, zero), axis=0)
+    return jnp.stack([out_i, out_q], axis=-1)
+
+
+def resolve_pulse_freqs(rec_freq, freq_table_hz, fsamp: float):
+    """Map 9-bit frequency-buffer addresses to freq/fsamp ratios."""
+    table = jnp.asarray(np.asarray(freq_table_hz, np.float32) / fsamp)
+    table = jnp.pad(table, (0, 1))
+    idx = jnp.clip(rec_freq, 0, len(table) - 1)
+    return table[idx]
+
+
+def pulse_window_weights(start_clk: int, n_clks: int, spc: int,
+                         freq_hz: float, fsamp: float,
+                         env=None) -> np.ndarray:
+    """Demodulation weights for a readout window: conj reference carrier
+    (optionally envelope-weighted) over ``[start, start + n)`` clocks.
+
+    Host-side helper producing the ``[n_samples, 2]`` (I, Q) weight matrix
+    consumed by :func:`..ops.demod.demod_iq` — the numeric equivalent of
+    the accumulator the reference's out-of-repo readout chain implements.
+    """
+    n = np.arange(start_clk * spc, (start_clk + n_clks) * spc)
+    ref = np.exp(-2j * np.pi * freq_hz * n / fsamp)
+    if env is not None:
+        ref = ref * np.conj(np.asarray(env))
+    return np.stack([np.real(ref), np.imag(ref)], axis=1).astype(np.float32)

@@ -1,0 +1,154 @@
+"""DSP ops tests: waveform synthesis, demod, discrimination, meas LUT."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from distributed_processor_tpu.ops import (
+    synthesize_element, pulse_window_weights, demod_iq, demod_iq_pallas,
+    discriminate, demod_and_discriminate, MeasLUT, stack_window_weights,
+    iq_to_complex)
+from distributed_processor_tpu.elements import ENV_CW_SENTINEL
+
+
+def _rec(pulses, max_p=8):
+    """Build a pulse-record dict from a list of pulse dicts."""
+    fields = ('gtime', 'env', 'phase', 'freq_rel', 'amp', 'elem')
+    rec = {f: np.zeros(max_p, dtype=np.float32 if f == 'freq_rel' else np.int32)
+           for f in fields}
+    for i, p in enumerate(pulses):
+        for f in fields:
+            rec[f][i] = p.get(f, 0)
+    rec['n_pulses'] = np.int32(len(pulses))
+    return {k: jnp.asarray(v) for k, v in rec.items()}
+
+
+def test_synthesize_single_pulse_window():
+    env = np.ones(8, complex) * 0.5
+    rec = _rec([dict(gtime=2, env=(2 << 12) | 0, phase=0, freq_rel=0.0,
+                     amp=0xffff, elem=0)])
+    out = iq_to_complex(synthesize_element(rec, env, spc=4, interp=1, n_clks=8))
+    # pulse spans DAC samples [8, 16); amp 1.0 * env 0.5, DC carrier
+    assert np.allclose(out[:8], 0)
+    assert np.allclose(out[8:16], 0.5, atol=1e-6)
+    assert np.allclose(out[16:], 0)
+
+
+def test_synthesize_carrier_phase_coherence():
+    env = np.ones(16, complex)
+    freq_rel = 0.125   # freq = fsamp/8 -> period 8 samples
+    rec = _rec([dict(gtime=0, env=(4 << 12) | 0, phase=0, freq_rel=freq_rel,
+                     amp=0xffff, elem=0)])
+    out = iq_to_complex(synthesize_element(rec, env, spc=4, interp=1, n_clks=4))
+    n = np.arange(16)
+    np.testing.assert_allclose(out, np.exp(2j * np.pi * freq_rel * n),
+                               atol=1e-5)
+    # phase word rotates the carrier: pi/2 = 2^15 counts of 2^17
+    rec2 = _rec([dict(gtime=0, env=(4 << 12) | 0, phase=1 << 15,
+                      freq_rel=freq_rel, amp=0xffff, elem=0)])
+    out2 = iq_to_complex(synthesize_element(rec2, env, spc=4, interp=1, n_clks=4))
+    np.testing.assert_allclose(out2, out * 1j, atol=1e-5)
+
+
+def test_synthesize_cw_holds_until_next_pulse():
+    env = np.concatenate([np.ones(4), 0.25 * np.ones(4)]).astype(complex)
+    rec = _rec([
+        dict(gtime=0, env=(ENV_CW_SENTINEL << 12) | 0, phase=0, freq_rel=0.0,
+             amp=0xffff, elem=0),
+        dict(gtime=4, env=(1 << 12) | 1, phase=0, freq_rel=0.0,
+             amp=0xffff, elem=0),
+    ])
+    out = iq_to_complex(synthesize_element(rec, env, spc=4, interp=1, n_clks=8))
+    assert np.allclose(out[:16], 1.0)          # CW holds env[0]
+    assert np.allclose(out[16:20], 0.25)       # next pulse takes over
+    assert np.allclose(out[20:], 0)
+
+
+def test_synthesize_interp_ratio():
+    env = np.array([1.0, -1.0], complex)
+    rec = _rec([dict(gtime=0, env=(1 << 12) | 0, phase=0, freq_rel=0.0,
+                     amp=0xffff, elem=0)])
+    # interp 2: each env sample covers 2 DAC samples; 4 env slots * 2 = 8
+    out = iq_to_complex(synthesize_element(rec, env, spc=4, interp=2, n_clks=4))
+    assert np.allclose(out[0:2], 1.0) and np.allclose(out[2:4], -1.0)
+
+
+def test_demod_matched_filter():
+    fsamp, fr = 2e9, 0.125   # integer cycles over the window (no leakage)
+    spc, n_clks = 4, 16
+    n = np.arange(n_clks * spc)
+    adc = np.real(0.7 * np.exp(2j * np.pi * fr * n))[None, :]
+    w = pulse_window_weights(0, n_clks, spc, fr * fsamp, fsamp)
+    iq = iq_to_complex(demod_iq(adc, w))
+    # matched filter: I accumulates 0.7 * N/2
+    assert abs(iq[0, 0].real - 0.7 * len(n) / 2) < 1e-2
+    # orthogonal frequency demods to ~0
+    w2 = pulse_window_weights(0, n_clks, spc, 0.25 * fsamp, fsamp)
+    iq2 = iq_to_complex(demod_iq(adc, w2))
+    assert abs(iq2[0, 0]) < 1e-3 * len(n)
+
+
+def test_demod_pallas_matches_reference():
+    rng = np.random.default_rng(0)
+    adc = rng.standard_normal((37, 64)).astype(np.float32)
+    w = rng.standard_normal((64, 6)).astype(np.float32)
+    ref = np.asarray(demod_iq(adc, w))
+    got = np.asarray(demod_iq_pallas(adc, w, block_s=16, interpret=True))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_discriminate_centroids():
+    c0, c1 = np.array([0 + 0j]), np.array([2 + 2j])
+    iq = np.array([[[0.1, 0.1]], [[1.9, 1.8]], [[0.9, 1.2]]])
+    bits = np.asarray(discriminate(iq, c0, c1))
+    assert list(bits[:, 0]) == [0, 1, 1]
+
+
+def test_readout_chain_fidelity():
+    # BASELINE config 2 shape: synthesize readout tones for states 0/1 with
+    # noise, demod, threshold; fidelity must be high at good SNR
+    rng = np.random.default_rng(1)
+    fsamp, fr = 2e9, 0.05
+    spc, n_clks = 4, 64
+    N = n_clks * spc
+    n = np.arange(N)
+    shots = 512
+    states = rng.integers(0, 2, shots)
+    # state-dependent phase shift of the readout tone
+    phase = np.where(states, np.pi / 2, 0.0)
+    adc = np.real(np.exp(2j * np.pi * fr * n[None, :] + 1j * phase[:, None]))
+    adc = (adc + 0.5 * rng.standard_normal((shots, N))).astype(np.float32)
+    w = stack_window_weights([pulse_window_weights(0, n_clks, spc,
+                                                   fr * fsamp, fsamp)], N)
+    c0 = np.array([N / 2 + 0j])
+    c1 = np.array([(N / 2) * np.exp(1j * np.pi / 2)])
+    bits, iq = demod_and_discriminate(adc, w, c0, c1)
+    fidelity = np.mean(np.asarray(bits)[:, 0] == states)
+    assert fidelity > 0.99
+
+
+def test_meas_lut_parity():
+    # 3-input parity LUT distributing to 5 cores (meas_lut.sv geometry)
+    mask = [True, True, True, False, False]
+    table = np.zeros(8, dtype=np.int32)
+    for a in range(8):
+        par = bin(a).count('1') & 1
+        table[a] = 0b11111 if par else 0
+    lut = MeasLUT(mask, table)
+    bits = np.array([[1, 0, 0, 1, 1],
+                     [1, 1, 0, 0, 0],
+                     [1, 1, 1, 0, 1]])
+    out = np.asarray(lut(bits))
+    np.testing.assert_array_equal(out[0], [1] * 5)   # parity 1
+    np.testing.assert_array_equal(out[1], [0] * 5)   # parity 0
+    np.testing.assert_array_equal(out[2], [1] * 5)   # parity 1
+    assert int(lut.address(np.array([1, 0, 1, 1, 1]))) == 0b101
+
+
+def test_stack_window_weights_offsets():
+    w1 = np.ones((4, 2), np.float32)
+    w2 = 2 * np.ones((4, 2), np.float32)
+    W = stack_window_weights([w1, w2], 12, starts=[0, 8])
+    assert W.shape == (12, 4)
+    assert np.all(W[:4, 0] == 1) and np.all(W[4:, 0] == 0)
+    assert np.all(W[8:, 2] == 2) and np.all(W[:8, 2] == 0)
