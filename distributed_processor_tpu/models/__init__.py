@@ -1,0 +1,5 @@
+from .channels import make_channel_config, make_channel_configs
+from .experiments import (active_reset, rabi_program, t1_program,
+                          ramsey_program, loop_shots_program)
+from .rb import rb_program, rb_sequence, clifford_table
+from .readout import sample_meas_bits, apply_assignment_error, IQReadoutModel

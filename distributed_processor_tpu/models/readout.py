@@ -1,0 +1,71 @@
+"""Statistical readout models: measurement-bit sources for the simulator.
+
+The reference never models readout — real hardware (or the cocotb
+testbench) supplies the ``meas`` bits (reference: hdl/fproc_meas.sv
+inputs; cocotb/proc/test_proc.py:441-446).  For closed-loop simulation
+the framework needs a bit source; two are provided:
+
+* :func:`sample_meas_bits` — Bernoulli bits per (shot, core, index) with
+  optional assignment error (fast path for large sweeps; measurement
+  outcomes independent per index);
+* :class:`IQReadoutModel` — full-physics path: state-dependent IQ
+  clouds, demodulated and discriminated through :mod:`..ops.demod`, so
+  readout infidelity emerges from the noise model rather than being
+  injected.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.demod import discriminate
+
+
+def sample_meas_bits(key, p1, n_shots: int, n_meas: int):
+    """Bernoulli measurement bits ``[n_shots, n_cores, n_meas]``.
+
+    ``p1``: per-core probability of reading |1> (array ``[n_cores]``).
+    """
+    p1 = jnp.asarray(p1, jnp.float32)
+    n_cores = p1.shape[0]
+    u = jax.random.uniform(key, (n_shots, n_cores, n_meas))
+    return (u < p1[None, :, None]).astype(jnp.int32)
+
+
+def apply_assignment_error(key, bits, p01: float, p10: float):
+    """Flip bits with asymmetric assignment-error probabilities."""
+    u = jax.random.uniform(key, bits.shape)
+    p_flip = jnp.where(bits == 0, p01, p10)
+    return jnp.where(u < p_flip, 1 - bits, bits)
+
+
+class IQReadoutModel:
+    """Gaussian IQ-cloud readout: state -> IQ point -> discriminated bit.
+
+    ``centers0``/``centers1``: complex ``[n_cores]`` cloud centres;
+    ``sigma``: cloud standard deviation (same units).
+    """
+
+    def __init__(self, centers0, centers1, sigma: float):
+        self.c0 = np.asarray(centers0, complex)
+        self.c1 = np.asarray(centers1, complex)
+        self.sigma = float(sigma)
+
+    def sample_iq(self, key, states):
+        """states ``[S, C]`` (0/1) -> IQ points ``[S, C, 2]`` float32."""
+        states = jnp.asarray(states)
+        c0 = jnp.asarray(
+            np.stack([self.c0.real, self.c0.imag], -1), jnp.float32)
+        c1 = jnp.asarray(
+            np.stack([self.c1.real, self.c1.imag], -1), jnp.float32)
+        mean = jnp.where(states[..., None] == 1, c1[None], c0[None])
+        noise = self.sigma * jax.random.normal(key, mean.shape)
+        return mean + noise
+
+    def measure(self, key, states):
+        """states ``[S, C]`` -> (bits ``[S, C]``, iq ``[S, C, 2]``)."""
+        iq = self.sample_iq(key, states)
+        bits = discriminate(iq, self.c0, self.c1)
+        return bits, iq

@@ -1,0 +1,2 @@
+from .mesh import make_mesh, shot_sharding
+from .sweep import sharded_simulate, sweep_stats, sharded_demod

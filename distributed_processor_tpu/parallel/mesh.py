@@ -1,0 +1,31 @@
+"""Device-mesh construction for sharded sweeps.
+
+The reference's "distributed backend" is on-chip wiring between cores
+(reference: hdl/sync_iface.sv, hdl/fproc_meas.sv); scaling to more
+shots/sweep points is host-side re-running.  Here the scale axes are
+first-class: a `jax.sharding.Mesh` whose ``'dp'`` axis shards shots /
+sweep points (data parallel over ICI) and whose optional ``'mp'`` axis
+shards long demod contractions.  All cross-core coupling (fproc, sync)
+stays inside a shard — one shot never spans devices — so the only
+collectives are reductions of results, which ride ICI allreduce.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_dp: int = None, n_mp: int = 1, devices=None) -> Mesh:
+    """Build a ``('dp', 'mp')`` mesh over available devices."""
+    devices = devices if devices is not None else jax.devices()
+    if n_dp is None:
+        n_dp = len(devices) // n_mp
+    devs = np.asarray(devices[:n_dp * n_mp]).reshape(n_dp, n_mp)
+    return Mesh(devs, ('dp', 'mp'))
+
+
+def shot_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for ``[shots, ...]`` arrays: shots over the dp axis."""
+    return NamedSharding(mesh, P('dp'))

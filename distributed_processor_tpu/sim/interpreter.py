@@ -37,7 +37,7 @@ import jax.numpy as jnp
 
 from .. import isa
 from ..hwconfig import FPGAConfig
-from .oracle import START_NCLKS, QCLK_RST_DELAY, MEAS_LATENCY
+from .oracle import INIT_TIME, QCLK_RST_DELAY, MEAS_LATENCY
 
 INT32_MAX = np.int32(2**31 - 1)
 
@@ -107,12 +107,15 @@ def _program_constants(mp, cfg: InterpreterConfig):
         jnp.asarray(mp.sync_participants)
 
 
-def _init_state(n_cores: int, cfg: InterpreterConfig) -> dict:
+def _init_state(n_cores: int, cfg: InterpreterConfig,
+                init_regs=None) -> dict:
     C, P, M, R = n_cores, cfg.max_pulses, cfg.max_meas, cfg.max_resets
     z = lambda *s: jnp.zeros(s, dtype=jnp.int32)
+    regs = z(C, isa.N_REGS) if init_regs is None \
+        else jnp.asarray(init_regs, jnp.int32)
     return dict(
-        pc=z(C), regs=z(C, isa.N_REGS),
-        time=jnp.full((C,), START_NCLKS, jnp.int32), offset=z(C),
+        pc=z(C), regs=regs,
+        time=jnp.full((C,), INIT_TIME, jnp.int32), offset=z(C),
         done=jnp.zeros((C,), bool), err=z(C), pp=z(C, 5),
         n_pulses=z(C),
         rec_qtime=z(C, P), rec_gtime=z(C, P), rec_env=z(C, P),
@@ -298,8 +301,8 @@ def _step(st: dict, soa: dict, spc, interp, sync_part, meas_bits,
 
 
 def _run(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
-         n_cores: int) -> dict:
-    st0 = _init_state(n_cores, cfg)
+         n_cores: int, init_regs=None) -> dict:
+    st0 = _init_state(n_cores, cfg, init_regs)
     st0['_steps'] = jnp.int32(0)
 
     def cond(st):
@@ -326,12 +329,29 @@ def _run(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
 
 
 @functools.partial(jax.jit, static_argnames=('cfg', 'n_cores'))
-def _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, n_cores):
-    return _run(soa, spc, interp, sync_part, meas_bits, cfg, n_cores)
+def _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, n_cores, init_regs):
+    return _run(soa, spc, interp, sync_part, meas_bits, cfg, n_cores,
+                init_regs)
 
 
-def simulate(mp, meas_bits=None, cfg: InterpreterConfig = None, **kw) -> dict:
+def _pad_meas(meas_bits, max_meas: int):
+    meas_bits = jnp.asarray(meas_bits, jnp.int32)
+    if meas_bits.shape[-1] > max_meas:
+        meas_bits = meas_bits[..., :max_meas]
+    elif meas_bits.shape[-1] < max_meas:
+        pad = [(0, 0)] * (meas_bits.ndim - 1) \
+            + [(0, max_meas - meas_bits.shape[-1])]
+        meas_bits = jnp.pad(meas_bits, pad)
+    return meas_bits
+
+
+def simulate(mp, meas_bits=None, init_regs=None,
+             cfg: InterpreterConfig = None, **kw) -> dict:
     """Execute a decoded :class:`~..decoder.MachineProgram` on one shot.
+
+    ``init_regs``: optional ``[n_cores, 16]`` initial register file — the
+    batched sweep hook (register-parameterized pulses make amplitude /
+    phase / time sweeps pure data, no recompilation).
 
     Returns the final machine state: pulse records (``rec_*`` arrays of
     shape ``[n_cores, max_pulses]`` valid up to ``n_pulses``), final
@@ -341,23 +361,28 @@ def simulate(mp, meas_bits=None, cfg: InterpreterConfig = None, **kw) -> dict:
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     if meas_bits is None:
         meas_bits = jnp.zeros((mp.n_cores, cfg.max_meas), jnp.int32)
-    meas_bits = jnp.asarray(meas_bits, jnp.int32)
-    if meas_bits.shape[1] < cfg.max_meas:
-        meas_bits = jnp.pad(meas_bits,
-                            ((0, 0), (0, cfg.max_meas - meas_bits.shape[1])))
-    return _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, mp.n_cores)
+    meas_bits = _pad_meas(meas_bits, cfg.max_meas)
+    if init_regs is None:
+        init_regs = jnp.zeros((mp.n_cores, isa.N_REGS), jnp.int32)
+    init_regs = jnp.asarray(init_regs, jnp.int32)
+    return _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, mp.n_cores,
+                    init_regs)
 
 
-def simulate_batch(mp, meas_bits, cfg: InterpreterConfig = None, **kw) -> dict:
+def simulate_batch(mp, meas_bits, init_regs=None,
+                   cfg: InterpreterConfig = None, **kw) -> dict:
     """vmap :func:`simulate` over a leading shot axis of ``meas_bits``
     (``[n_shots, n_cores, n_meas]``) — the reference re-runs shots on the
-    host; here shots are a vectorised batch axis on the accelerator."""
+    host; here shots are a vectorised batch axis on the accelerator.
+    ``init_regs`` may also carry a leading shot/sweep-point axis."""
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
-    meas_bits = jnp.asarray(meas_bits, jnp.int32)
-    if meas_bits.shape[2] < cfg.max_meas:
-        meas_bits = jnp.pad(
-            meas_bits, ((0, 0), (0, 0), (0, cfg.max_meas - meas_bits.shape[2])))
-    fn = jax.jit(jax.vmap(
-        lambda mb: _run(soa, spc, interp, sync_part, mb, cfg, mp.n_cores)))
-    return fn(meas_bits)
+    meas_bits = _pad_meas(meas_bits, cfg.max_meas)
+    if init_regs is None:
+        fn = jax.jit(jax.vmap(lambda mb: _run(
+            soa, spc, interp, sync_part, mb, cfg, mp.n_cores)))
+        return fn(meas_bits)
+    init_regs = jnp.asarray(init_regs, jnp.int32)
+    fn = jax.jit(jax.vmap(lambda mb, ir: _run(
+        soa, spc, interp, sync_part, mb, cfg, mp.n_cores, ir)))
+    return fn(meas_bits, init_regs)

@@ -44,6 +44,11 @@ import numpy as np
 from .. import isa
 
 START_NCLKS = 5       # schedule origin (ir/passes.py START_NCLKS)
+# First instruction issues at INIT_TIME: the scheduler's START_NCLKS
+# margin covers the initial command fetch plus the phase_reset the
+# compiler prepends (cost pulse_regwrite_clks=3; 2 + 3 = START_NCLKS),
+# so compiled programs meet their first pulse time by construction.
+INIT_TIME = 2
 QCLK_RST_DELAY = 4    # sync release -> qclk zero (cocotb test_proc.py:17)
 MEAS_LATENCY = 64     # rdlo pulse end -> bit available (hwconfig FPROC_MEAS_CLKS)
 
@@ -86,7 +91,7 @@ class OracleCore:
     def __init__(self, n_regs: int = isa.N_REGS):
         self.pc = 0
         self.regs = [0] * n_regs
-        self.time = START_NCLKS
+        self.time = INIT_TIME
         self.offset = 0
         self.done = False
         self.err = []

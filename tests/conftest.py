@@ -13,6 +13,12 @@ if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
 
+# the environment's sitecustomize imports jax at interpreter start (with
+# JAX_PLATFORMS=axon already in the env), so the env var alone is locked
+# in; override through the config API before any backend initialises.
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np

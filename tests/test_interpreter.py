@@ -116,8 +116,8 @@ def test_conditional_loop():
     out = simulate(mp_of(cmds))
     assert int(out['regs'][0, 0]) == 0
     assert bool(out['done'][0])
-    # time: 5 + alu(5) + 5*(alu 5 + jump 5) = 60
-    assert int(out['time'][0]) == 60
+    # time: 2 + alu(5) + 5*(alu 5 + jump 5) = 57
+    assert int(out['time'][0]) == 57
 
 
 def test_inc_qclk_shifts_trigger():
@@ -191,9 +191,9 @@ def test_sync_barrier_aligns_cores():
         isa.done_cmd(),
     ]
     out = simulate(mp_of(core0, core1))
-    # core0 arrives at t=5+15=20; release 20+4=24; both fire at qclk 5
-    assert int(out['rec_gtime'][0, 0]) == 29
-    assert int(out['rec_gtime'][1, 0]) == 29
+    # core0 arrives at t=2+15=17; release 17+4=21; both fire at qclk 5
+    assert int(out['rec_gtime'][0, 0]) == 26
+    assert int(out['rec_gtime'][1, 0]) == 26
     assert int(out['rec_qtime'][0, 0]) == 5
     assert np.all(np.asarray(out['err']) == 0)
 
