@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Headline benchmark: 8-qubit active-reset + randomized-benchmarking
+sweep on one chip.
+
+Pipeline measured per batch (steady state, post-jit):
+
+  measurement-bit sampling -> batched ISA interpretation (per-shot
+  divergent control flow through the active-reset branch) -> IQ readout
+  model -> discrimination
+
+Prints ONE JSON line: shots/sec/chip, with vs_baseline relative to the
+north-star target of 1e6 shots in 60 s (BASELINE.md) — there is no
+reference number to compare against (the reference publishes none; it
+executes shots on FPGA hardware one at a time, host-sequenced).
+
+Env knobs: BENCH_SHOTS (total, default 131072), BENCH_BATCH (per-device
+batch, default 16384), BENCH_DEPTH (RB depth, default 12).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+from distributed_processor_tpu.pipeline import compile_to_machine
+from distributed_processor_tpu.models import (
+    active_reset, rb_program, make_default_qchip, sample_meas_bits,
+    IQReadoutModel)
+from distributed_processor_tpu.sim.interpreter import (
+    InterpreterConfig, _program_constants, _run)
+from distributed_processor_tpu.ops.demod import discriminate
+
+NORTH_STAR_SHOTS_PER_SEC = 1e6 / 60.0
+
+
+def build_machine_program(n_qubits: int, depth: int):
+    qubits = [f'Q{i}' for i in range(n_qubits)]
+    qchip = make_default_qchip(n_qubits)
+    program = active_reset(qubits) + rb_program(qubits, depth, seed=1234)
+    return compile_to_machine(program, qchip, n_qubits=n_qubits)
+
+
+def main():
+    n_qubits = int(os.environ.get('BENCH_QUBITS', 8))
+    depth = int(os.environ.get('BENCH_DEPTH', 12))
+    total_shots = int(os.environ.get('BENCH_SHOTS', 131072))
+    batch = int(os.environ.get('BENCH_BATCH', 16384))
+    batch = min(batch, total_shots)
+    n_batches = max(total_shots // batch, 1)
+    total_shots = batch * n_batches
+
+    t0 = time.perf_counter()
+    mp = build_machine_program(n_qubits, depth)
+    t_compile = time.perf_counter() - t0
+
+    n_instr = mp.n_instr
+    cfg = InterpreterConfig(
+        max_steps=n_instr + 16,
+        max_pulses=int(mp.max_pulses_per_core(1)) + 4,
+        max_meas=4, max_resets=2)
+    soa, spc, interp, sync_part = _program_constants(mp, cfg)
+    C = mp.n_cores
+
+    readout = IQReadoutModel(
+        centers0=np.full(C, 1.0 + 0.0j), centers1=np.full(C, -0.6 + 0.8j),
+        sigma=0.3)
+
+    @jax.jit
+    def step(key):
+        kb, ki = jax.random.split(key)
+        bits = sample_meas_bits(kb, jnp.full((C,), 0.15), batch, cfg.max_meas)
+        out = jax.vmap(lambda b: _run(soa, spc, interp, sync_part, b, cfg, C))(
+            bits)
+        # readout physics on the final measurement of each core
+        states = bits[:, :, 1]
+        iq = readout.sample_iq(ki, states)
+        final_bits = discriminate(iq, readout.c0, readout.c1)
+        return (jnp.sum(out['n_pulses'], axis=0),
+                jnp.sum(out['err']), jnp.sum(final_bits, axis=0),
+                jnp.max(out['steps']))
+
+    key = jax.random.PRNGKey(0)
+    # warm-up / compile
+    t0 = time.perf_counter()
+    res = jax.block_until_ready(step(key))
+    t_jit = time.perf_counter() - t0
+    err_total = int(res[1])
+
+    t0 = time.perf_counter()
+    for i in range(n_batches):
+        key, sub = jax.random.split(key)
+        res = step(sub)
+    res = jax.block_until_ready(res)
+    elapsed = time.perf_counter() - t0
+    err_total += int(res[1])
+
+    shots_per_sec = total_shots / elapsed
+    result = {
+        'metric': 'shots/sec/chip, 8q active-reset+RB sweep (sim+readout)',
+        'value': round(shots_per_sec, 1),
+        'unit': 'shots/s',
+        'vs_baseline': round(shots_per_sec / NORTH_STAR_SHOTS_PER_SEC, 3),
+        'detail': {
+            'n_qubits': n_qubits, 'rb_depth': depth,
+            'total_shots': total_shots, 'batch': batch,
+            'n_instr': n_instr, 'interp_steps': int(res[3]),
+            'compile_s': round(t_compile, 3), 'jit_s': round(t_jit, 3),
+            'run_s': round(elapsed, 3), 'err_shots': err_total,
+            'platform': jax.devices()[0].platform,
+            'device': str(jax.devices()[0]),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    main()

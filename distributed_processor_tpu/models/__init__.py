@@ -3,3 +3,4 @@ from .experiments import (active_reset, rabi_program, t1_program,
                           ramsey_program, loop_shots_program)
 from .rb import rb_program, rb_sequence, clifford_table
 from .readout import sample_meas_bits, apply_assignment_error, IQReadoutModel
+from .default_qchip import make_default_qchip, make_default_qchip_dict

@@ -1,0 +1,47 @@
+"""Built-in N-qubit calibration: a self-contained QChip gate library.
+
+The reference requires an external calibration JSON (the out-of-repo
+``qubitconfig`` package's qubitcfg.json); this generates an equivalent
+library programmatically — per-qubit X90 (DRAG), Z90 (virtual), read
+(flat-top rdrv + square rdlo) — so benchmarks and demos run without any
+external files.  Schema matches :class:`~..qchip.QChip`.
+"""
+
+from __future__ import annotations
+
+from ..qchip import QChip
+
+
+def make_default_qchip_dict(n_qubits: int = 8) -> dict:
+    qubits, gates = {}, {}
+    for i in range(n_qubits):
+        q = f'Q{i}'
+        qubits[q] = {
+            'freq': 4.2e9 + 0.11e9 * i,
+            'freq_ef': 4.0e9 + 0.11e9 * i,
+            'readfreq': 6.4e9 + 0.08e9 * i,
+        }
+        gates[q + 'X90'] = [{
+            'dest': q + '.qdrv', 'freq': q + '.freq', 'phase': 0.0,
+            'amp': 0.48, 't0': 0.0, 'twidth': 24e-9,
+            'env': {'env_func': 'DRAG',
+                    'paradict': {'alpha': 0.4, 'sigmas': 3,
+                                 'delta': -270e6}},
+        }]
+        gates[q + 'Z90'] = [{'gate': 'virtualz', 'freq': q + '.freq',
+                             'phase': 1.5707963267948966}]
+        gates[q + 'read'] = [
+            {'dest': q + '.rdrv', 'freq': q + '.readfreq', 'phase': 0.0,
+             'amp': 0.25, 't0': 0.0, 'twidth': 512e-9,
+             'env': {'env_func': 'cos_edge_square',
+                     'paradict': {'ramp_fraction': 0.25}}},
+            {'dest': q + '.rdlo', 'freq': q + '.readfreq', 'phase': 0.0,
+             'amp': 1.0, 't0': 0.0, 'twidth': 512e-9,
+             'env': {'env_func': 'square', 'paradict': {'phase': 0.0,
+                                                        'amplitude': 1.0}}},
+        ]
+    return {'Qubits': qubits, 'Gates': gates}
+
+
+def make_default_qchip(n_qubits: int = 8) -> QChip:
+    return QChip(make_default_qchip_dict(n_qubits))
