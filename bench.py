@@ -34,7 +34,7 @@ from distributed_processor_tpu.models import (
     active_reset, rb_program, make_default_qchip, sample_meas_bits,
     IQReadoutModel)
 from distributed_processor_tpu.sim.interpreter import (
-    InterpreterConfig, _program_constants, _run)
+    InterpreterConfig, _program_constants, _run_batch)
 from distributed_processor_tpu.ops.demod import discriminate
 
 NORTH_STAR_SHOTS_PER_SEC = 1e6 / 60.0
@@ -76,15 +76,14 @@ def main():
     def step(key):
         kb, ki = jax.random.split(key)
         bits = sample_meas_bits(kb, jnp.full((C,), 0.15), batch, cfg.max_meas)
-        out = jax.vmap(lambda b: _run(soa, spc, interp, sync_part, b, cfg, C))(
-            bits)
+        out = _run_batch(soa, spc, interp, sync_part, bits, cfg, C)
         # readout physics on the final measurement of each core
         states = bits[:, :, 1]
         iq = readout.sample_iq(ki, states)
         final_bits = discriminate(iq, readout.c0, readout.c1)
         return (jnp.sum(out['n_pulses'], axis=0),
                 jnp.sum(out['err']), jnp.sum(final_bits, axis=0),
-                jnp.max(out['steps']))
+                out['steps'])
 
     key = jax.random.PRNGKey(0)
     # warm-up / compile

@@ -19,8 +19,8 @@ try:
 except ImportError:      # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
-from ..sim.interpreter import (InterpreterConfig, _program_constants, _run,
-                               _pad_meas)
+from ..sim.interpreter import (InterpreterConfig, _program_constants,
+                               _run_batch, _pad_meas)
 
 
 def sharded_simulate(mp, meas_bits, mesh, init_regs=None,
@@ -37,9 +37,12 @@ def sharded_simulate(mp, meas_bits, mesh, init_regs=None,
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)
 
     def local(mb, ir):
-        run = lambda b, r: _run(soa, spc, interp, sync_part, b, cfg,
-                                mp.n_cores, r)
-        return jax.vmap(run)(mb, ir)
+        out = _run_batch(soa, spc, interp, sync_part, mb, cfg,
+                         mp.n_cores, ir)
+        # drop scalar diagnostics: every remaining leaf is shot-leading
+        out.pop('steps')
+        out.pop('incomplete')
+        return out
 
     if init_regs is None:
         init_regs = jnp.zeros((meas_bits.shape[0], mp.n_cores, 16),
@@ -67,8 +70,7 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
     n_shots = meas_bits.shape[0]
 
     def local(mb):
-        run = lambda b: _run(soa, spc, interp, sync_part, b, cfg, mp.n_cores)
-        out = jax.vmap(run)(mb)
+        out = _run_batch(soa, spc, interp, sync_part, mb, cfg, mp.n_cores)
         pulse_sum = jnp.sum(out['n_pulses'], axis=0)      # [n_cores]
         err_shots = jnp.sum(jnp.any(out['err'] != 0, axis=1))
         qclk_sum = jnp.sum(out['qclk'], axis=0)

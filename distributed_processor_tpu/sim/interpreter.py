@@ -3,13 +3,26 @@
 This is the TPU-native replacement for the reference's per-qubit RTL
 cores (reference: hdl/proc.sv + hdl/ctrl.v): instead of N soft CPUs
 stepping an FSM, every core of every shot advances one *instruction* per
-``lax.while_loop`` iteration, with all per-core state held in int32
-arrays shaped ``[n_cores, ...]`` (``vmap`` adds the shot axis).  Cross-
-core coupling — the sync barrier and the measurement (fproc) fabric — is
-computed with masked reductions over the core axis each step, which is
-the lockstep-convergence equivalent of the reference's `sync_iface` /
-`fproc_iface` wiring (reference: hdl/sync_iface.sv, hdl/fproc_meas.sv,
-hdl/core_state_mgr.sv).
+``lax.while_loop`` iteration, with all machine state held in int32
+arrays shaped ``[n_shots, n_cores, ...]``.  Cross-core coupling — the
+sync barrier and the measurement (fproc) fabric — is computed with
+masked reductions over the core axis each step, the lockstep-convergence
+equivalent of the reference's `sync_iface` / `fproc_iface` wiring
+(reference: hdl/sync_iface.sv, hdl/fproc_meas.sv, hdl/core_state_mgr.sv).
+
+TPU-shaped implementation choices (these are what make it fast):
+
+* **No per-lane gathers.**  Dynamic indexing (program fetch by pc,
+  register-file reads/writes, fproc producer selection) is done with
+  one-hot multiply-reduce over the small static axis instead of
+  ``take_along_axis`` — per-lane dynamic gathers serialise on the VPU,
+  one-hot select vectorises (measured ~3x on v5e for the fetch alone).
+* **The loop is outermost, not vmapped.**  State is batch-first, so the
+  step counter stays a scalar and pulse records can be written with
+  ``dynamic_update_slice`` at the step index (cheap contiguous slice
+  update) instead of a scatter; records are compacted to pulse-slot
+  order once at the end with an MXU batch-matmul against the slot
+  one-hot (record fields are split to 16-bit halves so float32 is exact).
 
 Timing semantics match :mod:`.oracle` (the scalar golden model) exactly;
 see that module's docstring for the contract.  The instruction-cost
@@ -52,6 +65,20 @@ ERR_FPROC_ID = 32        # fproc func_id out of range
 _PMASKS = np.array([0xffffff, 0x1ffff, 0x1ff, 0xffff, 0xf], dtype=np.int32)
 # field order matches isa.PULSE_PARAM_ORDER = (env, phase, freq, amp, cfg)
 
+# gather order for the packed [n_cores, n_instr, F] program table
+_FIELDS = ('kind', 'alu_op', 'in0_is_reg', 'imm', 'in0_reg', 'in1_reg',
+           'out_reg', 'jump_addr', 'func_id', 'cmd_time',
+           'p_env', 'p_phase', 'p_freq', 'p_amp', 'p_cfg',
+           'p_wen', 'p_regsel', 'p_reg')
+_F = {name: i for i, name in enumerate(_FIELDS)}
+
+# step-record layout: 32-bit times split into 16-bit halves so the
+# compaction matmul is exact in float32
+_REC_STEP_FIELDS = ('qtime_lo', 'qtime_hi', 'gtime_lo', 'gtime_hi',
+                    'env', 'phase', 'freq', 'amp', 'cfg', 'elem', 'dur')
+_REC_FIELDS = ('qtime', 'gtime', 'env', 'phase', 'freq', 'amp', 'cfg',
+               'elem', 'dur')
+
 
 @dataclass(frozen=True)
 class InterpreterConfig:
@@ -78,6 +105,16 @@ class InterpreterConfig:
                    pulse_load_clks=fpga_config.pulse_load_clks, **kw)
 
 
+def _onehot(idx, n: int) -> jnp.ndarray:
+    """``[...] -> [..., n]`` int32 one-hot (TPU-friendly select mask)."""
+    return (idx[..., None] == jnp.arange(n, dtype=jnp.int32)).astype(jnp.int32)
+
+
+def _ohsel(table, oh):
+    """Select ``table[..., k]`` by a one-hot mask: multiply + reduce."""
+    return jnp.sum(table * oh, axis=-1)
+
+
 def _alu_vec(op, in0, in1):
     """Vectorised 8-op ALU on int32 lanes (reference: hdl/alu.v:31-51)."""
     return jnp.select(
@@ -90,11 +127,8 @@ def _alu_vec(op, in0, in1):
 
 def _program_constants(mp, cfg: InterpreterConfig):
     """Host-side: freeze the decoded program into device constants."""
-    soa = {f: jnp.asarray(getattr(mp.soa, f)) for f in (
-        'kind', 'alu_op', 'in0_is_reg', 'imm', 'in0_reg', 'in1_reg', 'out_reg',
-        'jump_addr', 'func_id', 'cmd_time',
-        'p_env', 'p_phase', 'p_freq', 'p_amp', 'p_cfg',
-        'p_wen', 'p_regsel', 'p_reg')}
+    soa = jnp.asarray(np.stack(
+        [np.asarray(getattr(mp.soa, f)) for f in _FIELDS], axis=-1))
     n_cores = mp.n_cores
     max_elems = max((len(t.elem_cfgs) for t in mp.tables), default=0) or 1
     spc = np.ones((n_cores, max_elems), dtype=np.int32)
@@ -107,64 +141,84 @@ def _program_constants(mp, cfg: InterpreterConfig):
         jnp.asarray(mp.sync_participants)
 
 
-def _init_state(n_cores: int, cfg: InterpreterConfig,
+def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
                 init_regs=None) -> dict:
-    C, P, M, R = n_cores, cfg.max_pulses, cfg.max_meas, cfg.max_resets
+    B, C = batch, n_cores
+    T, M, R = cfg.max_steps, cfg.max_meas, cfg.max_resets
     z = lambda *s: jnp.zeros(s, dtype=jnp.int32)
-    regs = z(C, isa.N_REGS) if init_regs is None \
-        else jnp.asarray(init_regs, jnp.int32)
+    if init_regs is None:
+        regs = z(B, C, isa.N_REGS)
+    else:
+        regs = jnp.broadcast_to(
+            jnp.asarray(init_regs, jnp.int32), (B, C, isa.N_REGS))
     return dict(
-        pc=z(C), regs=regs,
-        time=jnp.full((C,), INIT_TIME, jnp.int32), offset=z(C),
-        done=jnp.zeros((C,), bool), err=z(C), pp=z(C, 5),
-        n_pulses=z(C),
-        rec_qtime=z(C, P), rec_gtime=z(C, P), rec_env=z(C, P),
-        rec_phase=z(C, P), rec_freq=z(C, P), rec_amp=z(C, P),
-        rec_cfg=z(C, P), rec_elem=z(C, P), rec_dur=z(C, P),
-        n_resets=z(C), rst_time=z(C, R),
-        n_meas=z(C), meas_avail=jnp.full((C, M), INT32_MAX, jnp.int32),
+        pc=z(B, C), regs=regs,
+        time=jnp.full((B, C), INIT_TIME, jnp.int32), offset=z(B, C),
+        done=jnp.zeros((B, C), bool), err=z(B, C), pp=z(B, C, 5),
+        n_pulses=z(B, C),
+        rec=z(B, C, T, len(_REC_STEP_FIELDS)),
+        rec_fire=z(B, C, T), rec_slot=z(B, C, T),
+        n_resets=z(B, C), rst_time=z(B, C, R),
+        n_meas=z(B, C),
+        meas_avail=jnp.full((B, C, M), INT32_MAX, jnp.int32),
     )
 
 
-def _step(st: dict, soa: dict, spc, interp, sync_part, meas_bits,
+def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
           cfg: InterpreterConfig) -> dict:
-    C = st['pc'].shape[0]
-    cidx = jnp.arange(C)
-    pc = jnp.clip(st['pc'], 0, soa['kind'].shape[1] - 1)
-    g = lambda f: soa[f][cidx, pc]
-    kind = g('kind')
-    live = ~st['done']
+    B, C = st['pc'].shape
+    N = soa.shape[1]
     time, offset, regs = st['time'], st['offset'], st['regs']
 
+    # ---- program fetch: one one-hot over the instruction axis ----------
+    oh_pc = _onehot(jnp.clip(st['pc'], 0, N - 1), N)          # [B, C, N]
+    fetched = {f: jnp.sum(soa[None, :, :, _F[f]] * oh_pc, axis=-1)
+               for f in _FIELDS}                               # each [B, C]
+    g = lambda f: fetched[f]
+    kind = g('kind')
+    live = ~st['done']
+
+    def reg_read(idx):
+        return _ohsel(regs, _onehot(idx, isa.N_REGS))
+
     # ---- operand fetch -------------------------------------------------
-    in0 = jnp.where(g('in0_is_reg') == 1, regs[cidx, g('in0_reg')], g('imm'))
+    in0 = jnp.where(g('in0_is_reg') == 1, reg_read(g('in0_reg')), g('imm'))
     qclk = time - offset
     is_fproc = (kind == isa.K_ALU_FPROC) | (kind == isa.K_JUMP_FPROC)
 
     # ---- fproc fabric (reference: hdl/fproc_meas.sv / core_state_mgr.sv)
     fid = g('func_id')
     fid_bad = fid >= C
-    prod = jnp.clip(fid, 0, C - 1)
+    oh_prod = _onehot(jnp.clip(fid, 0, C - 1), C)              # [B, C, C']
+    sel_core = lambda arr: _ohsel(arr[:, None, :], oh_prod)    # [B,C'] -> [B,C]
+    # [B, C', M] -> [B, C, M] (producer row per reader)
+    sel_core_m = lambda arr: jnp.sum(
+        arr[:, None, :, :] * oh_prod[..., None], axis=2)
     req = time
-    mavail_p = st['meas_avail'][prod]                       # [C, M]
-    nmeas_p = st['n_meas'][prod]
-    prod_done = st['done'][prod]
+    mavail_p = sel_core_m(st['meas_avail'])
+    bits_p = sel_core_m(meas_bits)
+    nmeas_p = sel_core(st['n_meas'])
+    prod_done = sel_core(st['done'].astype(jnp.int32)) == 1
     if cfg.fabric == 'sticky':
         # bit latched at read time; producer must have simulated past `req`
-        f_ready = prod_done | (st['time'][prod] >= req)
-        m_cnt = jnp.sum(mavail_p <= req[:, None], axis=1)
-        f_data = jnp.where(m_cnt > 0,
-                           meas_bits[prod, jnp.maximum(m_cnt - 1, 0)], 0)
+        f_ready = prod_done | (sel_core(time) >= req)
+        m_cnt = jnp.sum((mavail_p <= req[..., None]).astype(jnp.int32), -1)
+        f_data = jnp.where(
+            m_cnt > 0,
+            _ohsel(bits_p, _onehot(jnp.maximum(m_cnt - 1, 0), cfg.max_meas)),
+            0)
         f_tready = req
-        f_deadlock = jnp.zeros((C,), bool)
+        f_deadlock = jnp.zeros((B, C), bool)
     else:
         # fresh: first measurement completing strictly after the request
-        fresh = (mavail_p > req[:, None]) & \
-            (jnp.arange(cfg.max_meas)[None, :] < nmeas_p[:, None])
-        exists = jnp.any(fresh, axis=1)
-        j = jnp.argmax(fresh, axis=1)
-        f_data = jnp.where(exists, meas_bits[prod, j], 0)
-        f_tready = jnp.where(exists, jnp.maximum(req, mavail_p[cidx, j]), req)
+        fresh = (mavail_p > req[..., None]) & \
+            (jnp.arange(cfg.max_meas)[None, None, :] < nmeas_p[..., None])
+        exists = jnp.any(fresh, axis=-1)
+        oh_j = _onehot(jnp.argmax(fresh, axis=-1).astype(jnp.int32),
+                       cfg.max_meas)
+        f_data = jnp.where(exists, _ohsel(bits_p, oh_j), 0)
+        f_tready = jnp.where(exists,
+                             jnp.maximum(req, _ohsel(mavail_p, oh_j)), req)
         f_deadlock = ~exists & prod_done
         f_ready = exists | f_deadlock
     f_ready = f_ready | fid_bad
@@ -173,19 +227,20 @@ def _step(st: dict, soa: dict, spc, interp, sync_part, meas_bits,
     # ---- ALU (in1 mux per reference: hdl/proc.sv:111) ------------------
     in1 = jnp.where(is_fproc, f_data,
                     jnp.where(kind == isa.K_INC_QCLK, qclk,
-                              regs[cidx, g('in1_reg')]))
+                              reg_read(g('in1_reg'))))
     alu_res = _alu_vec(g('alu_op'), in0, in1)
 
     # ---- sync barrier (reference: ctrl.v:510-552 + qclk reset) ---------
     at_sync = live & (kind == isa.K_SYNC)
-    live_part = sync_part & live
-    sync_ready = jnp.any(at_sync) & jnp.all(~live_part | at_sync)
-    release = jnp.max(jnp.where(at_sync, time, -INT32_MAX)) + QCLK_RST_DELAY
-    sync_adv = at_sync & sync_ready
-    sync_err = sync_ready & jnp.any(sync_part & st['done'])
+    live_part = sync_part[None, :] & live
+    sync_ready = jnp.any(at_sync, -1) & jnp.all(~live_part | at_sync, -1)
+    release = jnp.max(jnp.where(at_sync, time, -INT32_MAX),
+                      axis=-1, keepdims=True) + QCLK_RST_DELAY      # [B, 1]
+    sync_adv = at_sync & sync_ready[:, None]
+    sync_err = sync_ready & jnp.any(sync_part[None, :] & st['done'], -1)
 
     # ---- stall mask ----------------------------------------------------
-    stalled = (is_fproc & ~f_ready) | (at_sync & ~sync_ready)
+    stalled = (is_fproc & ~f_ready) | (at_sync & ~sync_ready[:, None])
     adv = live & ~stalled                     # cores executing this step
 
     # ---- pulse-register latch + trigger --------------------------------
@@ -193,53 +248,60 @@ def _step(st: dict, soa: dict, spc, interp, sync_part, meas_bits,
     is_pt = kind == isa.K_PULSE_TRIG
     is_pulse = (is_pw | is_pt) & adv
     imm_vals = jnp.stack([g('p_env'), g('p_phase'), g('p_freq'),
-                          g('p_amp'), g('p_cfg')], axis=1)       # [C, 5]
-    wen = (g('p_wen')[:, None] >> jnp.arange(5)[None, :]) & 1
-    rsel = (g('p_regsel')[:, None] >> jnp.arange(5)[None, :]) & 1
-    regval = regs[cidx, g('p_reg')]
-    cand = jnp.where(rsel == 1, regval[:, None], imm_vals) & _PMASKS[None, :]
-    pp = jnp.where(is_pulse[:, None] & (wen == 1), cand, st['pp'])
+                          g('p_amp'), g('p_cfg')], axis=-1)      # [B, C, 5]
+    wen = (g('p_wen')[..., None] >> jnp.arange(5)) & 1
+    rsel = (g('p_regsel')[..., None] >> jnp.arange(5)) & 1
+    regval = reg_read(g('p_reg'))
+    cand = jnp.where(rsel == 1, regval[..., None], imm_vals) \
+        & jnp.asarray(_PMASKS)
+    pp = jnp.where(is_pulse[..., None] & (wen == 1), cand, st['pp'])
 
     cmd_time = g('cmd_time')                  # uint32 bit pattern
     trig = offset + cmd_time
     missed_trig = is_pt & adv & (trig < time)
     trig = jnp.maximum(trig, time)
-    elem = pp[:, 4] & 0b11
-    elem_c = jnp.minimum(elem, spc.shape[1] - 1)
-    envw = pp[:, 0]
+    elem = pp[..., 4] & 0b11
+    oh_elem = _onehot(jnp.minimum(elem, spc.shape[1] - 1), spc.shape[1])
+    spc_e = _ohsel(spc[None], oh_elem)
+    interp_e = _ohsel(interp[None], oh_elem)
+    envw = pp[..., 0]
     env_len = (envw >> 12) & 0xfff
-    nsamp = env_len * 4 * interp[cidx, elem_c]
-    dur = jnp.where(env_len == 0xfff, 0,
-                    (nsamp + spc[cidx, elem_c] - 1) // spc[cidx, elem_c])
+    nsamp = env_len * 4 * interp_e
+    dur = jnp.where(env_len == 0xfff, 0, (nsamp + spc_e - 1) // spc_e)
 
+    # ---- pulse record: step-indexed slice write (compacted post-loop) --
     fire = is_pt & adv
-    slot = jnp.minimum(st['n_pulses'], cfg.max_pulses - 1)
     rec_of = jnp.where(fire & (st['n_pulses'] >= cfg.max_pulses),
                        ERR_PULSE_OVERFLOW, 0)
-    new_rec = {}
-    for name, val in (('qtime', cmd_time), ('gtime', trig),
-                      ('env', pp[:, 0]), ('phase', pp[:, 1]),
-                      ('freq', pp[:, 2]), ('amp', pp[:, 3]),
-                      ('cfg', pp[:, 4]), ('elem', elem), ('dur', dur)):
-        arr = st['rec_' + name]
-        new_rec['rec_' + name] = arr.at[cidx, slot].set(
-            jnp.where(fire, val, arr[cidx, slot]))
+    rec_vals = jnp.stack(
+        [cmd_time & 0xffff, (cmd_time >> 16) & 0xffff,
+         trig & 0xffff, (trig >> 16) & 0xffff,
+         pp[..., 0], pp[..., 1], pp[..., 2], pp[..., 3], pp[..., 4],
+         elem, dur], axis=-1)                                    # [B, C, 11]
+    rec = jax.lax.dynamic_update_slice(
+        st['rec'], rec_vals[:, :, None, :], (0, 0, step_i, 0))
+    rec_fire = jax.lax.dynamic_update_slice(
+        st['rec_fire'], fire.astype(jnp.int32)[:, :, None], (0, 0, step_i))
+    rec_slot = jax.lax.dynamic_update_slice(
+        st['rec_slot'], st['n_pulses'][:, :, None], (0, 0, step_i))
     n_pulses = st['n_pulses'] + fire.astype(jnp.int32)
 
     is_meas_pulse = fire & (elem == cfg.meas_elem)
-    mslot = jnp.minimum(st['n_meas'], cfg.max_meas - 1)
     meas_of = jnp.where(is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
                         ERR_MEAS_OVERFLOW, 0)
-    meas_avail = st['meas_avail'].at[cidx, mslot].set(
-        jnp.where(is_meas_pulse, trig + dur + cfg.meas_latency,
-                  st['meas_avail'][cidx, mslot]))
+    oh_mslot = _onehot(jnp.minimum(st['n_meas'], cfg.max_meas - 1),
+                       cfg.max_meas)
+    meas_avail = jnp.where(
+        (oh_mslot == 1) & is_meas_pulse[..., None],
+        (trig + dur + cfg.meas_latency)[..., None], st['meas_avail'])
     n_meas = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
 
     # ---- phase reset record --------------------------------------------
     is_rst = (kind == isa.K_PULSE_RESET) & adv
-    rslot = jnp.minimum(st['n_resets'], cfg.max_resets - 1)
-    rst_time = st['rst_time'].at[cidx, rslot].set(
-        jnp.where(is_rst, time, st['rst_time'][cidx, rslot]))
+    oh_rslot = _onehot(jnp.minimum(st['n_resets'], cfg.max_resets - 1),
+                       cfg.max_resets)
+    rst_time = jnp.where((oh_rslot == 1) & is_rst[..., None],
+                         time[..., None], st['rst_time'])
     n_resets = st['n_resets'] + is_rst.astype(jnp.int32)
 
     # ---- idle ----------------------------------------------------------
@@ -250,9 +312,8 @@ def _step(st: dict, soa: dict, spc, interp, sync_part, meas_bits,
 
     # ---- register writeback --------------------------------------------
     wr_reg = ((kind == isa.K_REG_ALU) | (kind == isa.K_ALU_FPROC)) & adv
-    out_reg = g('out_reg')
-    regs = regs.at[cidx, out_reg].set(
-        jnp.where(wr_reg, alu_res, regs[cidx, out_reg]))
+    wr_mask = (_onehot(g('out_reg'), isa.N_REGS) == 1) & wr_reg[..., None]
+    regs = jnp.where(wr_mask, alu_res[..., None], regs)
 
     # ---- next pc -------------------------------------------------------
     branch_taken = (alu_res & 1) == 1
@@ -292,17 +353,40 @@ def _step(st: dict, soa: dict, spc, interp, sync_part, meas_bits,
         | jnp.where(missed_trig | missed_idle, ERR_MISSED_TRIG, 0) \
         | jnp.where(is_fproc & adv & fid_bad, ERR_FPROC_ID, 0) \
         | jnp.where(is_fproc & adv & f_deadlock, ERR_FPROC_DEADLOCK, 0) \
-        | jnp.where(sync_adv & sync_err, ERR_SYNC_DONE, 0)
+        | jnp.where(sync_adv & sync_err[:, None], ERR_SYNC_DONE, 0)
 
     return dict(st, pc=pc_next, regs=regs, time=time_next, offset=offset_next,
                 done=st['done'] | is_done, err=err, pp=pp, n_pulses=n_pulses,
+                rec=rec, rec_fire=rec_fire, rec_slot=rec_slot,
                 n_resets=n_resets, rst_time=rst_time,
-                n_meas=n_meas, meas_avail=meas_avail, **new_rec)
+                n_meas=n_meas, meas_avail=meas_avail)
 
 
-def _run(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
-         n_cores: int, init_regs=None) -> dict:
-    st0 = _init_state(n_cores, cfg, init_regs)
+def _compact_records(rec, rec_fire, rec_slot, max_pulses: int) -> dict:
+    """Compact step-indexed records to pulse-slot order.
+
+    One einsum per run: ``out[b,c,p,f] = sum_t fire*rec[b,c,t,f] *
+    onehot(slot)[b,c,t,p]`` — a batched MXU matmul, exact in float32
+    because every step-record field is < 2^16.
+    """
+    oh = ((rec_slot[..., None] == jnp.arange(max_pulses))
+          & (rec_fire[..., None] == 1))                         # [B,C,T,P]
+    vals = (rec * rec_fire[..., None]).astype(jnp.float32)
+    out = jnp.einsum('bctf,bctp->bcpf', vals, oh.astype(jnp.float32),
+                     preferred_element_type=jnp.float32).astype(jnp.int32)
+    lo = {n: out[..., i] for i, n in enumerate(_REC_STEP_FIELDS)}
+    rec_out = {'rec_qtime': lo['qtime_lo'] | (lo['qtime_hi'] << 16),
+               'rec_gtime': lo['gtime_lo'] | (lo['gtime_hi'] << 16)}
+    for n in ('env', 'phase', 'freq', 'amp', 'cfg', 'elem', 'dur'):
+        rec_out['rec_' + n] = lo[n]
+    return rec_out
+
+
+def _run_batch(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
+               n_cores: int, init_regs=None) -> dict:
+    """Execute a shot batch: meas_bits ``[B, n_cores, max_meas]``."""
+    B = meas_bits.shape[0]
+    st0 = _init_state(B, n_cores, cfg, init_regs)
     st0['_steps'] = jnp.int32(0)
 
     def cond(st):
@@ -310,28 +394,48 @@ def _run(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
 
     def body(st):
         steps = st.pop('_steps')
-        # detect global deadlock: every live core stalled => no state change
-        st2 = _step(st, soa, spc, interp, sync_part, meas_bits, cfg)
-        same = jnp.all(jnp.array(
-            [jnp.all(st2[k] == st[k]) for k in ('pc', 'time', 'done')]))
-        st2['err'] = jnp.where(same & ~st2['done'],
+        st2 = _step(st, steps, soa, spc, interp, sync_part, meas_bits, cfg)
+        # global-deadlock detection per shot: no live core changed state
+        same = jnp.all((st2['pc'] == st['pc']) & (st2['time'] == st['time'])
+                       & (st2['done'] == st['done']), axis=-1)   # [B]
+        st2['err'] = jnp.where(same[:, None] & ~st2['done'],
                                st2['err'] | ERR_FPROC_DEADLOCK, st2['err'])
-        st2['done'] = st2['done'] | same
+        st2['done'] = st2['done'] | same[:, None]
         st2['_steps'] = steps + 1
         return st2
 
     st = jax.lax.while_loop(cond, body, st0)
     steps = st.pop('_steps')
+    st.update(_compact_records(st.pop('rec'), st.pop('rec_fire'),
+                               st.pop('rec_slot'), cfg.max_pulses))
     st['qclk'] = st['time'] - st['offset']
     st['steps'] = steps
     st['incomplete'] = ~jnp.all(st['done'])
     return st
 
 
+def _run(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
+         n_cores: int, init_regs=None) -> dict:
+    """Single-shot wrapper: meas_bits ``[n_cores, max_meas]``."""
+    if init_regs is not None:
+        init_regs = jnp.asarray(init_regs, jnp.int32)[None]
+    out = _run_batch(soa, spc, interp, sync_part, meas_bits[None], cfg,
+                     n_cores, init_regs)
+    return {k: (v if k in ('steps', 'incomplete') else v[0])
+            for k, v in out.items()}
+
+
 @functools.partial(jax.jit, static_argnames=('cfg', 'n_cores'))
 def _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, n_cores, init_regs):
     return _run(soa, spc, interp, sync_part, meas_bits, cfg, n_cores,
                 init_regs)
+
+
+@functools.partial(jax.jit, static_argnames=('cfg', 'n_cores'))
+def _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg, n_cores,
+                   init_regs):
+    return _run_batch(soa, spc, interp, sync_part, meas_bits, cfg, n_cores,
+                      init_regs)
 
 
 def _pad_meas(meas_bits, max_meas: int):
@@ -371,18 +475,18 @@ def simulate(mp, meas_bits=None, init_regs=None,
 
 def simulate_batch(mp, meas_bits, init_regs=None,
                    cfg: InterpreterConfig = None, **kw) -> dict:
-    """vmap :func:`simulate` over a leading shot axis of ``meas_bits``
+    """Batch :func:`simulate` over a leading shot axis of ``meas_bits``
     (``[n_shots, n_cores, n_meas]``) — the reference re-runs shots on the
-    host; here shots are a vectorised batch axis on the accelerator.
-    ``init_regs`` may also carry a leading shot/sweep-point axis."""
+    host; here shots are the leading axis of every state array on the
+    accelerator.  ``init_regs`` may also carry the shot/sweep-point axis."""
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)
-    if init_regs is None:
-        fn = jax.jit(jax.vmap(lambda mb: _run(
-            soa, spc, interp, sync_part, mb, cfg, mp.n_cores)))
-        return fn(meas_bits)
-    init_regs = jnp.asarray(init_regs, jnp.int32)
-    fn = jax.jit(jax.vmap(lambda mb, ir: _run(
-        soa, spc, interp, sync_part, mb, cfg, mp.n_cores, ir)))
-    return fn(meas_bits, init_regs)
+    init_regs = jnp.zeros((mp.n_cores, isa.N_REGS), jnp.int32) \
+        if init_regs is None else jnp.asarray(init_regs, jnp.int32)
+    if init_regs.ndim == 2:
+        init_regs = jnp.broadcast_to(
+            init_regs[None],
+            (meas_bits.shape[0],) + tuple(init_regs.shape))
+    return _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
+                          mp.n_cores, init_regs)
