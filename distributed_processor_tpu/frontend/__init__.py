@@ -1,0 +1,2 @@
+from .visitor import qasm_to_program, QASMTranslator
+from .gate_map import GateMap, DefaultGateMap, QubitMap, DefaultQubitMap

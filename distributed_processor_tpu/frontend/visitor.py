@@ -1,0 +1,229 @@
+"""OpenQASM 3 -> native program translation.
+
+Equivalent of the reference's ``QASMQubiCVisitor`` (reference:
+python/distproc/openqasm/visitor.py:41-149), driven by the built-in
+parser instead of the external ``openqasm3`` package:
+
+* qubit declarations map through a :class:`~.gate_map.QubitMap`;
+* gate calls map through a :class:`~.gate_map.GateMap`;
+* ``reset`` expands to the read + branch_fproc active-reset idiom
+  (reference: visitor.py:86-92);
+* ``c[i] = measure q[j]`` emits a read and records which qubit feeds
+  each classical bit, so later ``if (c[i] == v)`` branches become
+  measurement branches (``branch_fproc``) — the part the reference left
+  unfinished (visitor.py:113-119 "BranchingStatement unfinished");
+* classical declarations/assignments become declare/set_var/alu chains
+  with temporaries for nested expressions (reference: visitor.py:121-147).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import qasm_parser as qp
+from .gate_map import GateMap, DefaultGateMap, QubitMap, DefaultQubitMap
+
+_CMP_TO_ALU = {'==': 'eq', '<=': 'le', '>=': 'ge'}
+
+
+class QASMTranslationError(ValueError):
+    pass
+
+
+class QASMTranslator:
+    """Stateful translator: one instance per QASM program."""
+
+    def __init__(self, gate_map: GateMap = None, qubit_map: QubitMap = None):
+        self.gate_map = gate_map or DefaultGateMap()
+        self.qubit_map = qubit_map or DefaultQubitMap()
+        self.qubit_regs: dict[str, int] = {}     # register name -> size
+        self.bit_regs: dict[str, int] = {}
+        self.int_vars: set[str] = set()
+        self.bit_sources: dict[tuple, str] = {}  # (reg, idx) -> qubit name
+        self._tmp = 0
+
+    # -- public ----------------------------------------------------------
+
+    def translate(self, src: str) -> list[dict]:
+        stmts = qp.parse_qasm(src)
+        out = []
+        for s in stmts:
+            out.extend(self._stmt(s))
+        return out
+
+    # -- helpers ---------------------------------------------------------
+
+    @property
+    def all_qubits(self) -> list[str]:
+        return [self.qubit_map.get_hardware_qubit(reg, i)
+                for reg, size in self.qubit_regs.items()
+                for i in range(size)]
+
+    def _qubit(self, ref: qp.Ref) -> str:
+        if ref.name not in self.qubit_regs:
+            raise QASMTranslationError(f'{ref.name!r} is not a qubit register')
+        return self.qubit_map.get_hardware_qubit(ref.name, ref.index)
+
+    def _tmpvar(self) -> str:
+        self._tmp += 1
+        return f'_qasm_tmp{self._tmp}'
+
+    # -- statements ------------------------------------------------------
+
+    def _stmt(self, s) -> list[dict]:
+        if isinstance(s, qp.Decl):
+            return self._decl(s)
+        if isinstance(s, qp.GateCall):
+            qubits = [self._qubit(r) for r in s.operands]
+            params = [self._const_expr(p) for p in s.params]
+            return self.gate_map.get_qubic_gateinstr(s.name, qubits, params)
+        if isinstance(s, qp.Reset):
+            q = self._qubit(s.target)
+            return [{'name': 'read', 'qubit': [q]},
+                    {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+                     'func_id': f'{q}.meas', 'scope': [q],
+                     'true': [{'name': 'X90', 'qubit': [q]},
+                              {'name': 'X90', 'qubit': [q]}],
+                     'false': []}]
+        if isinstance(s, qp.Measure):
+            q = self._qubit(s.target)
+            if s.out is not None:
+                if s.out.name not in self.bit_regs:
+                    raise QASMTranslationError(
+                        f'{s.out.name!r} is not a bit register')
+                self.bit_sources[(s.out.name, s.out.index)] = q
+            return [{'name': 'read', 'qubit': [q]}]
+        if isinstance(s, qp.Barrier):
+            qubits = [self._qubit(r) for r in s.operands] or self.all_qubits
+            return [{'name': 'barrier', 'qubit': qubits}]
+        if isinstance(s, qp.Assign):
+            return self._assign(s)
+        if isinstance(s, qp.If):
+            return self._if(s)
+        raise QASMTranslationError(f'unsupported statement {s}')
+
+    def _decl(self, s: qp.Decl) -> list[dict]:
+        if s.kind == 'qubit':
+            self.qubit_regs[s.name] = s.size or 1
+            return []
+        if s.kind == 'bit':
+            self.bit_regs[s.name] = s.size or 1
+            return []
+        # classical int/float variable
+        self.int_vars.add(s.name)
+        out = [{'name': 'declare', 'var': s.name, 'dtype': 'int',
+                'scope': self.all_qubits}]
+        if s.init is not None:
+            pre, val = self._expr(s.init)
+            out.extend(pre)
+            out.append({'name': 'set_var', 'var': s.name, 'value': val})
+        return out
+
+    def _assign(self, s: qp.Assign) -> list[dict]:
+        if s.target.name not in self.int_vars:
+            raise QASMTranslationError(
+                f'{s.target.name!r} is not a declared variable')
+        pre, val = self._expr(s.expr)
+        if isinstance(val, str) or not pre:
+            # simple value or variable: set_var / alu-into-target
+            if pre and pre[-1].get('out') is not None:
+                pre[-1]['out'] = s.target.name
+                return pre
+            return pre + [{'name': 'set_var', 'var': s.target.name,
+                           'value': val}]
+        pre[-1]['out'] = s.target.name
+        return pre
+
+    def _if(self, s: qp.If) -> list[dict]:
+        if s.op not in _CMP_TO_ALU:
+            raise QASMTranslationError(
+                f'only ==/<=/>= conditions supported, got {s.op!r}')
+        cond = _CMP_TO_ALU[s.op]
+        true = [i for st in s.true for i in self._stmt(st)]
+        false = [i for st in s.false for i in self._stmt(st)]
+        lhs, rhs = s.lhs, s.rhs
+        # normalise: measured-bit or variable on the right
+        if isinstance(lhs, qp.Ref) and not isinstance(rhs, qp.Ref):
+            lhs, rhs = rhs, lhs
+        if not isinstance(rhs, qp.Ref):
+            raise QASMTranslationError('condition must involve a bit or var')
+        pre, lhs_val = ([], lhs) if not isinstance(lhs, (qp.Ref, qp.BinOp)) \
+            else self._expr(lhs)
+        key = (rhs.name, rhs.index)
+        if key in self.bit_sources:          # measurement branch
+            q = self.bit_sources[key]
+            return pre + [{'name': 'branch_fproc', 'alu_cond': cond,
+                           'cond_lhs': lhs_val, 'func_id': f'{q}.meas',
+                           'scope': self.all_qubits,
+                           'true': true, 'false': false}]
+        if rhs.name in self.int_vars:        # variable branch
+            return pre + [{'name': 'branch_var', 'alu_cond': cond,
+                           'cond_lhs': lhs_val, 'cond_rhs': rhs.name,
+                           'scope': self.all_qubits,
+                           'true': true, 'false': false}]
+        raise QASMTranslationError(
+            f'{rhs.name!r} is neither a measured bit nor a variable')
+
+    # -- expressions -----------------------------------------------------
+
+    def _const_expr(self, e) -> float:
+        """Fold a parameter expression to a number (pi supported)."""
+        if isinstance(e, (int, float)):
+            return e
+        if isinstance(e, qp.Ref):
+            if e.name in ('pi', 'π'):
+                return np.pi
+            if e.name in ('tau', 'τ'):
+                return 2 * np.pi
+            if e.name == 'euler':
+                return np.e
+            raise QASMTranslationError(
+                f'gate parameters must be constant, got {e.name!r}')
+        if isinstance(e, qp.BinOp):
+            a, b = self._const_expr(e.lhs), self._const_expr(e.rhs)
+            return {'+': a + b, '-': a - b, '*': a * b, '/': a / b,
+                    '%': a % b}[e.op]
+        raise QASMTranslationError(f'bad parameter expression {e}')
+
+    def _expr(self, e) -> tuple[list[dict], object]:
+        """Lower an expression to (instructions, value-or-varname) using
+        temporaries for nesting (reference: visitor.py:121-147)."""
+        if isinstance(e, (int, float)):
+            return [], int(e)
+        if isinstance(e, qp.Ref):
+            if e.name in self.int_vars:
+                return [], e.name
+            if e.name in ('pi', 'π'):
+                return [], np.pi
+            raise QASMTranslationError(f'unknown variable {e.name!r}')
+        if isinstance(e, qp.BinOp):
+            if e.op not in ('+', '-'):
+                raise QASMTranslationError(
+                    f'only +/- supported on variables, got {e.op!r}')
+            pre_l, lhs = self._expr(e.lhs)
+            pre_r, rhs = self._expr(e.rhs)
+            # the processor ALU computes lhs <op> rhs with rhs a register
+            if not isinstance(rhs, str):
+                if isinstance(lhs, str) and e.op == '+':
+                    lhs, rhs = rhs, lhs          # commute constant left
+                else:
+                    tmp = self._tmpvar()
+                    pre_r += [
+                        {'name': 'declare', 'var': tmp, 'dtype': 'int',
+                         'scope': self.all_qubits},
+                        {'name': 'set_var', 'var': tmp, 'value': rhs}]
+                    rhs = tmp
+            out = self._tmpvar()
+            instrs = pre_l + pre_r + [
+                {'name': 'declare', 'var': out, 'dtype': 'int',
+                 'scope': self.all_qubits},
+                {'name': 'alu', 'op': {'+': 'add', '-': 'sub'}[e.op],
+                 'lhs': lhs, 'rhs': rhs, 'out': out}]
+            return instrs, out
+        raise QASMTranslationError(f'bad expression {e}')
+
+
+def qasm_to_program(src: str, gate_map: GateMap = None,
+                    qubit_map: QubitMap = None) -> list[dict]:
+    """Translate OpenQASM 3 source to the native dict program format."""
+    return QASMTranslator(gate_map, qubit_map).translate(src)

@@ -40,6 +40,27 @@ def make_default_qchip_dict(n_qubits: int = 8) -> dict:
              'env': {'env_func': 'square', 'paradict': {'phase': 0.0,
                                                         'amplitude': 1.0}}},
         ]
+    # two-qubit gates for adjacent pairs: a cross-resonance-style CNOT
+    # (drive on the control at the target frequency + echo) and a CZ
+    for i in range(n_qubits - 1):
+        c, t = f'Q{i}', f'Q{i+1}'
+        cr = {'env_func': 'cos_edge_square', 'paradict': {'ramp_fraction': 0.3}}
+        gates[c + t + 'CNOT'] = [
+            {'gate': 'virtualz', 'freq': c + '.freq', 'phase': -1.5707963267948966},
+            {'dest': c + '.qdrv', 'freq': t + '.freq', 'phase': 0.0,
+             'amp': 0.35, 't0': 0.0, 'twidth': 120e-9, 'env': cr},
+            {'gate': c + 'X90', 't0': 120e-9},
+            {'dest': c + '.qdrv', 'freq': t + '.freq',
+             'phase': 3.141592653589793, 'amp': 0.35, 't0': 144e-9,
+             'twidth': 120e-9, 'env': cr},
+            {'gate': c + 'X90', 't0': 264e-9},
+        ]
+        gates[c + t + 'CZ'] = [
+            {'dest': c + '.qdrv', 'freq': c + '.freq_ef', 'phase': 0.0,
+             'amp': 0.42, 't0': 0.0, 'twidth': 80e-9, 'env': cr},
+            {'gate': 'virtualz', 'freq': c + '.freq', 'phase': 0.7853981633974483},
+            {'gate': 'virtualz', 'freq': t + '.freq', 'phase': 0.7853981633974483},
+        ]
     return {'Qubits': qubits, 'Gates': gates}
 
 

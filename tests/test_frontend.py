@@ -1,0 +1,141 @@
+"""OpenQASM 3 frontend tests: parser, gate mapping, translation, and
+QASM -> compile -> simulate end-to-end."""
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu.frontend import (qasm_to_program,
+                                                DefaultGateMap)
+from distributed_processor_tpu.frontend.qasm_parser import (parse_qasm,
+                                                            QASMSyntaxError)
+from distributed_processor_tpu.models import make_default_qchip
+from distributed_processor_tpu.pipeline import compile_to_machine
+from distributed_processor_tpu.sim import simulate
+
+
+def test_parser_basics():
+    stmts = parse_qasm('''
+        OPENQASM 3;
+        include "stdgates.inc";
+        qubit[2] q;
+        bit[2] c;
+        h q[0];
+        cx q[0], q[1];
+        rz(pi/2) q[1];
+        c[0] = measure q[0];
+        // a comment
+        reset q[1];
+    ''')
+    kinds = [type(s).__name__ for s in stmts]
+    assert kinds == ['Decl', 'Decl', 'GateCall', 'GateCall', 'GateCall',
+                     'Measure', 'Reset']
+
+
+def test_parser_rejects_garbage():
+    with pytest.raises(QASMSyntaxError):
+        parse_qasm('qubit[2 q;')
+
+
+def test_gate_map_decompositions():
+    gm = DefaultGateMap()
+    h = gm.get_qubic_gateinstr('h', ['Q0'], [])
+    assert [i['name'] for i in h] == ['virtual_z', 'X90', 'virtual_z']
+    x = gm.get_qubic_gateinstr('x', ['Q0'], [])
+    assert [i['name'] for i in x] == ['X90', 'X90']
+    rz = gm.get_qubic_gateinstr('rz', ['Q0'], [np.pi / 4])
+    assert rz == [{'name': 'virtual_z', 'qubit': ['Q0'],
+                   'phase': np.pi / 4}]
+    cx = gm.get_qubic_gateinstr('cx', ['Q0', 'Q1'], [])
+    assert cx == [{'name': 'CNOT', 'qubit': ['Q0', 'Q1']}]
+
+
+def test_gate_map_unitaries():
+    """Euler decompositions must reproduce the gate unitaries."""
+    gm = DefaultGateMap()
+    X90 = np.array([[1, -1j], [-1j, 1]]) / np.sqrt(2)
+
+    def u_of(instrs):
+        u = np.eye(2)
+        for i in instrs:
+            if i['name'] == 'X90':
+                u = X90 @ u
+            else:
+                p = i['phase']
+                u = np.diag([np.exp(-1j * p / 2), np.exp(1j * p / 2)]) @ u
+        return u
+
+    def proj_eq(a, b):
+        return abs(abs(np.trace(a.conj().T @ b)) - 2) < 1e-9
+
+    H = np.array([[1, 1], [1, -1]]) / np.sqrt(2)
+    Y = np.array([[0, -1j], [1j, 0]])
+    assert proj_eq(u_of(gm.get_qubic_gateinstr('h', ['Q0'], [])), H)
+    assert proj_eq(u_of(gm.get_qubic_gateinstr('y', ['Q0'], [])), Y)
+    theta = 1.23
+    RX = np.array([[np.cos(theta / 2), -1j * np.sin(theta / 2)],
+                   [-1j * np.sin(theta / 2), np.cos(theta / 2)]])
+    assert proj_eq(u_of(gm.get_qubic_gateinstr('rx', ['Q0'], [theta])), RX)
+    RY = np.array([[np.cos(theta / 2), -np.sin(theta / 2)],
+                   [np.sin(theta / 2), np.cos(theta / 2)]])
+    assert proj_eq(u_of(gm.get_qubic_gateinstr('ry', ['Q0'], [theta])), RY)
+
+
+def test_reset_expands_to_active_reset():
+    prog = qasm_to_program('qubit[1] q; reset q[0];')
+    assert prog[0] == {'name': 'read', 'qubit': ['Q0']}
+    assert prog[1]['name'] == 'branch_fproc'
+    assert prog[1]['func_id'] == 'Q0.meas'
+    assert [i['name'] for i in prog[1]['true']] == ['X90', 'X90']
+
+
+def test_measure_feeds_branch():
+    prog = qasm_to_program('''
+        qubit[2] q;
+        bit[1] c;
+        c[0] = measure q[0];
+        if (c[0] == 1) { x q[1]; }
+    ''')
+    assert prog[0] == {'name': 'read', 'qubit': ['Q0']}
+    br = prog[1]
+    assert br['name'] == 'branch_fproc' and br['func_id'] == 'Q0.meas'
+    assert [i['name'] for i in br['true']] == ['X90', 'X90']
+    assert br['false'] == []
+
+
+def test_classical_arithmetic():
+    prog = qasm_to_program('''
+        qubit[1] q;
+        int[32] a = 3;
+        int[32] b;
+        b = a + 2;
+        if (b >= 5) { x q[0]; }
+    ''')
+    names = [i['name'] for i in prog]
+    assert 'declare' in names and 'set_var' in names and 'alu' in names
+    alu = next(i for i in prog if i['name'] == 'alu')
+    assert alu['op'] == 'add' and alu['out'] == 'b'
+    assert prog[-1]['name'] == 'branch_var'
+    assert prog[-1]['cond_rhs'] == 'b'
+
+
+def test_qasm_end_to_end_simulation():
+    src = '''
+        OPENQASM 3;
+        qubit[2] q;
+        bit[2] c;
+        h q[0];
+        cx q[0], q[1];
+        barrier q[0], q[1];
+        c[0] = measure q[0];
+        c[1] = measure q[1];
+        if (c[0] == 1) { x q[0]; }
+    '''
+    program = qasm_to_program(src)
+    qchip = make_default_qchip(2)
+    mp = compile_to_machine(program, qchip, n_qubits=2)
+    out0 = simulate(mp, meas_bits=np.zeros((2, 4), int))
+    out1 = simulate(mp, meas_bits=np.ones((2, 4), int))
+    assert np.all(np.asarray(out0['err']) == 0)
+    assert np.all(np.asarray(out1['err']) == 0)
+    # measured-1 branch adds the two X90 flip pulses on core 0
+    assert int(out1['n_pulses'][0]) == int(out0['n_pulses'][0]) + 2
