@@ -1,0 +1,152 @@
+"""User-facing facade: compile, execute, and render programs.
+
+Ties the full stack together (the reference stops at FPGA BRAM bytes;
+everything past `GlobalAssembler` is the TPU backend this framework
+adds):
+
+    dict program / OpenQASM 3
+        -> Compiler (IR passes) -> GlobalAssembler -> decoder
+        -> JAX ISA interpreter (shots batched on device)
+        -> element waveform synthesis / readout demod (ops/)
+
+Example::
+
+    sim = Simulator(n_qubits=2)
+    out = sim.run('qubit[2] q; h q[0]; cx q[0], q[1];', shots=1024)
+    wf = sim.waveforms(out)          # per-core per-element I/Q traces
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from .hwconfig import FPGAConfig
+from .decoder import MachineProgram
+from .pipeline import compile_to_machine
+from .models.channels import make_channel_configs
+from .models.default_qchip import make_default_qchip
+from .sim.interpreter import InterpreterConfig, simulate, simulate_batch
+from .elements import IQ_SCALE
+from .ops.waveform import synthesize_element
+from .ops.demod import demod_iq, discriminate
+
+
+class Simulator:
+    """Compile-and-execute facade for N-qubit programs."""
+
+    def __init__(self, qchip=None, n_qubits: int = 8, channel_configs=None,
+                 fpga_config: FPGAConfig = None):
+        self.n_qubits = n_qubits
+        self.qchip = qchip or make_default_qchip(n_qubits)
+        self.channel_configs = channel_configs or make_channel_configs(n_qubits)
+        self.fpga_config = fpga_config or FPGAConfig()
+
+    # -- compilation -----------------------------------------------------
+
+    def compile(self, program) -> MachineProgram:
+        """Compile a dict program or OpenQASM 3 source string."""
+        if isinstance(program, str):
+            from .frontend import qasm_to_program
+            program = qasm_to_program(program)
+        return compile_to_machine(program, self.qchip,
+                                  channel_configs=self.channel_configs,
+                                  fpga_config=self.fpga_config)
+
+    def interpreter_config(self, mp: MachineProgram,
+                           **kw) -> InterpreterConfig:
+        """Sized-to-the-program interpreter config."""
+        defaults = dict(
+            max_steps=mp.n_instr + 16 if not kw.get('has_loops')
+            else 64 * mp.n_instr,
+            max_pulses=min(int(mp.max_pulses_per_core(64)) + 4, 4096),
+            max_meas=16, max_resets=4)
+        defaults.pop('has_loops', None)
+        kw.pop('has_loops', None)
+        defaults.update(kw)
+        return InterpreterConfig.from_fpga_config(self.fpga_config,
+                                                  **defaults)
+
+    # -- execution -------------------------------------------------------
+
+    def run(self, program, shots: int = 1, meas_bits=None, p1=None,
+            key=None, **cfg_kw) -> dict:
+        """Compile (if needed) and execute ``shots`` shots.
+
+        Measurement bits come from (in priority order) ``meas_bits``
+        (``[shots, n_cores, n_meas]``), or Bernoulli sampling with
+        per-qubit probabilities ``p1`` (needs ``key``), or zeros.
+        The result dict carries the machine program under ``'_mp'`` for
+        waveform rendering.
+        """
+        mp = program if isinstance(program, MachineProgram) \
+            else self.compile(program)
+        cfg = self.interpreter_config(mp, **cfg_kw)
+        if meas_bits is None and p1 is not None:
+            from .models.readout import sample_meas_bits
+            key = key if key is not None else jax.random.PRNGKey(0)
+            meas_bits = sample_meas_bits(
+                key, np.broadcast_to(np.asarray(p1, np.float32),
+                                     (mp.n_cores,)),
+                shots, cfg.max_meas)
+        if shots == 1 and (meas_bits is None or meas_bits.ndim == 2):
+            out = dict(simulate(mp, meas_bits=meas_bits, cfg=cfg))
+        else:
+            if meas_bits is None:
+                meas_bits = np.zeros((shots, mp.n_cores, cfg.max_meas), int)
+            out = dict(simulate_batch(mp, meas_bits, cfg=cfg))
+        out['_mp'] = mp
+        out['_cfg'] = cfg
+        return out
+
+    # -- rendering -------------------------------------------------------
+
+    def waveforms(self, out: dict, shot: int = None, n_clks: int = None,
+                  cores=None) -> dict:
+        """Render element output traces from a run's pulse records.
+
+        Returns ``{core_ind: [trace_elem0, trace_elem1, ...]}`` where each
+        trace is ``float32 [n_samples, 2]`` I/Q.  For batched runs pass
+        ``shot`` to select one shot.
+        """
+        mp: MachineProgram = out['_mp']
+        sel = (lambda a: np.asarray(a)) if shot is None \
+            else (lambda a: np.asarray(a)[shot])
+        n_pulses = sel(out['n_pulses'])
+        gtime, dur = sel(out['rec_gtime']), sel(out['rec_dur'])
+        if n_clks is None:
+            end = gtime + dur
+            n_clks = int(end.max()) + 8
+        result = {}
+        for c in (cores if cores is not None else range(mp.n_cores)):
+            tables = mp.tables[c]
+            traces = []
+            for e, ecfg in enumerate(tables.elem_cfgs):
+                freq_table = tables.freqs[e]['freq'] if e < len(tables.freqs) \
+                    else np.zeros(0)
+                freq_rel_table = np.concatenate(
+                    [np.asarray(freq_table) / ecfg.sample_freq, [0.0]])
+                rec_freq = sel(out['rec_freq'])[c]
+                rec = {
+                    'gtime': sel(out['rec_gtime'])[c],
+                    'env': sel(out['rec_env'])[c],
+                    'phase': sel(out['rec_phase'])[c],
+                    'amp': sel(out['rec_amp'])[c],
+                    'elem': sel(out['rec_elem'])[c],
+                    'freq_rel': freq_rel_table[
+                        np.clip(rec_freq, 0, len(freq_rel_table) - 1)],
+                    'n_pulses': n_pulses[c],
+                }
+                env_table = np.asarray(tables.envs[e]) / IQ_SCALE \
+                    if e < len(tables.envs) and len(tables.envs[e]) \
+                    else np.zeros(1, complex)
+                traces.append(np.asarray(synthesize_element(
+                    rec, env_table, spc=ecfg.samples_per_clk,
+                    interp=ecfg.interp_ratio, n_clks=n_clks, elem=e)))
+            result[c] = traces
+        return result
+
+    def demod_readout(self, out: dict, adc_traces, windows) -> np.ndarray:
+        """Demodulate external ADC traces against per-measurement windows
+        (``[n_samples, 2M]`` weight matrix) — see :mod:`.ops.demod`."""
+        return demod_iq(adc_traces, windows)
