@@ -1,0 +1,103 @@
+"""Native (C++) host-runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA; the runtime *around* it — here the
+command-buffer codec at the FPGA-BRAM boundary — is native, compiled
+on first use with the system toolchain and cached next to the package.
+Every entry point has a pure-Python fallback (the :mod:`..isa` codec),
+and bit-exactness between the two is covered by tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, 'soa_codec.cpp')
+_LIB = os.path.join(_HERE, 'libsoacodec.so')
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+N_FIELDS = 19
+CMD_BYTES = 16
+
+
+def _build() -> bool:
+    cmd = ['g++', '-O2', '-shared', '-fPIC', '-o', _LIB + '.tmp', _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_LIB + '.tmp', _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def get_lib():
+    """ctypes handle to the codec library, or None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or \
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.soa_decode.restype = ctypes.c_int
+        lib.soa_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int32, flags='C_CONTIGUOUS')]
+        lib.encode_pulse_batch.restype = None
+        lib.encode_pulse_batch.argtypes = [
+            np.ctypeslib.ndpointer(np.int32, flags='C_CONTIGUOUS')] * 6 + [
+            ctypes.c_int,
+            np.ctypeslib.ndpointer(np.uint8, flags='C_CONTIGUOUS')]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def decode_soa_fields(buf: bytes):
+    """Decode a command buffer to the ``[N_FIELDS, n]`` int32 array
+    (SOA_FIELDS order), or None if the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if len(buf) % CMD_BYTES:
+        raise ValueError('command buffer length must be a multiple of 16')
+    n = len(buf) // CMD_BYTES
+    out = np.zeros((N_FIELDS, n), dtype=np.int32)
+    rc = lib.soa_decode(bytes(buf), n, out)
+    if rc:
+        raise ValueError(f'instruction {rc - 1}: unknown opcode')
+    return out
+
+
+def encode_pulse_batch(cmd_time, env, phase, freq, amp, cfg):
+    """Batch-encode full-parameter timed pulse commands -> bytes, or
+    None if the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    arrs = [np.ascontiguousarray(a, dtype=np.int32)
+            for a in (cmd_time, env, phase, freq, amp, cfg)]
+    n = len(arrs[0])
+    if any(len(a) != n for a in arrs):
+        raise ValueError('field arrays must have equal length')
+    out = np.zeros(n * CMD_BYTES, dtype=np.uint8)
+    lib.encode_pulse_batch(arrs[0], arrs[1], arrs[2], arrs[3], arrs[4],
+                           arrs[5], n, out)
+    return out.tobytes()

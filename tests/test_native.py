@@ -1,0 +1,88 @@
+"""Native codec tests: C++ decode/encode bit-exact vs the Python path."""
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu import isa, native
+
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native toolchain unavailable')
+
+
+def _random_cmds(rng, n=200):
+    cmds = []
+    ops = list(isa.ALU_OPS)
+    for _ in range(n):
+        r = rng.integers(8)
+        if r < 2:
+            cmds.append(isa.pulse_cmd(
+                freq_word=int(rng.integers(1 << 9)),
+                phase_word=int(rng.integers(1 << 17)),
+                amp_word=int(rng.integers(1 << 16)),
+                env_word=int(rng.integers(1 << 24)),
+                cfg_word=int(rng.integers(1 << 4)),
+                cmd_time=int(rng.integers(1 << 32))))
+        elif r == 2:
+            cmds.append(isa.pulse_cmd(phase_regaddr=int(rng.integers(16)),
+                                      amp_word=int(rng.integers(1 << 16))))
+        elif r == 3:
+            imr = 'ir'[int(rng.integers(2))]
+            in0 = int(rng.integers(-2**31, 2**31)) if imr == 'i' \
+                else int(rng.integers(16))
+            cmds.append(isa.alu_cmd(
+                'reg_alu', imr, in0,
+                ops[int(rng.integers(8))], int(rng.integers(16)),
+                write_reg_addr=int(rng.integers(16))))
+        elif r == 4:
+            cmds.append(isa.alu_cmd(
+                'jump_fproc', 'i', int(rng.integers(-100, 100)),
+                ops[int(rng.integers(8))],
+                jump_cmd_ptr=int(rng.integers(256)),
+                func_id=int(rng.integers(256))))
+        elif r == 5:
+            cmds.append(isa.sync(int(rng.integers(256))))
+        elif r == 6:
+            cmds.append(isa.idle(int(rng.integers(1 << 32))))
+        else:
+            cmds.append(isa.done_cmd())
+    return cmds
+
+
+def test_native_decode_matches_python():
+    rng = np.random.default_rng(0)
+    buf = isa.cmds_to_bytes(_random_cmds(rng))
+    nat = isa.decode_soa(buf, use_native=True)
+    py = isa.decode_soa(buf, use_native=False)
+    for f in isa.SOA_FIELDS:
+        np.testing.assert_array_equal(getattr(nat, f), getattr(py, f),
+                                      err_msg=f)
+
+
+def test_native_encode_matches_python():
+    rng = np.random.default_rng(1)
+    n = 100
+    t = rng.integers(0, 1 << 32, n)
+    env = rng.integers(0, 1 << 24, n)
+    ph = rng.integers(0, 1 << 17, n)
+    fr = rng.integers(0, 1 << 9, n)
+    am = rng.integers(0, 1 << 16, n)
+    cf = rng.integers(0, 1 << 4, n)
+    got = native.encode_pulse_batch(
+        t.astype(np.int64).view(np.int64).astype(np.uint32).view(np.int32)
+        if False else np.asarray(t, np.uint32).view(np.int32),
+        np.asarray(env, np.int32), np.asarray(ph, np.int32),
+        np.asarray(fr, np.int32), np.asarray(am, np.int32),
+        np.asarray(cf, np.int32))
+    want = isa.cmds_to_bytes([
+        isa.pulse_cmd(freq_word=int(fr[i]), phase_word=int(ph[i]),
+                      amp_word=int(am[i]), env_word=int(env[i]),
+                      cfg_word=int(cf[i]), cmd_time=int(t[i]))
+        for i in range(n)])
+    assert got == want
+
+
+def test_native_decode_rejects_bad_opcode():
+    bad = (0b11111 << 123).to_bytes(16, 'little')
+    with pytest.raises(ValueError):
+        native.decode_soa_fields(bad)
