@@ -57,6 +57,9 @@ class Instruction:
                 val = sorted(val)
             elif isinstance(val, np.ndarray):
                 val = list(val)
+            elif isinstance(val, list):
+                val = [v.to_dict() if isinstance(v, Instruction) else v
+                       for v in val]
             out[f.name] = val
         return out
 

@@ -1,0 +1,131 @@
+"""Serialization round-trips, schedule linting, and on-device loop
+execution semantics (qclk rewind)."""
+
+import json
+import numpy as np
+import pytest
+
+import distributed_processor_tpu as dp
+from distributed_processor_tpu import compiler as cm
+from distributed_processor_tpu.ir.program import IRProgram
+from distributed_processor_tpu.pipeline import compile_to_machine
+from distributed_processor_tpu.sim import simulate
+
+from test_compiler import compile_program, sorted_prog_dict, FAST_CLOCKS
+
+
+@pytest.fixture(scope='module')
+def qchip(qchipcfg_path):
+    return dp.QChip(qchipcfg_path)
+
+
+MULTIRST = [
+    {'name': 'X90', 'qubit': ['Q0']},
+    {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+     'func_id': 'Q0.meas', 'true': [],
+     'false': [{'name': 'X90', 'qubit': ['Q0']}], 'scope': ['Q0']},
+    {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+     'func_id': 'Q1.meas', 'true': [],
+     'false': [{'name': 'X90', 'qubit': ['Q1']}], 'scope': ['Q1']},
+    {'name': 'X90', 'qubit': ['Q1']}]
+
+
+def test_serialize_roundtrip_after_every_pass(qchip):
+    """The IR must survive serialize -> rebuild at every pass boundary
+    and still compile to the same per-core asm (the reference proves the
+    same property in test_serialize_multrst, test_compiler.py:608-649)."""
+    fpga_config = dp.FPGAConfig()
+    passes = cm.get_passes(fpga_config, qchip)
+    ref = compile_program(MULTIRST, qchip, fpga_config).compile()
+    ref_prog = sorted_prog_dict(ref)
+
+    for cut in range(len(passes) + 1):
+        ir_prog = IRProgram(MULTIRST)
+        for p in passes[:cut]:
+            p.run_pass(ir_prog)
+        rebuilt = IRProgram(ir_prog.serialize())
+        for p in passes[cut:]:
+            p.run_pass(rebuilt)
+        compiler = dp.Compiler(MULTIRST)
+        compiler.ir_prog = rebuilt
+        got = sorted_prog_dict(compiler.compile())
+        canon = lambda d: json.dumps({str(k): v for k, v in d.items()},
+                                     default=str, sort_keys=True)
+        assert canon(got) == canon(ref_prog), \
+            f'mismatch when serializing after pass {cut}'
+
+
+def test_compiled_program_save_load_roundtrip(tmp_path, qchip,
+                                              channelcfg_path):
+    from test_compiler import MockElement
+    prog = compile_program(MULTIRST, qchip, dp.FPGAConfig()).compile()
+    path = str(tmp_path / 'prog.json')
+    prog.save(path)
+    loaded = cm.load_compiled_program(path)
+    assert loaded.fpga_config.alu_instr_clks == 5
+
+    channel_configs = dp.load_channel_configs(channelcfg_path)
+    a1 = dp.GlobalAssembler(prog, channel_configs,
+                            MockElement).get_assembled_program()
+    a2 = dp.GlobalAssembler(loaded, channel_configs,
+                            MockElement).get_assembled_program()
+    assert sorted(a1.keys()) == sorted(a2.keys())
+    for core in a1:
+        assert a1[core]['cmd_buf'] == a2[core]['cmd_buf']
+
+
+def _user_scheduled(start2: int):
+    env = {'env_func': 'square', 'paradict': {'phase': 0, 'amplitude': 1}}
+    return [
+        {'name': 'pulse', 'freq': 100e6, 'phase': 0, 'amp': 0.5,
+         'twidth': 24e-9, 'env': env, 'dest': 'Q0.qdrv', 'start_time': 5},
+        {'name': 'pulse', 'freq': 100e6, 'phase': 0, 'amp': 0.5,
+         'twidth': 24e-9, 'env': env, 'dest': 'Q0.qdrv',
+         'start_time': start2},
+    ]
+
+
+def test_lint_schedule_rejects_tight_timing(qchip):
+    flags = cm.CompilerFlags(resolve_gates=False, schedule=False)
+    # second pulse would issue before the pipeline frees (5 + 3 clks)
+    with pytest.raises(Exception):
+        compiler = dp.Compiler(_user_scheduled(6))
+        compiler.run_ir_passes(cm.get_passes(dp.FPGAConfig(), qchip,
+                                             compiler_flags=flags))
+    # properly spaced version lints clean
+    compiler = dp.Compiler(_user_scheduled(30))
+    compiler.run_ir_passes(cm.get_passes(dp.FPGAConfig(), qchip,
+                                         compiler_flags=flags))
+    assert compiler.compile() is not None
+
+
+def test_loop_qclk_rewind_execution(qchip):
+    """On-device loop: each iteration re-triggers the same cmd_time via
+    the inc_qclk rewind (reference: compiler.py:322-324); global pulse
+    times advance by the loop delta_t."""
+    program = [
+        {'name': 'declare', 'var': 'i', 'dtype': 'int', 'scope': ['Q0']},
+        {'name': 'set_var', 'var': 'i', 'value': 1},
+        {'name': 'loop', 'cond_lhs': 5, 'cond_rhs': 'i', 'alu_cond': 'ge',
+         'scope': ['Q0'],
+         'body': [{'name': 'X90', 'qubit': ['Q0']},
+                  {'name': 'alu', 'op': 'add', 'lhs': 1, 'rhs': 'i',
+                   'out': 'i'}]},
+        {'name': 'read', 'qubit': ['Q0']},
+    ]
+    mp = compile_to_machine(program, qchip, n_qubits=1)
+    out = simulate(mp, max_steps=512, max_pulses=16, max_meas=4)
+    assert int(out['err'][0]) == 0 and bool(out['done'][0])
+    n = int(out['n_pulses'][0])
+    assert n == 5 + 2          # 5 loop X90s + rdrv/rdlo read pair
+    elems = np.asarray(out['rec_elem'][0, :n])
+    qt = np.asarray(out['rec_qtime'][0, :n])
+    gt = np.asarray(out['rec_gtime'][0, :n])
+    loop_idx = np.nonzero(elems == 0)[0]
+    # every iteration re-fires at the same qclk time...
+    assert len(set(qt[loop_idx])) == 1
+    # ...but globally spaced by a constant delta_t
+    deltas = np.diff(gt[loop_idx])
+    assert len(set(deltas)) == 1 and deltas[0] > 0
+    # loop counter ended at 6 (ran i = 1..5)
+    assert int(out['regs'][0, 0]) == 6 or 6 in np.asarray(out['regs'][0])
