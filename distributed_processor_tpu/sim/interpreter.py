@@ -87,9 +87,17 @@ class InterpreterConfig:
     max_pulses: int = 256
     max_meas: int = 64
     max_resets: int = 8
-    fabric: str = 'sticky'        # 'sticky' | 'fresh'
+    fabric: str = 'sticky'        # 'sticky' | 'fresh' | 'lut'
     meas_elem: int = 2            # element index whose pulses are readouts
     meas_latency: int = MEAS_LATENCY
+    # 'lut' fabric (reference: hdl/fproc_lut.sv): func_id 0 = own fresh
+    # measurement; func_id >= 1 = syndrome-LUT distribution over the
+    # masked input cores.  Tuples so the config stays hashable/static;
+    # the gateware hard-codes these (meas_lut.sv:16-20) — here they are
+    # writable configuration.
+    lut_mask: tuple = ()          # bool per core: LUT address inputs
+    lut_table: tuple = ()         # [2^k] entries, bit c = output for core c
+    trace: bool = False           # record per-step (pc, time) per core
     alu_instr_clks: int = 5
     jump_cond_clks: int = 5
     jump_fproc_clks: int = 8
@@ -161,6 +169,8 @@ def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
         n_resets=z(B, C), rst_time=z(B, C, R),
         n_meas=z(B, C),
         meas_avail=jnp.full((B, C, M), INT32_MAX, jnp.int32),
+        **({'trace_pc': z(B, C, T), 'trace_time': z(B, C, T)}
+           if cfg.trace else {}),
     )
 
 
@@ -186,22 +196,44 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     qclk = time - offset
     is_fproc = (kind == isa.K_ALU_FPROC) | (kind == isa.K_JUMP_FPROC)
 
-    # ---- fproc fabric (reference: hdl/fproc_meas.sv / core_state_mgr.sv)
+    # ---- fproc fabric (reference: hdl/fproc_meas.sv / core_state_mgr.sv /
+    # hdl/fproc_lut.sv, selected statically by cfg.fabric) ---------------
     fid = g('func_id')
-    fid_bad = fid >= C
-    oh_prod = _onehot(jnp.clip(fid, 0, C - 1), C)              # [B, C, C']
-    sel_core = lambda arr: _ohsel(arr[:, None, :], oh_prod)    # [B,C'] -> [B,C]
-    # [B, C', M] -> [B, C, M] (producer row per reader)
-    sel_core_m = lambda arr: jnp.sum(
-        arr[:, None, :, :] * oh_prod[..., None], axis=2)
     req = time
-    mavail_p = sel_core_m(st['meas_avail'])
-    bits_p = sel_core_m(meas_bits)
-    nmeas_p = sel_core(st['n_meas'])
-    prod_done = sel_core(st['done'].astype(jnp.int32)) == 1
+
+    def _by_producer(prod_oh):
+        """Select producer-core rows for each reader: [B,C'] -> [B,C]."""
+        sel = lambda arr: _ohsel(arr[:, None, :], prod_oh)
+        sel_m = lambda arr: jnp.sum(
+            arr[:, None, :, :] * prod_oh[..., None], axis=2)
+        return sel, sel_m
+
+    def _fresh_read(prod_oh):
+        """First measurement completing strictly after the request
+        (reference: hdl/core_state_mgr.sv:45-56 WAIT_MEAS)."""
+        sel, sel_m = _by_producer(prod_oh)
+        mavail_p, bits_p = sel_m(st['meas_avail']), sel_m(meas_bits)
+        fresh = (mavail_p > req[..., None]) & \
+            (jnp.arange(cfg.max_meas)[None, None, :]
+             < sel(st['n_meas'])[..., None])
+        exists = jnp.any(fresh, axis=-1)
+        oh_j = _onehot(jnp.argmax(fresh, axis=-1).astype(jnp.int32),
+                       cfg.max_meas)
+        data = jnp.where(exists, _ohsel(bits_p, oh_j), 0)
+        tready = jnp.where(exists,
+                           jnp.maximum(req, _ohsel(mavail_p, oh_j)), req)
+        dead = ~exists & (sel(st['done'].astype(jnp.int32)) == 1)
+        return exists | dead, data, tready, dead
+
+    fid_bad = jnp.zeros((B, C), bool)
     if cfg.fabric == 'sticky':
         # bit latched at read time; producer must have simulated past `req`
-        f_ready = prod_done | (sel_core(time) >= req)
+        fid_bad = fid >= C
+        oh_prod = _onehot(jnp.clip(fid, 0, C - 1), C)
+        sel, sel_m = _by_producer(oh_prod)
+        mavail_p, bits_p = sel_m(st['meas_avail']), sel_m(meas_bits)
+        f_ready = (sel(st['done'].astype(jnp.int32)) == 1) \
+            | (sel(time) >= req)
         m_cnt = jnp.sum((mavail_p <= req[..., None]).astype(jnp.int32), -1)
         f_data = jnp.where(
             m_cnt > 0,
@@ -209,18 +241,38 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             0)
         f_tready = req
         f_deadlock = jnp.zeros((B, C), bool)
-    else:
-        # fresh: first measurement completing strictly after the request
-        fresh = (mavail_p > req[..., None]) & \
-            (jnp.arange(cfg.max_meas)[None, None, :] < nmeas_p[..., None])
-        exists = jnp.any(fresh, axis=-1)
-        oh_j = _onehot(jnp.argmax(fresh, axis=-1).astype(jnp.int32),
-                       cfg.max_meas)
-        f_data = jnp.where(exists, _ohsel(bits_p, oh_j), 0)
-        f_tready = jnp.where(exists,
-                             jnp.maximum(req, _ohsel(mavail_p, oh_j)), req)
-        f_deadlock = ~exists & prod_done
-        f_ready = exists | f_deadlock
+    elif cfg.fabric == 'fresh':
+        fid_bad = fid >= C
+        oh_prod = _onehot(jnp.clip(fid, 0, C - 1), C)
+        f_ready, f_data, f_tready, f_deadlock = _fresh_read(oh_prod)
+    else:  # 'lut' — reference: hdl/fproc_lut.sv + meas_lut.sv
+        # func_id 0: own fresh measurement
+        own_oh = jnp.broadcast_to(
+            jnp.eye(C, dtype=jnp.int32)[None], (B, C, C))
+        o_ready, o_data, o_tready, o_dead = _fresh_read(own_oh)
+        # func_id >= 1: all masked cores' latest bits form the address
+        lmask = np.asarray(cfg.lut_mask, dtype=bool)
+        shifts = np.zeros(C, dtype=np.int32)
+        shifts[lmask] = np.arange(int(lmask.sum()))
+        lmask_j = jnp.asarray(lmask)
+        ok = (st['n_meas'] >= 1)[:, None, :] \
+            & (st['done'][:, None, :]
+               | (time[:, None, :] >= req[:, :, None]))      # [B, C, C']
+        l_ready = jnp.all(jnp.where(lmask_j[None, None, :], ok, True), -1)
+        cnt = jnp.sum((st['meas_avail'][:, None, :, :]
+                       <= req[:, :, None, None]).astype(jnp.int32), -1)
+        oh_cnt = _onehot(jnp.maximum(cnt - 1, 0), cfg.max_meas)
+        bit = jnp.where(cnt > 0,
+                        jnp.sum(meas_bits[:, None, :, :] * oh_cnt, -1), 0)
+        addr = jnp.sum(bit * lmask_j * (1 << jnp.asarray(shifts)), -1)
+        table = jnp.asarray(cfg.lut_table, jnp.int32)
+        entry = _ohsel(table[None, None, :], _onehot(addr, len(table)))
+        l_data = (entry >> jnp.arange(C, dtype=jnp.int32)[None, :]) & 1
+        is_own = fid == 0
+        f_ready = jnp.where(is_own, o_ready, l_ready)
+        f_data = jnp.where(is_own, o_data, l_data)
+        f_tready = jnp.where(is_own, o_tready, req)
+        f_deadlock = is_own & o_dead
     f_ready = f_ready | fid_bad
     f_data = jnp.where(fid_bad, 0, f_data)
 
@@ -355,11 +407,20 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         | jnp.where(is_fproc & adv & f_deadlock, ERR_FPROC_DEADLOCK, 0) \
         | jnp.where(sync_adv & sync_err[:, None], ERR_SYNC_DONE, 0)
 
+    tr = {}
+    if cfg.trace:
+        # instruction-trace export: the simulator's VCD analog
+        # (reference traces RTL waveforms via Verilator --trace)
+        tr['trace_pc'] = jax.lax.dynamic_update_slice(
+            st['trace_pc'], st['pc'][:, :, None], (0, 0, step_i))
+        tr['trace_time'] = jax.lax.dynamic_update_slice(
+            st['trace_time'], time[:, :, None], (0, 0, step_i))
+
     return dict(st, pc=pc_next, regs=regs, time=time_next, offset=offset_next,
                 done=st['done'] | is_done, err=err, pp=pp, n_pulses=n_pulses,
                 rec=rec, rec_fire=rec_fire, rec_slot=rec_slot,
                 n_resets=n_resets, rst_time=rst_time,
-                n_meas=n_meas, meas_avail=meas_avail)
+                n_meas=n_meas, meas_avail=meas_avail, **tr)
 
 
 def _compact_records(rec, rec_fire, rec_slot, max_pulses: int) -> dict:
@@ -385,6 +446,10 @@ def _compact_records(rec, rec_fire, rec_slot, max_pulses: int) -> dict:
 def _run_batch(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
                n_cores: int, init_regs=None) -> dict:
     """Execute a shot batch: meas_bits ``[B, n_cores, max_meas]``."""
+    if cfg.fabric == 'lut' and (len(cfg.lut_mask) != n_cores
+                                or not cfg.lut_table):
+        raise ValueError("fabric='lut' needs lut_mask (len n_cores) and "
+                         "lut_table in the InterpreterConfig")
     B = meas_bits.shape[0]
     st0 = _init_state(B, n_cores, cfg, init_regs)
     st0['_steps'] = jnp.int32(0)

@@ -115,6 +115,7 @@ def _pulse_dur_clks(env_word: int, spc: int, interp: int) -> int:
 
 def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
                meas_elem: int = 2, meas_latency: int = MEAS_LATENCY,
+               lut_mask=None, lut_table=None,
                max_steps: int = 100000) -> dict:
     """Execute a decoded :class:`~..decoder.MachineProgram` scalar-style.
 
@@ -139,13 +140,41 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
         e = cfgs[elem]
         return _pulse_dur_clks(env_word, e.samples_per_clk, e.interp_ratio)
 
+    def _fresh(core: OracleCore, prod: OracleCore, req: int):
+        for m, t in enumerate(prod.meas_avail):
+            if t > req:
+                if m >= meas_bits.shape[1]:
+                    core.err.append('meas_overflow')
+                    return True, 0, req
+                return True, int(meas_bits[cores.index(prod), m]), max(req, t)
+        if prod.done:
+            core.err.append('fproc_deadlock')
+            return True, 0, req
+        return False, 0, 0
+
     def fproc_read(c: int, core: OracleCore, func_id: int):
         """Return (ready, data, t_ready) for a fproc access at core.time."""
+        req = core.time
+        if fabric == 'lut':
+            # reference: hdl/fproc_lut.sv — id 0: own fresh measurement;
+            # id >= 1: syndrome LUT over the masked cores' latest bits
+            if func_id == 0:
+                return _fresh(core, core, req)
+            masked = [i for i in range(n_cores) if lut_mask[i]]
+            for i in masked:
+                p = cores[i]
+                if not p.meas_avail or not (p.done or p.time >= req):
+                    return False, 0, 0
+            addr = 0
+            for rank, i in enumerate(masked):
+                m = sum(1 for t in cores[i].meas_avail if t <= req)
+                bit = int(meas_bits[i, m - 1]) if m > 0 else 0
+                addr |= bit << rank
+            return True, (int(lut_table[addr]) >> c) & 1, req
         if func_id >= n_cores:
             core.err.append('fproc_id')
             return True, 0, core.time
         prod = cores[func_id]
-        req = core.time
         if fabric == 'sticky':
             if not (prod.done or prod.time >= req):
                 return False, 0, 0
@@ -153,16 +182,7 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
             data = int(meas_bits[func_id, m - 1]) if m > 0 else 0
             return True, data, req
         elif fabric == 'fresh':
-            for m, t in enumerate(prod.meas_avail):
-                if t > req:
-                    if m >= meas_bits.shape[1]:
-                        core.err.append('meas_overflow')
-                        return True, 0, req
-                    return True, int(meas_bits[func_id, m]), max(req, t)
-            if prod.done:
-                core.err.append('fproc_deadlock')
-                return True, 0, req
-            return False, 0, 0
+            return _fresh(core, prod, req)
         raise ValueError(f'unknown fabric {fabric!r}')
 
     for _ in range(max_steps):

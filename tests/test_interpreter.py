@@ -314,3 +314,62 @@ def test_oracle_sticky_returns_latest_bit():
     assert int(out['regs'][0, 2]) == 1
     orc = run_oracle(prog, meas_bits=np.array([[0, 1]]))
     assert orc['regs'][0, 2] == 1
+
+
+def test_lut_fabric_syndrome_distribution():
+    """fproc_lut mode: cores 0/1 measure; core 2 branches on the parity
+    LUT output (reference: hdl/fproc_lut.sv + meas_lut.sv semantics)."""
+    rd = lambda: isa.pulse_cmd(freq_word=3, cfg_word=2,
+                               env_word=(2 << 12) | 0, cmd_time=10)
+    core_meas = [rd(), isa.done_cmd()]
+    core_read = [
+        isa.idle(200),
+        isa.alu_cmd('jump_fproc', 'i', 1, 'eq', jump_cmd_ptr=3, func_id=1),
+        isa.jump_i(4),
+        isa.pulse_cmd(freq_word=9, cfg_word=0, env_word=(2 << 12) | 0,
+                      cmd_time=400),
+        isa.done_cmd(),
+    ]
+    prog = mp_of(core_meas, list(core_meas), core_read)
+    # parity LUT over cores 0,1; all-cores output mask
+    table = tuple(0b111 if bin(a).count('1') & 1 else 0 for a in range(4))
+    kw = dict(fabric='lut', lut_mask=(True, True, False), lut_table=table)
+    for bits, expect_pulse in (((0, 0), 0), ((1, 0), 1), ((0, 1), 1),
+                               ((1, 1), 0)):
+        mb = np.array([[bits[0]], [bits[1]], [0]])
+        out = simulate(prog, meas_bits=mb, **kw)
+        assert int(out['n_pulses'][2]) == expect_pulse, (bits, expect_pulse)
+        assert int(out['err'][2]) == 0
+        orc = run_oracle(prog, meas_bits=mb, fabric='lut',
+                         lut_mask=(True, True, False), lut_table=table)
+        assert len(orc['pulses'][2]) == expect_pulse
+
+
+def test_lut_fabric_own_fresh_read():
+    """func_id 0 in lut mode waits for the core's own fresh measurement."""
+    cmds = [
+        isa.pulse_cmd(freq_word=3, cfg_word=2, env_word=(2 << 12) | 0,
+                      cmd_time=10),
+        isa.alu_cmd('alu_fproc', 'i', 0, 'id1', write_reg_addr=5, func_id=0),
+        isa.done_cmd(),
+    ]
+    prog = mp_of(cmds)
+    out = simulate(prog, meas_bits=np.array([[1]]), fabric='lut',
+                   lut_mask=(True,), lut_table=(0, 1))
+    assert int(out['regs'][0, 5]) == 1
+    # fresh semantics: completion waits for meas_avail (pulse end + 64)
+    assert int(out['time'][0]) >= 76
+
+
+def test_instruction_trace_export():
+    cmds = [
+        isa.alu_cmd('reg_alu', 'i', 7, 'id0', write_reg_addr=0),
+        isa.pulse_cmd(freq_word=1, cfg_word=0, cmd_time=20),
+        isa.done_cmd(),
+    ]
+    out = simulate(mp_of(cmds), trace=True, max_steps=8)
+    steps = int(out['steps'])
+    pcs = list(np.asarray(out['trace_pc'][0, :steps]))
+    assert pcs == [0, 1, 2]
+    times = list(np.asarray(out['trace_time'][0, :steps]))
+    assert times[0] == 2 and times[1] == 7   # INIT_TIME, +alu_instr_clks
