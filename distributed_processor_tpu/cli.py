@@ -1,0 +1,133 @@
+"""Command-line interface: compile, disassemble, and run programs.
+
+Usage (also installed as the ``dproc-tpu`` console script)::
+
+    python -m distributed_processor_tpu compile prog.json -o out.json
+    python -m distributed_processor_tpu disasm out.json --core 0
+    python -m distributed_processor_tpu run prog.qasm --shots 1024
+    python -m distributed_processor_tpu trace prog.json
+
+Programs are JSON instruction lists (the compiler input format) or
+OpenQASM 3 source (by ``.qasm`` extension or ``--qasm``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _load_program(path: str, force_qasm: bool = False):
+    with open(path) as f:
+        text = f.read()
+    if force_qasm or path.endswith('.qasm'):
+        return text
+    return json.loads(text)
+
+
+def _make_sim(args):
+    from .simulator import Simulator
+    from .qchip import QChip
+    qchip = QChip(args.qchip) if args.qchip else None
+    return Simulator(qchip=qchip, n_qubits=args.qubits)
+
+
+def cmd_compile(args):
+    sim = _make_sim(args)
+    program = _load_program(args.program, args.qasm)
+    if isinstance(program, str):
+        from .frontend import qasm_to_program
+        program = qasm_to_program(program)
+    from .pipeline import compile_program
+    prog = compile_program(program, sim.qchip, fpga_config=sim.fpga_config)
+    if args.output:
+        prog.save(args.output)
+        print(f'wrote {args.output}')
+    else:
+        for grp, instrs in prog.program.items():
+            print(f'# core group {grp}')
+            for i in instrs:
+                print(f'  {i}')
+
+
+def cmd_disasm(args):
+    sim = _make_sim(args)
+    mp = sim.compile(_load_program(args.program, args.qasm))
+    from . import isa
+    for c in range(mp.n_cores) if args.core is None else [args.core]:
+        print(f'# core {mp.core_inds[c]}')
+        soa = mp.soa
+        from .isa import _KIND_NAMES
+        for i in range(mp.n_instr):
+            kind = int(soa.kind[c, i])
+            print(f'  {i:4d}: {_KIND_NAMES[kind]}')
+
+
+def cmd_run(args):
+    sim = _make_sim(args)
+    out = sim.run(_load_program(args.program, args.qasm), shots=args.shots,
+                  p1=args.p1)
+    n_pulses = np.asarray(out['n_pulses'])
+    err = np.asarray(out['err'])
+    result = {
+        'shots': args.shots,
+        'mean_pulses_per_core': np.atleast_2d(n_pulses).mean(0).tolist(),
+        'error_shots': int(np.any(np.atleast_2d(err) != 0, -1).sum()),
+        'steps': int(out['steps']),
+    }
+    print(json.dumps(result, indent=2))
+
+
+def cmd_trace(args):
+    sim = _make_sim(args)
+    mp = sim.compile(_load_program(args.program, args.qasm))
+    from .sim import simulate
+    out = simulate(mp, cfg=sim.interpreter_config(mp, trace=True))
+    steps = int(out['steps'])
+    for c in range(mp.n_cores):
+        print(f'# core {mp.core_inds[c]}')
+        for s in range(steps):
+            pc = int(out['trace_pc'][c, s])
+            t = int(out['trace_time'][c, s])
+            print(f'  step {s:4d}  t={t:8d}  pc={pc}')
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog='dproc-tpu',
+                                 description=__doc__.split('\n')[0])
+    ap.add_argument('--qchip', help='calibration JSON (default: built-in)')
+    ap.add_argument('--qubits', type=int, default=8)
+    ap.add_argument('--qasm', action='store_true',
+                    help='treat the program file as OpenQASM 3')
+    sub = ap.add_subparsers(dest='command', required=True)
+
+    p = sub.add_parser('compile', help='compile to per-core assembly')
+    p.add_argument('program')
+    p.add_argument('-o', '--output')
+    p.set_defaults(fn=cmd_compile)
+
+    p = sub.add_parser('disasm', help='decode the assembled machine program')
+    p.add_argument('program')
+    p.add_argument('--core', type=int)
+    p.set_defaults(fn=cmd_disasm)
+
+    p = sub.add_parser('run', help='simulate shots')
+    p.add_argument('program')
+    p.add_argument('--shots', type=int, default=1)
+    p.add_argument('--p1', type=float, default=None,
+                   help='Bernoulli P(measure 1) per qubit')
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser('trace', help='instruction trace (1 shot)')
+    p.add_argument('program')
+    p.set_defaults(fn=cmd_trace)
+
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == '__main__':
+    main()

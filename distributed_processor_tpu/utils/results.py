@@ -1,0 +1,78 @@
+"""Sweep-result checkpointing.
+
+The reference has no result persistence (CompiledProgram.save is
+stubbed upstream; results live on the host); long sharded sweeps here
+need resumable accumulation.  Results are stored as compressed npz
+archives with a manifest, written atomically so an interrupted sweep
+never leaves a torn checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def save_results(path: str, results: dict, meta: dict = None) -> None:
+    """Atomically save a dict of arrays (+ JSON-able metadata)."""
+    arrays = {}
+    for k, v in results.items():
+        if k.startswith('_'):
+            continue
+        arrays[k] = np.asarray(v)
+    if meta is not None:
+        arrays['__meta__'] = np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8)
+    tmp = path + '.tmp'
+    with open(tmp, 'wb') as f:
+        np.savez_compressed(f, **arrays)
+    os.replace(tmp, path)
+
+
+def load_results(path: str) -> tuple[dict, dict]:
+    """Load a checkpoint -> (arrays dict, metadata dict)."""
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != '__meta__'}
+        meta = {}
+        if '__meta__' in z.files:
+            meta = json.loads(bytes(z['__meta__']).decode())
+    return arrays, meta
+
+
+class SweepAccumulator:
+    """Accumulate per-batch sweep statistics with periodic checkpoints.
+
+    ``add`` sums array leaves across batches (counts, histograms);
+    ``checkpoint_every`` batches a checkpoint is written; ``resume``
+    picks up the accumulated state + next batch index.
+    """
+
+    def __init__(self, path: str = None, checkpoint_every: int = 0):
+        self.path = path
+        self.checkpoint_every = checkpoint_every
+        self.state: dict = {}
+        self.n_batches = 0
+
+    def add(self, batch_stats: dict) -> None:
+        for k, v in batch_stats.items():
+            v = np.asarray(v)
+            self.state[k] = self.state.get(k, 0) + v
+        self.n_batches += 1
+        if self.path and self.checkpoint_every and \
+                self.n_batches % self.checkpoint_every == 0:
+            self.save()
+
+    def save(self) -> None:
+        save_results(self.path, self.state,
+                     meta={'n_batches': self.n_batches})
+
+    @classmethod
+    def resume(cls, path: str, checkpoint_every: int = 0) -> 'SweepAccumulator':
+        acc = cls(path, checkpoint_every)
+        if os.path.exists(path):
+            arrays, meta = load_results(path)
+            acc.state = dict(arrays)
+            acc.n_batches = int(meta.get('n_batches', 0))
+        return acc
