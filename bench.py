@@ -13,8 +13,10 @@ north-star target of 1e6 shots in 60 s (BASELINE.md) — there is no
 reference number to compare against (the reference publishes none; it
 executes shots on FPGA hardware one at a time, host-sequenced).
 
-Env knobs: BENCH_SHOTS (total, default 131072), BENCH_BATCH (per-device
-batch, default 16384), BENCH_DEPTH (RB depth, default 12).
+Env knobs: BENCH_SHOTS (total, default 1048576), BENCH_BATCH (per-device
+batch, default 262144), BENCH_DEPTH (RB depth, default 12).  Batch size
+matters: big batches amortise the per-step while_loop dispatch; 262144
+is the largest whose loop-carried record state fits HBM comfortably.
 """
 
 import json
@@ -50,8 +52,8 @@ def build_machine_program(n_qubits: int, depth: int):
 def main():
     n_qubits = int(os.environ.get('BENCH_QUBITS', 8))
     depth = int(os.environ.get('BENCH_DEPTH', 12))
-    total_shots = int(os.environ.get('BENCH_SHOTS', 131072))
-    batch = int(os.environ.get('BENCH_BATCH', 16384))
+    total_shots = int(os.environ.get('BENCH_SHOTS', 1048576))
+    batch = int(os.environ.get('BENCH_BATCH', 262144))
     batch = min(batch, total_shots)
     n_batches = max(total_shots // batch, 1)
     total_shots = batch * n_batches
@@ -95,8 +97,10 @@ def main():
     t0 = time.perf_counter()
     for i in range(n_batches):
         key, sub = jax.random.split(key)
-        res = step(sub)
-    res = jax.block_until_ready(res)
+        # block per batch: queueing several in-flight steps multiplies
+        # peak HBM (each holds ~100s of MB of loop-carried state) and
+        # stalls the allocator, measured ~3x slower than synchronous
+        res = jax.block_until_ready(step(sub))
     elapsed = time.perf_counter() - t0
     err_total += int(res[1])
 
