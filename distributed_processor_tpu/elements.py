@@ -11,11 +11,13 @@ and the freq/env buffer shapes observable in the reference's disassembler
 * env word: 24-bit = {12-bit length, 12-bit start address}; addresses and
   lengths count groups of 4 envelope samples (four parallel memory banks);
   length 0xfff is the continuous-wave sentinel
-* env buffer: one uint32 per sample = signed 16-bit I (LSB) | Q << 16
+* env buffer: one uint32 per sample = signed 16-bit Q (LSB) | I << 16
+  (the reference disassembler's convention: real = high half,
+  reference python/distproc/asmparse.py:60-63)
 * freq buffer: 16 uint32 words per frequency — word 0 is the 32-bit phase
   increment freq/fsamp * 2^32, words 1..15 are the IQ unit phasors
   exp(2 pi i k freq / fsamp) for the element's parallel sample lanes,
-  packed signed-15-bit I | Q<<16
+  packed signed-15-bit I<<16 | Q
 * cfg word: 4-bit = {2-bit mode, 2-bit element index}
 """
 
@@ -38,17 +40,18 @@ IQ_SCALE = 2 ** 15 - 1
 
 
 def pack_iq(i, q) -> np.ndarray:
-    """Pack signed 16-bit I (low half) and Q (high half) into uint32."""
+    """Pack signed 16-bit I (high half) and Q (low half) into uint32
+    (reference: python/distproc/asmparse.py:60-63 reads real = high)."""
     iw = np.asarray(np.round(i), dtype=np.int64) & 0xffff
     qw = np.asarray(np.round(q), dtype=np.int64) & 0xffff
-    return ((qw << 16) | iw).astype(np.uint32)
+    return ((iw << 16) | qw).astype(np.uint32)
 
 
 def unpack_iq(words) -> np.ndarray:
     """Inverse of :func:`pack_iq`; returns complex I + 1j*Q."""
     w = np.asarray(words, dtype=np.uint32).astype(np.int64)
-    i = w & 0xffff
-    q = (w >> 16) & 0xffff
+    q = w & 0xffff
+    i = (w >> 16) & 0xffff
     i = np.where(i >= 1 << 15, i - (1 << 16), i)
     q = np.where(q >= 1 << 15, q - (1 << 16), q)
     return i + 1j * q

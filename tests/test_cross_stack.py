@@ -1,0 +1,143 @@
+"""Cross-stack semantic tests: compiler decisions observable in machine
+execution, and buffer-format parity against the reference's disassembler
+(imported as a data oracle, not copied)."""
+
+import importlib.util
+import sys
+
+import numpy as np
+import pytest
+
+import distributed_processor_tpu as dp
+from distributed_processor_tpu.pipeline import compile_to_machine
+from distributed_processor_tpu.sim import simulate
+from distributed_processor_tpu.elements import TPUElementConfig
+from distributed_processor_tpu.models import make_default_qchip
+
+
+@pytest.fixture(scope='module')
+def qchip(qchipcfg_path):
+    return dp.QChip(qchipcfg_path)
+
+
+def test_virtual_z_lands_in_pulse_phase_words(qchip):
+    """Software z-phase accumulation (ResolveVirtualZ) must appear in the
+    executed pulse records' phase words."""
+    program = [{'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'virtual_z', 'qubit': ['Q0'], 'phase': np.pi / 2},
+               {'name': 'X90', 'qubit': ['Q0']},
+               {'name': 'virtual_z', 'qubit': ['Q0'], 'phase': np.pi / 4},
+               {'name': 'X90', 'qubit': ['Q0']}]
+    mp = compile_to_machine(program, qchip, n_qubits=1)
+    out = simulate(mp)
+    assert int(out['err'][0]) == 0
+    ecfg = TPUElementConfig()
+    phases = [int(p) for p in np.asarray(out['rec_phase'][0, :3])]
+    assert phases[0] == 0
+    assert phases[1] == ecfg.get_phase_word(np.pi / 2)
+    assert phases[2] == ecfg.get_phase_word(3 * np.pi / 4)
+
+
+def test_cross_core_compiled_feedback(qchip):
+    """Q1 branches on Q0's measurement: GlobalAssembler resolves
+    'Q0.meas' to core 0's index and the interpreter routes the bit
+    across cores (BASELINE config 4 coupling, compiled path)."""
+    program = [
+        {'name': 'read', 'qubit': ['Q0']},
+        # the barrier puts Q0's readout timing in the branch block's
+        # schedule ancestry (CFG edges follow last-writer-per-dest), so
+        # the inserted Hold covers the cross-core measurement latency
+        {'name': 'barrier', 'qubit': ['Q0', 'Q1']},
+        {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+         'func_id': 'Q0.meas', 'scope': ['Q1'],
+         'true': [{'name': 'X90', 'qubit': ['Q1']}], 'false': []},
+        {'name': 'X90', 'qubit': ['Q0']},
+    ]
+    mp = compile_to_machine(program, qchip, n_qubits=2)
+    out0 = simulate(mp, meas_bits=np.zeros((2, 4), int))
+    out1 = simulate(mp, meas_bits=np.array([[1, 1, 1, 1], [0, 0, 0, 0]]))
+    assert np.all(np.asarray(out0['err']) == 0)
+    assert np.all(np.asarray(out1['err']) == 0)
+    # Q0's bit = 1 adds one X90 on core 1
+    assert int(out1['n_pulses'][1]) == int(out0['n_pulses'][1]) + 1
+    # and leaves core 0 unchanged
+    assert int(out1['n_pulses'][0]) == int(out0['n_pulses'][0])
+
+
+class _Numpy1Shim:
+    """numpy-1 compat for the reference module (written pre-numpy-2):
+    buffers decode to object arrays of python ints so its mixed
+    uint32/bigint arithmetic keeps numpy-1 semantics."""
+    int32 = np.int64      # avoids numpy-2 strict overflow in astype
+
+    def __getattr__(self, k):
+        return getattr(np, k)
+
+    def frombuffer(self, buf, dtype=None):
+        return np.frombuffer(buf, dtype=dtype).astype(object)
+
+
+def _load_reference_asmparse(reference_root):
+    path = f'{reference_root}/python/distproc/asmparse.py'
+    spec = importlib.util.spec_from_file_location('ref_asmparse', path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, f'{reference_root}/python')   # its distproc imports
+    try:
+        spec.loader.exec_module(mod)
+    except Exception as e:            # pragma: no cover
+        pytest.skip(f'reference asmparse not importable: {e}')
+    finally:
+        sys.path.remove(f'{reference_root}/python')
+    mod.numpy = _Numpy1Shim()
+    mod.vsign16 = np.vectorize(mod.sign16, otypes=[object])
+    mod.vsign32 = np.vectorize(mod.sign32, otypes=[object])
+    return mod
+
+
+def test_env_buffer_parity_with_reference_parser(reference_root):
+    """Our packed envelope buffers decode identically under the
+    reference's envparse (word = signed 16-bit Q low | I << 16; the
+    reference reads real from the high half, asmparse.py:61-62)."""
+    ref = _load_reference_asmparse(reference_root)
+    ecfg = TPUElementConfig(samples_per_clk=16)
+    rng = np.random.default_rng(0)
+    env = (rng.uniform(-1, 1, 64) + 1j * rng.uniform(-1, 1, 64)) * 0.9
+    buf = ecfg.get_env_buffer(env)
+    ours = np.asarray(buf, dtype='<u4')
+    theirs = np.asarray(ref.envparse(ours.tobytes()), dtype=complex)
+    from distributed_processor_tpu.elements import unpack_iq
+    decoded = unpack_iq(ours)
+    np.testing.assert_array_equal(np.real(decoded), np.real(theirs))
+    np.testing.assert_array_equal(np.imag(decoded), np.imag(theirs))
+
+
+def test_freq_buffer_parity_with_reference_parser(reference_root):
+    """Frequency buffers: word 0 (the 32-bit phase increment) must
+    decode to the same frequency under the reference's freqparse."""
+    ref = _load_reference_asmparse(reference_root)
+    ecfg = TPUElementConfig(samples_per_clk=16)   # 8 GS/s
+    freqs = [100e6, 4.2e9, 6.5536e9]
+    buf = ecfg.get_freq_buffer(freqs)
+    parsed = ref.freqparse(np.asarray(buf, dtype='<u4').tobytes(),
+                           ecfg.sample_freq)
+    np.testing.assert_allclose(np.asarray(parsed['freq'], float), freqs,
+                               rtol=1e-6)
+    # the lane phasors decode to unit-magnitude IQ under their parser
+    mags = np.abs(np.asarray(parsed['iq15'], dtype=complex)) / (2**15 - 1)
+    np.testing.assert_allclose(mags, 1.0, atol=2e-4)
+
+
+def test_cmdparse_parity_on_pulse_command(reference_root):
+    """A pulse command we encode must field-decode identically under the
+    reference's cmdparse."""
+    ref = _load_reference_asmparse(reference_root)
+    from distributed_processor_tpu import isa
+    cmd = isa.pulse_cmd(freq_word=0x123, phase_word=0x1abcd, amp_word=0x8421,
+                        env_word=(7 << 12) | 3, cfg_word=0x5, cmd_time=4242)
+    parsed = ref.cmdparse(int(cmd).to_bytes(16, 'little'))[0]
+    assert parsed['cmdtime'] == 4242
+    assert parsed['freq'] == 0x123
+    assert parsed['phase'] == 0x1abcd
+    assert parsed['amp'] == 0x8421
+    assert parsed['cfg'] == 0x5
+    assert parsed['env_start'] == 3 and parsed['env_length'] == 7
