@@ -65,6 +65,24 @@ def ramsey_program(qubit: str, delay_s: float,
     return out
 
 
+def ghz_program(qubits) -> list[dict]:
+    """GHZ-state preparation + readout: H on the first qubit, a CNOT
+    chain, barrier, read all (uses the CNOT calibrations the default
+    qchip defines for adjacent pairs)."""
+    q0 = qubits[0]
+    prog = [
+        {'name': 'virtual_z', 'qubit': [q0], 'phase': np.pi / 2},
+        {'name': 'X90', 'qubit': [q0]},
+        {'name': 'virtual_z', 'qubit': [q0], 'phase': np.pi / 2},
+    ]
+    for a, b in zip(qubits, qubits[1:]):
+        prog.append({'name': 'CNOT', 'qubit': [a, b]})
+    prog.append({'name': 'barrier', 'qubit': list(qubits)})
+    for q in qubits:
+        prog.append({'name': 'read', 'qubit': [q]})
+    return prog
+
+
 def loop_shots_program(body: list[dict], n_shots: int, scope) -> list[dict]:
     """Wrap a program body in an on-device shot loop (the reference's
     loop instruction with a var counter — qclk rewind keeps per-iteration

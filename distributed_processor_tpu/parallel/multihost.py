@@ -1,0 +1,69 @@
+"""Multi-host execution: DCN-spanning meshes.
+
+The reference's distributed story ends at on-chip wiring (SURVEY §2.3);
+here scaling past one host is the standard JAX multi-controller model:
+every host runs the same program, `jax.distributed.initialize` wires the
+processes, and a global mesh spans all devices.  Shot batches stay
+host-local (the dp axis is ordered so each host's shard lives on its own
+devices — collectives for statistics ride ICI within a host and DCN
+across hosts only for the final psum).
+
+Single-process runs fall back transparently, so everything here is
+exercised by the regular test suite; multi-host needs no code changes,
+only `initialize_multihost()` before first jax use on each controller.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize_multihost(coordinator_address: str = None,
+                         num_processes: int = None,
+                         process_id: int = None) -> dict:
+    """Initialise the multi-controller runtime (no-op if single-process
+    or already initialised).  Returns topology info."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    return {'process_index': jax.process_index(),
+            'process_count': jax.process_count(),
+            'local_devices': len(jax.local_devices()),
+            'global_devices': len(jax.devices())}
+
+
+def make_global_mesh(n_mp: int = 1) -> Mesh:
+    """A ('dp', 'mp') mesh over every device of every process, ordered so
+    consecutive dp rows are host-local (shot shards never straddle DCN)."""
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    if n_mp < 1 or len(devs) % n_mp:
+        raise ValueError(
+            f'{len(devs)} devices not divisible by n_mp={n_mp}')
+    n_dp = len(devs) // n_mp
+    return Mesh(np.asarray(devs).reshape(n_dp, n_mp), ('dp', 'mp'))
+
+
+def host_local_batch(mesh: Mesh, global_shots: int) -> tuple[int, int]:
+    """Split a global shot count: returns (local_shots, local_offset) for
+    this process given equal sharding over the dp axis."""
+    n_dp = mesh.devices.shape[0]
+    if global_shots % n_dp:
+        raise ValueError(f'{global_shots} shots not divisible by dp={n_dp}')
+    per_dev = global_shots // n_dp
+    local_rows = [i for i in range(n_dp)
+                  if mesh.devices[i, 0].process_index == jax.process_index()]
+    return per_dev * len(local_rows), per_dev * (local_rows[0]
+                                                 if local_rows else 0)
+
+
+def global_shot_array(mesh: Mesh, local_data, global_shape) -> jax.Array:
+    """Assemble a dp-sharded global array from per-host local shards
+    (single-process: a plain device_put with the shot sharding)."""
+    sharding = NamedSharding(mesh, P('dp'))
+    if jax.process_count() == 1:
+        return jax.device_put(np.asarray(local_data), sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local_data), global_shape)

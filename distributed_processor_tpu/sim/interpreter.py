@@ -250,28 +250,35 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         own_oh = jnp.broadcast_to(
             jnp.eye(C, dtype=jnp.int32)[None], (B, C, C))
         o_ready, o_data, o_tready, o_dead = _fresh_read(own_oh)
-        # func_id >= 1: all masked cores' latest bits form the address
+        # func_id >= 1: the masked cores' latest bits form the address;
+        # the read blocks until every masked input's bit is *valid*
+        # (reference: meas_lut.sv LUT_WAIT until (mask & valid) == mask)
         lmask = np.asarray(cfg.lut_mask, dtype=bool)
         shifts = np.zeros(C, dtype=np.int32)
         shifts[lmask] = np.arange(int(lmask.sum()))
         lmask_j = jnp.asarray(lmask)
+        # causality: every masked producer has recorded >= 1 measurement
+        # and its timeline passed the reader's request
         ok = (st['n_meas'] >= 1)[:, None, :] \
             & (st['done'][:, None, :]
                | (time[:, None, :] >= req[:, :, None]))      # [B, C, C']
         l_ready = jnp.all(jnp.where(lmask_j[None, None, :], ok, True), -1)
-        cnt = jnp.sum((st['meas_avail'][:, None, :, :]
-                       <= req[:, :, None, None]).astype(jnp.int32), -1)
-        oh_cnt = _onehot(jnp.maximum(cnt - 1, 0), cfg.max_meas)
-        bit = jnp.where(cnt > 0,
-                        jnp.sum(meas_bits[:, None, :, :] * oh_cnt, -1), 0)
-        addr = jnp.sum(bit * lmask_j * (1 << jnp.asarray(shifts)), -1)
+        oh_last = _onehot(jnp.maximum(st['n_meas'] - 1, 0), cfg.max_meas)
+        avail_last = _ohsel(jnp.where(st['meas_avail'] == INT32_MAX, 0,
+                                      st['meas_avail']), oh_last)   # [B, C']
+        bit = _ohsel(meas_bits, oh_last)                            # [B, C']
+        t_lut = jnp.max(jnp.where(lmask_j[None, :], avail_last, 0),
+                        axis=-1)                                    # [B]
+        addr = jnp.sum(bit[:, None, :] * lmask_j * (1 << jnp.asarray(shifts)),
+                       -1)                                          # [B, C]
         table = jnp.asarray(cfg.lut_table, jnp.int32)
         entry = _ohsel(table[None, None, :], _onehot(addr, len(table)))
         l_data = (entry >> jnp.arange(C, dtype=jnp.int32)[None, :]) & 1
         is_own = fid == 0
         f_ready = jnp.where(is_own, o_ready, l_ready)
         f_data = jnp.where(is_own, o_data, l_data)
-        f_tready = jnp.where(is_own, o_tready, req)
+        f_tready = jnp.where(is_own, o_tready,
+                             jnp.maximum(req, t_lut[:, None]))
         f_deadlock = is_own & o_dead
     f_ready = f_ready | fid_bad
     f_data = jnp.where(fid_bad, 0, f_data)

@@ -165,12 +165,19 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
                 p = cores[i]
                 if not p.meas_avail or not (p.done or p.time >= req):
                     return False, 0, 0
+            # blocks until every masked input's latest bit is valid
+            # (meas_lut.sv LUT_WAIT); addr from the latest measurements
             addr = 0
             for rank, i in enumerate(masked):
-                m = sum(1 for t in cores[i].meas_avail if t <= req)
-                bit = int(meas_bits[i, m - 1]) if m > 0 else 0
+                m = len(cores[i].meas_avail) - 1
+                if m >= meas_bits.shape[1]:
+                    core.err.append('meas_overflow')
+                    bit = 0
+                else:
+                    bit = int(meas_bits[i, m])
                 addr |= bit << rank
-            return True, (int(lut_table[addr]) >> c) & 1, req
+            t_lut = max(cores[i].meas_avail[-1] for i in masked)
+            return True, (int(lut_table[addr]) >> c) & 1, max(req, t_lut)
         if func_id >= n_cores:
             core.err.append('fproc_id')
             return True, 0, core.time

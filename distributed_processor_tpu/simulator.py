@@ -70,7 +70,7 @@ class Simulator:
     # -- execution -------------------------------------------------------
 
     def run(self, program, shots: int = 1, meas_bits=None, p1=None,
-            key=None, **cfg_kw) -> dict:
+            key=None, init_regs=None, **cfg_kw) -> dict:
         """Compile (if needed) and execute ``shots`` shots.
 
         Measurement bits come from (in priority order) ``meas_bits``
@@ -90,11 +90,13 @@ class Simulator:
                                      (mp.n_cores,)),
                 shots, cfg.max_meas)
         if shots == 1 and (meas_bits is None or meas_bits.ndim == 2):
-            out = dict(simulate(mp, meas_bits=meas_bits, cfg=cfg))
+            out = dict(simulate(mp, meas_bits=meas_bits,
+                                init_regs=init_regs, cfg=cfg))
         else:
             if meas_bits is None:
                 meas_bits = np.zeros((shots, mp.n_cores, cfg.max_meas), int)
-            out = dict(simulate_batch(mp, meas_bits, cfg=cfg))
+            out = dict(simulate_batch(mp, meas_bits, init_regs=init_regs,
+                                      cfg=cfg))
         out['_mp'] = mp
         out['_cfg'] = cfg
         return out

@@ -1,0 +1,78 @@
+"""Repetition-code LUT round, GHZ program, multihost helpers."""
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu.models import (
+    repetition_round_machine_program, repetition_config, majority_lut,
+    corrected_counts, ghz_program, make_default_qchip)
+from distributed_processor_tpu.sim import simulate, simulate_batch
+from distributed_processor_tpu.pipeline import compile_to_machine
+from distributed_processor_tpu.parallel import (
+    initialize_multihost, make_global_mesh, host_local_batch,
+    global_shot_array)
+
+
+def test_majority_lut_distance3():
+    table = majority_lut(3)
+    assert table[0b000] == 0          # no error
+    assert table[0b001] == 0b001      # single flip corrected
+    assert table[0b010] == 0b010
+    assert table[0b110] == 0b001      # minority bit 0 corrected
+    assert table[0b111] == 0
+
+
+def test_repetition_round_corrections():
+    n = 3
+    mp = repetition_round_machine_program(n)
+    cfg = repetition_config(n)
+    for pattern in range(8):
+        bits = np.array([[(pattern >> i) & 1] for i in range(n)])
+        out = simulate(mp, meas_bits=bits, cfg=cfg)
+        assert np.all(np.asarray(out['err']) == 0), pattern
+        want = majority_lut(n)[pattern]
+        got = list(corrected_counts(out, n))
+        assert got == [(want >> i) & 1 for i in range(n)], pattern
+
+
+def test_repetition_round_batched_random_errors():
+    n, shots = 3, 64
+    mp = repetition_round_machine_program(n)
+    cfg = repetition_config(n)
+    rng = np.random.default_rng(0)
+    bits = rng.integers(0, 2, (shots, n, 1))
+    out = simulate_batch(mp, bits, cfg=cfg)
+    assert np.all(np.asarray(out['err']) == 0)
+    table = majority_lut(n)
+    counts = corrected_counts(out, n)
+    for s in range(shots):
+        addr = sum(int(bits[s, i, 0]) << i for i in range(n))
+        want = [(table[addr] >> i) & 1 for i in range(n)]
+        assert list(counts[s]) == want
+
+
+def test_ghz_program_compiles_and_runs():
+    qubits = ['Q0', 'Q1', 'Q2']
+    qchip = make_default_qchip(3)
+    mp = compile_to_machine(ghz_program(qubits), qchip, n_qubits=3)
+    out = simulate(mp)
+    assert np.all(np.asarray(out['err']) == 0)
+    assert np.all(np.asarray(out['done']))
+    # every core reads out (rdlo pulse present)
+    for c in range(3):
+        n = int(out['n_pulses'][c])
+        assert 2 in np.asarray(out['rec_elem'][c, :n])
+
+
+def test_multihost_single_process_helpers():
+    info = initialize_multihost()
+    assert info['process_count'] == 1
+    mesh = make_global_mesh(n_mp=2)
+    assert mesh.axis_names == ('dp', 'mp')
+    local, offset = host_local_batch(mesh, 16)
+    assert local == 16 and offset == 0
+    arr = global_shot_array(mesh, np.arange(16 * 3).reshape(16, 3),
+                            (16, 3))
+    assert arr.shape == (16, 3)
+    with pytest.raises(ValueError):
+        host_local_batch(mesh, 15)
