@@ -3,3 +3,4 @@ from .waveform import (synthesize_element, pulse_window_weights,
 from .demod import (demod_iq, demod_iq_pallas, discriminate,
                     demod_and_discriminate, stack_window_weights)
 from .fabric import MeasLUT
+from .waveform_pallas import synthesize_element_pallas

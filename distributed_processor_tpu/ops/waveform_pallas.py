@@ -1,0 +1,179 @@
+"""Pallas TPU kernel for element waveform synthesis.
+
+The reference synthesises waveforms in dedicated DDS gateware (the
+out-of-repo signal-generator element); :func:`..ops.waveform.
+synthesize_element` is the XLA reference implementation.  This kernel
+tiles the trace through VMEM for long captures: the grid walks sample
+blocks, a ``fori_loop`` over pulses accumulates windowed contributions,
+and each pulse's envelope segment is fetched with a scalar-offset
+dynamic slice (per-lane gathers don't vectorise on TPU; contiguous
+slices do — the same design rule as the interpreter's one-hot fetch).
+
+The carrier is generated exactly the way the hardware NCO does it:
+a 32-bit integer phase accumulator (``inc * n mod 2^32``, wrapping int32
+multiply) so phase stays exact for arbitrarily long traces — float32
+``2*pi*f*n`` loses ~0.3 rad by a million samples.
+
+Envelopes are pre-expanded by their interpolation ratio and padded by
+one block on both sides, so every in-window lane's envelope index falls
+inside the loaded slice with no per-lane clamping.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..elements import ENV_CW_SENTINEL
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except ImportError:      # pragma: no cover
+    _HAS_PALLAS = False
+
+_TWO_PI_OVER_2_32 = float(2 * np.pi / 2 ** 32)
+
+
+def _kernel(scal_ref, env_ref, out_ref, *, block: int, n_pulses: int):
+    b = pl.program_id(0)
+    n0 = b * block
+    lane = jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)[:, 0]
+    n = n0 + lane
+
+    def body(p, acc):
+        s = scal_ref[0, p]
+        e = scal_ref[1, p]
+        env_off = scal_ref[2, p]          # into the padded expanded table
+        inc = scal_ref[3, p]              # 32-bit NCO phase increment
+        phase0 = scal_ref[4, p]           # phase word scaled to 2^32 units
+        ampw = scal_ref[5, p]             # amp word (16 bit)
+        is_cw = scal_ref[6, p]            # constant-envelope pulse
+
+        in_win = (n >= s) & (n < e)
+        # envelope slice: sample n reads padded index env_off + (n - s);
+        # the slice start is a scalar, alignment is exact by construction
+        # for in-window lanes; CW pulses pin the slice to their constant
+        # segment; the clamp (a) protects out-of-window blocks and (b)
+        # realises the reference's hold-last-sample overrun semantics
+        # (the table's tail fill repeats the last sample)
+        start = jnp.clip(env_off + (1 - is_cw) * (n0 - s), 0,
+                         env_ref.shape[0] - block)
+        ev_i = env_ref[pl.ds(start, block), 0]
+        ev_q = env_ref[pl.ds(start, block), 1]
+        # exact NCO: phase = (inc * n + phase0) mod 2^32 via int32 wrap
+        pa = inc * n + phase0
+        theta = pa.astype(jnp.float32) * _TWO_PI_OVER_2_32
+        c, si = jnp.cos(theta), jnp.sin(theta)
+        amp = ampw.astype(jnp.float32) / 65535.0
+        contrib_i = amp * (ev_i * c - ev_q * si)
+        contrib_q = amp * (ev_i * si + ev_q * c)
+        mask = in_win.astype(jnp.float32)
+        return (acc[0] + mask * contrib_i, acc[1] + mask * contrib_q)
+
+    zero = jnp.zeros((block,), jnp.float32)
+    acc_i, acc_q = jax.lax.fori_loop(0, n_pulses, body, (zero, zero))
+    out_ref[:, 0] = acc_i
+    out_ref[:, 1] = acc_q
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('block', 'n_samples', 'interpret'))
+def _synthesize_call(scal, env_padded, block, n_samples, interpret):
+    n_pulses = scal.shape[1]
+    env_shape = env_padded.shape
+    return pl.pallas_call(
+        functools.partial(_kernel, block=block, n_pulses=n_pulses),
+        grid=(n_samples // block,),
+        in_specs=[
+            pl.BlockSpec((7, n_pulses), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(env_shape, lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, 2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_samples, 2), jnp.float32),
+        interpret=interpret,
+    )(scal, env_padded)
+
+
+def synthesize_element_pallas(rec: dict, env_table, spc: int, interp: int,
+                              n_clks: int, elem: int = 0, block: int = 512,
+                              interpret: bool = False):
+    """Pallas-tiled version of :func:`..ops.waveform.synthesize_element`.
+
+    Same record/env inputs and output shape (``float32 [N, 2]``);
+    CW pulses hold their start sample until the next pulse as in the
+    reference implementation.  ``interpret=True`` runs off-TPU.
+    """
+    if not _HAS_PALLAS:   # pragma: no cover
+        from .waveform import synthesize_element
+        return synthesize_element(rec, env_table, spc, interp, n_clks, elem)
+
+    n_samples = n_clks * spc
+    if n_samples % block:
+        raise ValueError(f'n_clks*spc={n_samples} must be a multiple of '
+                         f'block={block}')
+
+    # ---- host-side preparation (concrete numpy) ------------------------
+    rec_np = {k: np.asarray(v) for k, v in rec.items()}
+    P = int(rec_np['n_pulses'])
+    valid = rec_np['elem'][:P] == elem
+    idx = np.nonzero(valid)[0]
+
+    env_table = np.asarray(env_table)
+    if env_table.ndim == 1:
+        env_table = np.stack([env_table.real, env_table.imag], -1)
+    env_exp = np.repeat(env_table.astype(np.float32), interp, axis=0)
+    pad = np.zeros((block, 2), np.float32)
+    last = env_exp[-1:] if len(env_exp) else np.zeros((1, 2), np.float32)
+    # tail fill repeats the last sample: an env window running past the
+    # table holds the final sample, matching synthesize_element's clamp
+    env_padded = np.concatenate(
+        [pad, env_exp, np.broadcast_to(last, (block, 2))])
+
+    scal = np.zeros((7, max(len(idx), 1)), dtype=np.int32)
+    starts = rec_np['gtime'][idx] * spc
+    env_words = rec_np['env'][idx]
+    env_addr = (env_words & 0xfff) * 4
+    env_nw = (env_words >> 12) & 0xfff
+    is_cw = env_nw == ENV_CW_SENTINEL
+    length = np.where(is_cw, n_samples, env_nw * 4 * interp)
+    order = np.argsort(starts)
+    nxt = np.full(len(idx), n_samples, dtype=np.int64)
+    if len(idx):
+        ss = starts[order]
+        for k in range(len(idx) - 1):
+            nxt[order[k]] = ss[k + 1]
+    ends = np.where(is_cw, np.minimum(nxt, n_samples), starts + length)
+    scal[0, :len(idx)] = starts
+    scal[1, :len(idx)] = ends
+    scal[2, :len(idx)] = env_addr * interp + block   # + front pad
+    scal[6, :len(idx)] = is_cw
+    for k in range(len(idx)):
+        if is_cw[k]:
+            # block-length constant segment holding the start sample;
+            # the kernel pins its slice here (no per-block advance)
+            samp = env_exp[min(int(env_addr[k]) * interp,
+                               max(len(env_exp) - 1, 0))] \
+                if len(env_exp) else np.zeros(2, np.float32)
+            scal[2, k] = len(env_padded)
+            env_padded = np.concatenate(
+                [env_padded,
+                 np.broadcast_to(samp, (block, 2)).astype(np.float32)])
+    scal[3, :len(idx)] = (
+        np.round(np.asarray(rec_np['freq_rel'][idx], np.float64)
+                 * 2 ** 32).astype(np.int64) % (1 << 32)
+    ).astype(np.uint32).view(np.int32)
+    scal[4, :len(idx)] = (
+        (np.asarray(rec_np['phase'][idx], np.int64) << 15) % (1 << 32)
+    ).astype(np.uint32).view(np.int32)     # 17-bit word -> 2^32 units
+    scal[5, :len(idx)] = rec_np['amp'][idx]
+    if not len(idx):
+        scal[1, 0] = 0                     # single no-op pulse entry
+
+    return _synthesize_call(jnp.asarray(scal), jnp.asarray(env_padded),
+                            block, n_samples, interpret)

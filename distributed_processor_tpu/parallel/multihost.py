@@ -22,10 +22,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def initialize_multihost(coordinator_address: str = None,
                          num_processes: int = None,
-                         process_id: int = None) -> dict:
-    """Initialise the multi-controller runtime (no-op if single-process
-    or already initialised).  Returns topology info."""
-    if num_processes is not None and num_processes > 1:
+                         process_id: int = None,
+                         auto: bool = False) -> dict:
+    """Initialise the multi-controller runtime.  Returns topology info.
+
+    ``auto=True`` lets JAX auto-detect the cluster (TPU pod slices);
+    explicit coordinator/num_processes/process_id works everywhere else.
+    With neither, this is a no-op suitable for single-process runs."""
+    if auto:
+        jax.distributed.initialize()
+    elif num_processes is not None and num_processes > 1:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)

@@ -152,3 +152,67 @@ def test_stack_window_weights_offsets():
     assert W.shape == (12, 4)
     assert np.all(W[:4, 0] == 1) and np.all(W[4:, 0] == 0)
     assert np.all(W[8:, 2] == 2) and np.all(W[:8, 2] == 0)
+
+
+def _rand_rec(rng, n_pulses, n_clks, spc, env_slots, max_p=16):
+    """Random non-overlapping pulse records on element 0."""
+    pulses, t = [], 2
+    for _ in range(n_pulses):
+        L = int(rng.integers(1, 4))          # env length in 4-sample groups
+        addr = int(rng.integers(0, env_slots - L))
+        t += int(rng.integers(2, 8))
+        pulses.append(dict(
+            gtime=t, env=(L << 12) | addr,
+            phase=int(rng.integers(1 << 17)),
+            freq_rel=float(rng.uniform(0, 0.4)),
+            amp=int(rng.integers(1 << 16)), elem=0))
+        t += (L * 4) // spc + 2
+    return _rec(pulses, max_p=max_p)
+
+
+@pytest.mark.parametrize('seed', range(3))
+def test_waveform_pallas_matches_reference(seed):
+    from distributed_processor_tpu.ops import synthesize_element_pallas
+    rng = np.random.default_rng(seed)
+    spc, n_clks = 4, 256                      # 1024 samples = 2 blocks @512
+    env = (rng.uniform(-1, 1, 64) + 1j * rng.uniform(-1, 1, 64)) * 0.9
+    rec = _rand_rec(rng, 5, n_clks, spc, env_slots=12)
+    want = np.asarray(synthesize_element(rec, env, spc=spc, interp=1,
+                                         n_clks=n_clks))
+    got = np.asarray(synthesize_element_pallas(rec, env, spc=spc, interp=1,
+                                               n_clks=n_clks,
+                                               interpret=True))
+    np.testing.assert_allclose(got, want, atol=2e-3)
+
+
+def test_waveform_pallas_interp_and_cw():
+    from distributed_processor_tpu.ops import synthesize_element_pallas
+    env = np.concatenate([np.ones(4), 0.25 * np.ones(4)]).astype(complex)
+    rec = _rec([
+        dict(gtime=0, env=(ENV_CW_SENTINEL << 12) | 0, phase=0,
+             freq_rel=0.0, amp=0xffff, elem=0),
+        dict(gtime=16, env=(1 << 12) | 1, phase=0, freq_rel=0.0,
+             amp=0xffff, elem=0),
+    ])
+    for interp in (1, 2):
+        want = np.asarray(synthesize_element(rec, env, spc=4, interp=interp,
+                                             n_clks=128))
+        got = np.asarray(synthesize_element_pallas(
+            rec, env, spc=4, interp=interp, n_clks=128, interpret=True))
+        np.testing.assert_allclose(got, want, atol=2e-3,
+                                   err_msg=f'interp={interp}')
+
+
+def test_waveform_pallas_env_overrun_holds_last_sample():
+    """Env window past the table end: both implementations hold the last
+    envelope sample (the reference clamp semantics)."""
+    from distributed_processor_tpu.ops import synthesize_element_pallas
+    env = np.full(8, 0.5, complex)
+    rec = _rec([dict(gtime=0, env=(4 << 12) | 0, phase=0, freq_rel=0.0,
+                     amp=0xffff, elem=0)])     # claims 16 samples, table 8
+    want = np.asarray(synthesize_element(rec, env, spc=4, interp=1,
+                                         n_clks=128))
+    got = np.asarray(synthesize_element_pallas(rec, env, spc=4, interp=1,
+                                               n_clks=128, interpret=True))
+    np.testing.assert_allclose(got, want, atol=2e-3)
+    assert abs(got[12, 0] - 0.5) < 1e-3        # held past the table end
