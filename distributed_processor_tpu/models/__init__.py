@@ -1,8 +1,11 @@
 from .channels import make_channel_config, make_channel_configs
 from .experiments import (active_reset, rabi_program, t1_program,
-                          ramsey_program, loop_shots_program, ghz_program)
+                          ramsey_program, loop_shots_program, ghz_program,
+                          t2_echo_program)
 from .rb import rb_program, rb_sequence, clifford_table
 from .readout import sample_meas_bits, apply_assignment_error, IQReadoutModel
 from .default_qchip import make_default_qchip, make_default_qchip_dict
 from .repetition import (repetition_round_machine_program, repetition_config,
                          majority_lut, corrected_counts)
+from .calibration import (fit_centroids, assignment_matrix,
+                          readout_fidelity, calibrate_readout)

@@ -65,6 +65,20 @@ def ramsey_program(qubit: str, delay_s: float,
     return out
 
 
+def t2_echo_program(qubit: str, delay_s: float) -> list[dict]:
+    """Hahn echo point: X90 - wait/2 - X (echo) - wait/2 - X90, read."""
+    half = {'name': 'delay', 't': float(delay_s) / 2, 'qubit': [qubit]}
+    return [
+        {'name': 'X90', 'qubit': [qubit]},
+        dict(half),
+        {'name': 'X90', 'qubit': [qubit]},
+        {'name': 'X90', 'qubit': [qubit]},
+        dict(half),
+        {'name': 'X90', 'qubit': [qubit]},
+        {'name': 'read', 'qubit': [qubit]},
+    ]
+
+
 def ghz_program(qubits) -> list[dict]:
     """GHZ-state preparation + readout: H on the first qubit, a CNOT
     chain, barrier, read all (uses the CNOT calibrations the default

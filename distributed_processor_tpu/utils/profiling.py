@@ -1,0 +1,51 @@
+"""Profiling helpers: the software replacement for RTL waveform dumps.
+
+The reference profiles by Verilator tracing (`--trace` in every cocotb
+Makefile); here the analogs are (a) the interpreter's ``trace=True``
+instruction trace and (b) the JAX/XLA device profiler wrapped below.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def device_profile(logdir: str):
+    """Capture an XLA device profile (view with TensorBoard/Perfetto)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StageTimer:
+    """Wall-clock stage timing with device synchronisation.
+
+    Example::
+
+        t = StageTimer()
+        out = t.stage('simulate', lambda: simulate_batch(mp, bits))
+        print(t.report())
+    """
+
+    def __init__(self):
+        self.times: dict[str, float] = {}
+
+    def stage(self, name: str, fn):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn())
+        self.times[name] = self.times.get(name, 0.0) \
+            + (time.perf_counter() - t0)
+        return out
+
+    def report(self) -> str:
+        total = sum(self.times.values()) or 1.0
+        lines = [f'{name:20s} {dt * 1000:10.1f} ms  {dt / total:6.1%}'
+                 for name, dt in sorted(self.times.items(),
+                                        key=lambda kv: -kv[1])]
+        return '\n'.join(lines)
