@@ -3,3 +3,4 @@ from .interpreter import (InterpreterConfig, simulate, simulate_batch,
                           ERR_MEAS_OVERFLOW, ERR_FPROC_DEADLOCK,
                           ERR_SYNC_DONE)
 from .oracle import OracleCore, run_oracle
+from .physics import ReadoutPhysics, run_physics_batch

@@ -98,6 +98,12 @@ class InterpreterConfig:
     lut_mask: tuple = ()          # bool per core: LUT address inputs
     lut_table: tuple = ()         # [2^k] entries, bit c = output for core c
     trace: bool = False           # record per-step (pc, time) per core
+    # physics-in-the-loop execution (sim/physics.py): measurement bits
+    # start *invalid* and are resolved by the DSP chain between epochs;
+    # fproc reads whose bit is pending stall the lane until resolve.
+    physics: bool = False
+    drive_elem: int = 0           # element whose pulses rotate the qubit
+    x90_amp: int = 0              # amp word of one quarter turn (0 = off)
     alu_instr_clks: int = 5
     jump_cond_clks: int = 5
     jump_fproc_clks: int = 8
@@ -171,11 +177,22 @@ def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
         meas_avail=jnp.full((B, C, M), INT32_MAX, jnp.int32),
         **({'trace_pc': z(B, C, T), 'trace_time': z(B, C, T)}
            if cfg.trace else {}),
+        # physics mode: classical device co-state (quarter-turn counter)
+        # plus per-measurement pulse-parameter records for the epoch
+        # resolver (sim/physics.py) — the numeric stand-in for the
+        # out-of-repo readout hardware that produces the meas bits
+        # (reference: hdl/fproc_meas.sv meas inputs)
+        **({'qturns': z(B, C), 'meas_state': z(B, C, M),
+            'meas_amp': z(B, C, M), 'meas_phase': z(B, C, M),
+            'meas_freq': z(B, C, M), 'meas_env': z(B, C, M),
+            'meas_gtime': z(B, C, M),
+            'phys_wait': jnp.zeros((B, C), bool)}
+           if cfg.physics else {}),
     )
 
 
 def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
-          cfg: InterpreterConfig) -> dict:
+          meas_valid, cfg: InterpreterConfig) -> dict:
     B, C = st['pc'].shape
     N = soa.shape[1]
     time, offset, regs = st['time'], st['offset'], st['regs']
@@ -210,20 +227,26 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
 
     def _fresh_read(prod_oh):
         """First measurement completing strictly after the request
-        (reference: hdl/core_state_mgr.sv:45-56 WAIT_MEAS)."""
+        (reference: hdl/core_state_mgr.sv:45-56 WAIT_MEAS).  A fired
+        measurement whose bit is still *invalid* (physics pending, not
+        yet demodulated) stalls the read instead of serving it."""
         sel, sel_m = _by_producer(prod_oh)
         mavail_p, bits_p = sel_m(st['meas_avail']), sel_m(meas_bits)
+        valid_p = sel_m(meas_valid.astype(jnp.int32))
         fresh = (mavail_p > req[..., None]) & \
             (jnp.arange(cfg.max_meas)[None, None, :]
              < sel(st['n_meas'])[..., None])
         exists = jnp.any(fresh, axis=-1)
         oh_j = _onehot(jnp.argmax(fresh, axis=-1).astype(jnp.int32),
                        cfg.max_meas)
-        data = jnp.where(exists, _ohsel(bits_p, oh_j), 0)
-        tready = jnp.where(exists,
+        sel_valid = _ohsel(valid_p, oh_j) == 1
+        ready = exists & sel_valid
+        phys = exists & ~sel_valid
+        data = jnp.where(ready, _ohsel(bits_p, oh_j), 0)
+        tready = jnp.where(ready,
                            jnp.maximum(req, _ohsel(mavail_p, oh_j)), req)
         dead = ~exists & (sel(st['done'].astype(jnp.int32)) == 1)
-        return exists | dead, data, tready, dead
+        return ready | dead, data, tready, dead, phys
 
     fid_bad = jnp.zeros((B, C), bool)
     if cfg.fabric == 'sticky':
@@ -232,24 +255,26 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         oh_prod = _onehot(jnp.clip(fid, 0, C - 1), C)
         sel, sel_m = _by_producer(oh_prod)
         mavail_p, bits_p = sel_m(st['meas_avail']), sel_m(meas_bits)
-        f_ready = (sel(st['done'].astype(jnp.int32)) == 1) \
+        valid_p = sel_m(meas_valid.astype(jnp.int32))
+        f_time_ok = (sel(st['done'].astype(jnp.int32)) == 1) \
             | (sel(time) >= req)
         m_cnt = jnp.sum((mavail_p <= req[..., None]).astype(jnp.int32), -1)
-        f_data = jnp.where(
-            m_cnt > 0,
-            _ohsel(bits_p, _onehot(jnp.maximum(m_cnt - 1, 0), cfg.max_meas)),
-            0)
+        oh_latest = _onehot(jnp.maximum(m_cnt - 1, 0), cfg.max_meas)
+        latest_valid = (m_cnt == 0) | (_ohsel(valid_p, oh_latest) == 1)
+        f_ready = f_time_ok & latest_valid
+        f_phys = f_time_ok & ~latest_valid
+        f_data = jnp.where(m_cnt > 0, _ohsel(bits_p, oh_latest), 0)
         f_tready = req
         f_deadlock = jnp.zeros((B, C), bool)
     elif cfg.fabric == 'fresh':
         fid_bad = fid >= C
         oh_prod = _onehot(jnp.clip(fid, 0, C - 1), C)
-        f_ready, f_data, f_tready, f_deadlock = _fresh_read(oh_prod)
+        f_ready, f_data, f_tready, f_deadlock, f_phys = _fresh_read(oh_prod)
     else:  # 'lut' — reference: hdl/fproc_lut.sv + meas_lut.sv
         # func_id 0: own fresh measurement
         own_oh = jnp.broadcast_to(
             jnp.eye(C, dtype=jnp.int32)[None], (B, C, C))
-        o_ready, o_data, o_tready, o_dead = _fresh_read(own_oh)
+        o_ready, o_data, o_tready, o_dead, o_phys = _fresh_read(own_oh)
         # func_id >= 1: the masked cores' latest bits form the address;
         # the read blocks until every masked input's bit is *valid*
         # (reference: meas_lut.sv LUT_WAIT until (mask & valid) == mask)
@@ -262,11 +287,15 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         ok = (st['n_meas'] >= 1)[:, None, :] \
             & (st['done'][:, None, :]
                | (time[:, None, :] >= req[:, :, None]))      # [B, C, C']
-        l_ready = jnp.all(jnp.where(lmask_j[None, None, :], ok, True), -1)
+        l_causal = jnp.all(jnp.where(lmask_j[None, None, :], ok, True), -1)
         oh_last = _onehot(jnp.maximum(st['n_meas'] - 1, 0), cfg.max_meas)
         avail_last = _ohsel(jnp.where(st['meas_avail'] == INT32_MAX, 0,
                                       st['meas_avail']), oh_last)   # [B, C']
         bit = _ohsel(meas_bits, oh_last)                            # [B, C']
+        valid_last = _ohsel(meas_valid.astype(jnp.int32), oh_last)  # [B, C']
+        l_valid = jnp.all(jnp.where(lmask_j[None, None, :],
+                                    (valid_last == 1)[:, None, :], True), -1)
+        l_ready = l_causal & l_valid
         t_lut = jnp.max(jnp.where(lmask_j[None, :], avail_last, 0),
                         axis=-1)                                    # [B]
         addr = jnp.sum(bit[:, None, :] * lmask_j * (1 << jnp.asarray(shifts)),
@@ -280,8 +309,10 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         f_tready = jnp.where(is_own, o_tready,
                              jnp.maximum(req, t_lut[:, None]))
         f_deadlock = is_own & o_dead
+        f_phys = jnp.where(is_own, o_phys, l_causal & ~l_valid)
     f_ready = f_ready | fid_bad
     f_data = jnp.where(fid_bad, 0, f_data)
+    f_phys = f_phys & ~fid_bad
 
     # ---- ALU (in1 mux per reference: hdl/proc.sv:111) ------------------
     in1 = jnp.where(is_fproc, f_data,
@@ -355,6 +386,34 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         (trig + dur + cfg.meas_latency)[..., None], st['meas_avail'])
     n_meas = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
 
+    # ---- physics co-state: classical qubit rotation + meas records -----
+    # The device model is a classical stand-in (the reference has no
+    # physics at all — hardware supplies the bits): each drive-element
+    # pulse adds round(amp / x90_amp) quarter turns; the state bit is the
+    # half-turn parity, floor convention.  Measurement pulses record
+    # their synthesis parameters for the epoch resolver (sim/physics.py).
+    phys_updates = {}
+    if cfg.physics:
+        qturns = st['qturns']
+        if cfg.x90_amp > 0:
+            x90 = jnp.int32(cfg.x90_amp)
+            dq = (2 * pp[..., 3] + x90) // (2 * x90)
+            is_drive = fire & (elem == cfg.drive_elem)
+            qturns = qturns + jnp.where(is_drive, dq, 0)
+        state_bit = (qturns >> 1) & 1
+        mwr = (oh_mslot == 1) & is_meas_pulse[..., None]
+        phys_updates = dict(
+            qturns=qturns,
+            meas_state=jnp.where(mwr, state_bit[..., None],
+                                 st['meas_state']),
+            meas_amp=jnp.where(mwr, pp[..., 3:4], st['meas_amp']),
+            meas_phase=jnp.where(mwr, pp[..., 1:2], st['meas_phase']),
+            meas_freq=jnp.where(mwr, pp[..., 2:3], st['meas_freq']),
+            meas_env=jnp.where(mwr, pp[..., 0:1], st['meas_env']),
+            meas_gtime=jnp.where(mwr, trig[..., None], st['meas_gtime']),
+            phys_wait=is_fproc & live & f_phys & ~f_ready,
+        )
+
     # ---- phase reset record --------------------------------------------
     is_rst = (kind == isa.K_PULSE_RESET) & adv
     oh_rslot = _onehot(jnp.minimum(st['n_resets'], cfg.max_resets - 1),
@@ -427,7 +486,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                 done=st['done'] | is_done, err=err, pp=pp, n_pulses=n_pulses,
                 rec=rec, rec_fire=rec_fire, rec_slot=rec_slot,
                 n_resets=n_resets, rst_time=rst_time,
-                n_meas=n_meas, meas_avail=meas_avail, **tr)
+                n_meas=n_meas, meas_avail=meas_avail, **phys_updates, **tr)
 
 
 def _compact_records(rec, rec_fire, rec_slot, max_pulses: int) -> dict:
@@ -450,33 +509,47 @@ def _compact_records(rec, rec_fire, rec_slot, max_pulses: int) -> dict:
     return rec_out
 
 
-def _run_batch(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
-               n_cores: int, init_regs=None) -> dict:
-    """Execute a shot batch: meas_bits ``[B, n_cores, max_meas]``."""
-    if cfg.fabric == 'lut' and (len(cfg.lut_mask) != n_cores
-                                or not cfg.lut_table):
-        raise ValueError("fabric='lut' needs lut_mask (len n_cores) and "
-                         "lut_table in the InterpreterConfig")
-    B = meas_bits.shape[0]
-    st0 = _init_state(B, n_cores, cfg, init_regs)
-    st0['_steps'] = jnp.int32(0)
+def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
+               cfg: InterpreterConfig) -> dict:
+    """Run the instruction while_loop until every shot is done (or, in
+    physics mode, paused waiting for a measurement bit to be resolved).
 
+    ``st0`` must carry ``_steps`` (total step budget, shared across
+    physics epochs) and, in physics mode, ``paused`` [B] bool.
+    """
     def cond(st):
-        return (~jnp.all(st['done'])) & (st['_steps'] < cfg.max_steps)
+        settled = jnp.all(st['done'], axis=-1)
+        if cfg.physics:
+            settled = settled | st['paused']
+        return (~jnp.all(settled)) & (st['_steps'] < cfg.max_steps)
 
     def body(st):
         steps = st.pop('_steps')
-        st2 = _step(st, steps, soa, spc, interp, sync_part, meas_bits, cfg)
-        # global-deadlock detection per shot: no live core changed state
+        paused = st.pop('paused') if cfg.physics else None
+        st2 = _step(st, steps, soa, spc, interp, sync_part, meas_bits,
+                    meas_valid, cfg)
+        # quiescence detection per shot: no live core changed state
         same = jnp.all((st2['pc'] == st['pc']) & (st2['time'] == st['time'])
                        & (st2['done'] == st['done']), axis=-1)   # [B]
-        st2['err'] = jnp.where(same[:, None] & ~st2['done'],
+        if cfg.physics:
+            # quiescent + a core awaiting an unresolved measurement bit
+            # = pause for the epoch resolver; quiescent without one is a
+            # genuine deadlock as in the non-physics engine
+            pending = jnp.any(st2['phys_wait'] & ~st2['done'], axis=-1)
+            st2['paused'] = paused | (same & pending)
+            hard = same & ~pending
+        else:
+            hard = same
+        st2['err'] = jnp.where(hard[:, None] & ~st2['done'],
                                st2['err'] | ERR_FPROC_DEADLOCK, st2['err'])
-        st2['done'] = st2['done'] | same[:, None]
+        st2['done'] = st2['done'] | hard[:, None]
         st2['_steps'] = steps + 1
         return st2
 
-    st = jax.lax.while_loop(cond, body, st0)
+    return jax.lax.while_loop(cond, body, st0)
+
+
+def _finalize(st: dict, cfg: InterpreterConfig) -> dict:
     steps = st.pop('_steps')
     st.update(_compact_records(st.pop('rec'), st.pop('rec_fire'),
                                st.pop('rec_slot'), cfg.max_pulses))
@@ -484,6 +557,30 @@ def _run_batch(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
     st['steps'] = steps
     st['incomplete'] = ~jnp.all(st['done'])
     return st
+
+
+def _check_fabric(cfg: InterpreterConfig, n_cores: int):
+    if cfg.fabric == 'lut' and (len(cfg.lut_mask) != n_cores
+                                or not cfg.lut_table):
+        raise ValueError("fabric='lut' needs lut_mask (len n_cores) and "
+                         "lut_table in the InterpreterConfig")
+
+
+def _run_batch(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
+               n_cores: int, init_regs=None) -> dict:
+    """Execute a shot batch: meas_bits ``[B, n_cores, max_meas]``
+    (injected a priori and all valid — the cocotb-style path)."""
+    _check_fabric(cfg, n_cores)
+    B = meas_bits.shape[0]
+    st0 = _init_state(B, n_cores, cfg, init_regs)
+    st0['_steps'] = jnp.int32(0)
+    if cfg.physics:
+        st0['paused'] = jnp.zeros((B,), bool)
+    meas_valid = jnp.ones(meas_bits.shape, bool)
+    st = _exec_loop(st0, soa, spc, interp, sync_part, meas_bits, meas_valid,
+                    cfg)
+    st.pop('paused', None)
+    return _finalize(st, cfg)
 
 
 def _run(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,

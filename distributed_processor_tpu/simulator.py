@@ -70,18 +70,32 @@ class Simulator:
     # -- execution -------------------------------------------------------
 
     def run(self, program, shots: int = 1, meas_bits=None, p1=None,
-            key=None, init_regs=None, **cfg_kw) -> dict:
+            key=None, init_regs=None, physics=None, **cfg_kw) -> dict:
         """Compile (if needed) and execute ``shots`` shots.
 
-        Measurement bits come from (in priority order) ``meas_bits``
-        (``[shots, n_cores, n_meas]``), or Bernoulli sampling with
-        per-qubit probabilities ``p1`` (needs ``key``), or zeros.
+        Measurement bits come from (in priority order) ``physics`` (a
+        :class:`~.sim.physics.ReadoutPhysics` — bits emerge in-sim from
+        synthesized + demodulated readout windows, nothing injected),
+        ``meas_bits`` (``[shots, n_cores, n_meas]``), Bernoulli sampling
+        with per-qubit probabilities ``p1`` (needs ``key``), or zeros.
         The result dict carries the machine program under ``'_mp'`` for
         waveform rendering.
         """
         mp = program if isinstance(program, MachineProgram) \
             else self.compile(program)
         cfg = self.interpreter_config(mp, **cfg_kw)
+        if physics is not None:
+            if meas_bits is not None or p1 is not None:
+                raise ValueError(
+                    'physics= resolves measurement bits in-sim; '
+                    'meas_bits=/p1= cannot also be given')
+            from .sim.physics import run_physics_batch
+            out = dict(run_physics_batch(
+                mp, physics, key if key is not None else jax.random.PRNGKey(0),
+                shots, init_regs=init_regs, cfg=cfg))
+            out['_mp'] = mp
+            out['_cfg'] = cfg
+            return out
         if meas_bits is None and p1 is not None:
             from .models.readout import sample_meas_bits
             key = key if key is not None else jax.random.PRNGKey(0)
