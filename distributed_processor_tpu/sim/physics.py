@@ -165,8 +165,7 @@ def _synth_windows(st: dict, tables, W: int):
     return y_i, y_q
 
 
-def _resolve(st: dict, bits, valid, key, tables, response,
-             cfg: InterpreterConfig, W: int):
+def _resolve(st: dict, bits, valid, key, tables, response, W: int):
     """Demodulate every fired-but-unresolved readout window into a bit.
 
     The measurement contract being implemented numerically is the
@@ -223,13 +222,16 @@ def _run_physics_jit(soa, spc, interp, sync_part, qturns0, init_regs,
 
     def cond(carry):
         st, bits, valid, ep = carry
-        return (~jnp.all(st['done'])) & (ep < max_epochs)
+        # stop on completion, epoch exhaustion, or a spent step budget
+        # (a shot that ran out of steps can never finish — don't burn
+        # further full-batch resolve passes on it)
+        return (~jnp.all(st['done'])) & (ep < max_epochs) \
+            & (st['_steps'] < cfg.max_steps)
 
     def body(carry):
         st, bits, valid, ep = carry
         st = _exec_loop(st, soa, spc, interp, sync_part, bits, valid, cfg)
-        bits, valid = _resolve(st, bits, valid, key, tables, response,
-                               cfg, W)
+        bits, valid = _resolve(st, bits, valid, key, tables, response, W)
         st = dict(st, paused=jnp.zeros_like(st['paused']))
         return st, bits, valid, ep + 1
 
@@ -241,6 +243,32 @@ def _run_physics_jit(soa, spc, interp, sync_part, qturns0, init_regs,
     out['meas_bits_valid'] = valid
     out['epochs'] = ep
     return out
+
+
+def physics_config(base: InterpreterConfig, model: ReadoutPhysics,
+                   **kw) -> InterpreterConfig:
+    """The effective interpreter config of a physics run.
+
+    The :class:`ReadoutPhysics` model is authoritative for the
+    device-model fields (``x90_amp``/``drive_elem``/``meas_elem``);
+    conflicting values on the base config or in ``kw`` raise rather
+    than being silently overridden.
+    """
+    base = base if base is not None else InterpreterConfig()
+    defaults = InterpreterConfig()
+    overrides = {}
+    for name in ('x90_amp', 'drive_elem', 'meas_elem'):
+        if name in kw:
+            raise ValueError(
+                f'{name} is set on the ReadoutPhysics model for physics '
+                f'runs, not in the interpreter config')
+        mv, bv = int(getattr(model, name)), int(getattr(base, name))
+        if bv != int(getattr(defaults, name)) and bv != mv:
+            raise ValueError(
+                f'conflicting {name}: interpreter config has {bv}, '
+                f'ReadoutPhysics has {mv}; set it on the model')
+        overrides[name] = mv
+    return replace(base, physics=True, **overrides, **kw)
 
 
 def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
@@ -260,10 +288,7 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     ``qturns``/``meas_state`` (classical device trajectory), and
     ``epochs`` (resolve rounds taken).
     """
-    base = cfg if cfg is not None else InterpreterConfig()
-    cfg = replace(base, physics=True, x90_amp=int(model.x90_amp),
-                  drive_elem=int(model.drive_elem),
-                  meas_elem=int(model.meas_elem), **kw)
+    cfg = physics_config(cfg, model, **kw)
     _check_fabric(cfg, mp.n_cores)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     env_stack, freq_stack, spc_m, interp_m, w_auto = \

@@ -89,12 +89,12 @@ class Simulator:
                 raise ValueError(
                     'physics= resolves measurement bits in-sim; '
                     'meas_bits=/p1= cannot also be given')
-            from .sim.physics import run_physics_batch
+            from .sim.physics import run_physics_batch, physics_config
             out = dict(run_physics_batch(
                 mp, physics, key if key is not None else jax.random.PRNGKey(0),
                 shots, init_regs=init_regs, cfg=cfg))
             out['_mp'] = mp
-            out['_cfg'] = cfg
+            out['_cfg'] = physics_config(cfg, physics)  # effective config
             return out
         if meas_bits is None and p1 is not None:
             from .models.readout import sample_meas_bits

@@ -373,3 +373,34 @@ def test_instruction_trace_export():
     assert pcs == [0, 1, 2]
     times = list(np.asarray(out['trace_time'][0, :steps]))
     assert times[0] == 2 and times[1] == 7   # INIT_TIME, +alu_instr_clks
+
+
+def test_large_program_gather_fetch_matches_oracle():
+    """A deep RB program (past the one-hot/gather fetch crossover) must
+    execute identically to the scalar oracle — pins the gather fetch
+    path (interpreter._FETCH_ONEHOT_MAX)."""
+    import numpy as np
+    from distributed_processor_tpu.simulator import Simulator
+    from distributed_processor_tpu.models.rb import rb_program
+    from distributed_processor_tpu.sim.interpreter import _FETCH_ONEHOT_MAX
+    from distributed_processor_tpu.sim.oracle import run_oracle
+
+    sim = Simulator(n_qubits=1)
+    depth = 80
+    mp = sim.compile(rb_program(['Q0'], depth, seed=11))
+    assert mp.n_instr > _FETCH_ONEHOT_MAX, 'program too small for the test'
+    out = sim.run(mp, shots=2, max_steps=mp.n_instr + 32,
+                  max_pulses=int(mp.max_pulses_per_core(1)) + 4,
+                  max_meas=4, max_resets=2)
+    assert not bool(out['incomplete'])
+    assert np.all(np.asarray(out['err']) == 0)
+    o = run_oracle(mp)
+    n = int(np.asarray(out['n_pulses'])[0, 0])
+    assert n == len(o['pulses'][0]) > depth
+    for fld, key in (('gtime', 'rec_gtime'), ('amp', 'rec_amp'),
+                     ('phase', 'rec_phase'), ('env', 'rec_env'),
+                     ('freq', 'rec_freq'), ('elem', 'rec_elem')):
+        np.testing.assert_array_equal(
+            np.asarray(out[key])[0, 0, :n],
+            [p[fld] for p in o['pulses'][0]], err_msg=fld)
+    np.testing.assert_array_equal(np.asarray(out['qclk'])[0], o['qclk'])

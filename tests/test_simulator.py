@@ -99,3 +99,29 @@ def test_waveform_batched_shot_selection(sim2):
     e0 = np.abs(iq_to_complex(wf0[0][0])).sum()
     e3 = np.abs(iq_to_complex(wf3[0][0])).sum()
     assert e3 > e0
+
+
+def test_deep_on_device_loop_bounded_memory(sim2):
+    """A 256-iteration on-device shot loop executes without the record
+    state scaling with step count (slot-indexed records: [B,C,P,F] is
+    the only pulse buffer), and matches the scalar oracle."""
+    from distributed_processor_tpu.models.experiments import loop_shots_program
+    from distributed_processor_tpu.sim.oracle import run_oracle
+
+    sim = Simulator(n_qubits=1)
+    n_iter = 256
+    prog = loop_shots_program([{'name': 'X90', 'qubit': ['Q0']}],
+                              n_iter, scope=['Q0'])
+    mp = sim.compile(prog)
+    out = sim.run(mp, shots=4, max_steps=16 * (n_iter + 2),
+                  max_pulses=n_iter + 8, max_meas=1, max_resets=2)
+    assert not bool(out['incomplete'])
+    assert np.all(np.asarray(out['err']) == 0)
+    o = run_oracle(mp, max_steps=16 * (n_iter + 2))
+    n_eng = int(np.asarray(out['n_pulses'])[0, 0])
+    assert n_eng == len(o['pulses'][0]) >= n_iter
+    # per-iteration schedules repeat: pulse times advance by a fixed delta
+    gt = np.asarray(out['rec_gtime'])[0, 0, :n_eng]
+    deltas = np.diff(gt)
+    assert np.all(deltas == deltas[0])
+    assert np.array_equal(gt, [p['gtime'] for p in o['pulses'][0]])
