@@ -19,8 +19,27 @@ try:
 except ImportError:      # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
+from .. import isa
 from ..sim.interpreter import (InterpreterConfig, _program_constants,
                                _run_batch, _pad_meas)
+
+
+def _shotwise_init_regs(init_regs, n_shots, n_cores):
+    """Normalize ``init_regs`` to ``[n_shots, n_cores, N_REGS]`` int32,
+    broadcasting the 2-D per-core form the way ``simulate_batch`` does
+    (shard_map shards axis 0, so it must be the shot axis)."""
+    if init_regs is None:
+        return jnp.zeros((n_shots, n_cores, isa.N_REGS), jnp.int32)
+    init_regs = jnp.asarray(init_regs, jnp.int32)
+    if init_regs.ndim == 2:
+        init_regs = jnp.broadcast_to(init_regs[None],
+                                     (n_shots,) + init_regs.shape)
+    if init_regs.shape[0] != n_shots:
+        raise ValueError(
+            f'init_regs leading axis {init_regs.shape[0]} != n_shots '
+            f'{n_shots} (pass [n_shots, n_cores, n_regs] or the 2-D '
+            f'per-core form)')
+    return init_regs
 
 
 def sharded_simulate(mp, meas_bits, mesh, init_regs=None,
@@ -44,10 +63,8 @@ def sharded_simulate(mp, meas_bits, mesh, init_regs=None,
         out.pop('incomplete')
         return out
 
-    if init_regs is None:
-        init_regs = jnp.zeros((meas_bits.shape[0], mp.n_cores, 16),
-                              jnp.int32)
-    init_regs = jnp.asarray(init_regs, jnp.int32)
+    init_regs = _shotwise_init_regs(init_regs, meas_bits.shape[0],
+                                    mp.n_cores)
 
     fn = shard_map(local, mesh=mesh,
                    in_specs=(P('dp'), P('dp')), out_specs=P('dp'),
@@ -69,8 +86,11 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)
     n_shots = meas_bits.shape[0]
 
-    def local(mb):
-        out = _run_batch(soa, spc, interp, sync_part, mb, cfg, mp.n_cores)
+    init_regs = _shotwise_init_regs(init_regs, n_shots, mp.n_cores)
+
+    def local(mb, ir):
+        out = _run_batch(soa, spc, interp, sync_part, mb, cfg,
+                         mp.n_cores, ir)
         pulse_sum = jnp.sum(out['n_pulses'], axis=0)      # [n_cores]
         err_shots = jnp.sum(jnp.any(out['err'] != 0, axis=1))
         qclk_sum = jnp.sum(out['qclk'], axis=0)
@@ -78,9 +98,9 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
                      qclk_sum=qclk_sum)
         return jax.tree.map(lambda x: jax.lax.psum(x, 'dp'), stats)
 
-    fn = shard_map(local, mesh=mesh, in_specs=(P('dp'),),
+    fn = shard_map(local, mesh=mesh, in_specs=(P('dp'), P('dp')),
                    out_specs=P(), check_vma=False)
-    out = jax.jit(fn)(meas_bits)
+    out = jax.jit(fn)(meas_bits, init_regs)
     return dict(mean_pulses=out['pulse_sum'] / n_shots,
                 err_rate=out['err_shots'] / n_shots,
                 mean_qclk=out['qclk_sum'] / n_shots)

@@ -35,6 +35,15 @@ support both fabric semantics present in the reference gateware:
 * ``'fresh'`` — block until the first measurement completing strictly
   after the read was issued (reference: hdl/core_state_mgr.sv:45-56
   WAIT_MEAS).
+
+Reads past the supplied injected-bit budget return 0, matching the
+vector engine's zero-padding (the cocotb injection strategy never
+supplies fewer bits than the program consumes; padding keeps the two
+engines bit-identical when a randomized program over-reads).
+
+All time arithmetic wraps at 32 bits (hardware counter width, matching
+the int32 JAX engine): ``qclk``/``time``/``offset`` comparisons follow
+two's-complement semantics once a timeline passes 2^31.
 """
 
 from __future__ import annotations
@@ -143,10 +152,9 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
     def _fresh(core: OracleCore, prod: OracleCore, req: int):
         for m, t in enumerate(prod.meas_avail):
             if t > req:
-                if m >= meas_bits.shape[1]:
-                    core.err.append('meas_overflow')
-                    return True, 0, req
-                return True, int(meas_bits[cores.index(prod), m]), max(req, t)
+                bit = 0 if m >= meas_bits.shape[1] \
+                    else int(meas_bits[cores.index(prod), m])   # zero-pad
+                return True, bit, max(req, t)
         if prod.done:
             core.err.append('fproc_deadlock')
             return True, 0, req
@@ -171,8 +179,7 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
             for rank, i in enumerate(masked):
                 m = len(cores[i].meas_avail) - 1
                 if m >= meas_bits.shape[1]:
-                    core.err.append('meas_overflow')
-                    bit = 0
+                    bit = 0               # zero-pad (see module doc)
                 else:
                     bit = int(meas_bits[i, m])
                 addr |= bit << rank
@@ -186,7 +193,8 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
             if not (prod.done or prod.time >= req):
                 return False, 0, 0
             m = sum(1 for t in prod.meas_avail if t <= req)
-            data = int(meas_bits[func_id, m - 1]) if m > 0 else 0
+            data = int(meas_bits[func_id, m - 1]) \
+                if 0 < m <= meas_bits.shape[1] else 0   # zero-pad past budget
             return True, data, req
         elif fabric == 'fresh':
             return _fresh(core, prod, req)
@@ -206,8 +214,8 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
                 if sync_part[i] and c.done:
                     c.err.append('sync_done')
                 if at_sync[i]:
-                    c.offset = release + QCLK_RST_DELAY
-                    c.time = release + QCLK_RST_DELAY
+                    c.offset = _i32(release + QCLK_RST_DELAY)
+                    c.time = _i32(release + QCLK_RST_DELAY)
                     c.pc += 1
             continue
 
@@ -232,19 +240,19 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
                         c.pulse_params[name] = val & PULSE_FIELD_MASK[name]
                 if kind == isa.K_PULSE_TRIG:
                     cmd_time = int(np.int64(soa.cmd_time[ci, i]) & MASK32)
-                    trig = c.offset + cmd_time
+                    trig = _i32(c.offset + cmd_time)
                     if trig < c.time:
                         c.err.append('missed_trig')
                         trig = c.time
                     elem = c.pulse_params['cfg'] & 0b11
                     dur = dur_of(ci, elem, c.pulse_params['env'])
-                    c.pulses.append(dict(c.pulse_params, qtime=cmd_time,
+                    c.pulses.append(dict(c.pulse_params, qtime=_i32(cmd_time),
                                          gtime=trig, elem=elem, dur=dur))
                     if elem == meas_elem:
-                        c.meas_avail.append(trig + dur + meas_latency)
-                    c.time = trig + cfg.pulse_load_clks
+                        c.meas_avail.append(_i32(trig + dur + meas_latency))
+                    c.time = _i32(trig + cfg.pulse_load_clks)
                 else:
-                    c.time += cfg.pulse_regwrite_clks
+                    c.time = _i32(c.time + cfg.pulse_regwrite_clks)
                 c.pc += 1
 
             elif kind == isa.K_REG_ALU:
@@ -252,11 +260,11 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
                     else int(soa.imm[ci, i])
                 in1 = c.regs[int(soa.in1_reg[ci, i])]
                 c.regs[int(soa.out_reg[ci, i])] = alu(int(soa.alu_op[ci, i]), in0, in1)
-                c.time += cfg.alu_instr_clks
+                c.time = _i32(c.time + cfg.alu_instr_clks)
                 c.pc += 1
 
             elif kind == isa.K_JUMP_I:
-                c.time += cfg.jump_cond_clks
+                c.time = _i32(c.time + cfg.jump_cond_clks)
                 c.pc = int(soa.jump_addr[ci, i])
 
             elif kind == isa.K_JUMP_COND:
@@ -264,7 +272,7 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
                     else int(soa.imm[ci, i])
                 in1 = c.regs[int(soa.in1_reg[ci, i])]
                 res = alu(int(soa.alu_op[ci, i]), in0, in1)
-                c.time += cfg.jump_cond_clks
+                c.time = _i32(c.time + cfg.jump_cond_clks)
                 c.pc = int(soa.jump_addr[ci, i]) if res & 1 else c.pc + 1
 
             elif kind in (isa.K_ALU_FPROC, isa.K_JUMP_FPROC):
@@ -274,7 +282,7 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
                 in0 = c.regs[int(soa.in0_reg[ci, i])] if soa.in0_is_reg[ci, i] \
                     else int(soa.imm[ci, i])
                 res = alu(int(soa.alu_op[ci, i]), in0, data)
-                c.time = t_ready + cfg.jump_fproc_clks
+                c.time = _i32(t_ready + cfg.jump_fproc_clks)
                 if kind == isa.K_ALU_FPROC:
                     c.regs[int(soa.out_reg[ci, i])] = res
                     c.pc += 1
@@ -286,8 +294,8 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
                     else int(soa.imm[ci, i])
                 # qclk loads the ALU result (in1 = current qclk) with the
                 # hardware pipeline compensation (reference: hdl/qclk.v:17)
-                c.offset = c.time - alu(int(soa.alu_op[ci, i]), in0, c.qclk)
-                c.time += cfg.alu_instr_clks
+                c.offset = _i32(c.time - alu(int(soa.alu_op[ci, i]), in0, c.qclk))
+                c.time = _i32(c.time + cfg.alu_instr_clks)
                 c.pc += 1
 
             elif kind == isa.K_DONE:
@@ -295,15 +303,15 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
 
             elif kind == isa.K_PULSE_RESET:
                 c.resets.append(c.time)
-                c.time += cfg.pulse_regwrite_clks
+                c.time = _i32(c.time + cfg.pulse_regwrite_clks)
                 c.pc += 1
 
             elif kind == isa.K_IDLE:
-                end = c.offset + int(np.int64(soa.cmd_time[ci, i]) & MASK32)
+                end = _i32(c.offset + int(np.int64(soa.cmd_time[ci, i]) & MASK32))
                 if c.time > end:
                     c.err.append('missed_idle')
                     end = c.time
-                c.time = end + cfg.pulse_load_clks
+                c.time = _i32(end + cfg.pulse_load_clks)
                 c.pc += 1
 
             else:

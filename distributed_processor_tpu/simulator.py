@@ -126,6 +126,10 @@ class Simulator:
         ``shot`` to select one shot.
         """
         mp: MachineProgram = out['_mp']
+        if shot is None and np.asarray(out['n_pulses']).ndim == 2:
+            raise ValueError(
+                'batched run: pass shot= to select which shot to render '
+                '(n_pulses has a leading shot axis)')
         sel = (lambda a: np.asarray(a)) if shot is None \
             else (lambda a: np.asarray(a)[shot])
         n_pulses = sel(out['n_pulses'])

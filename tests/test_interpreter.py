@@ -278,6 +278,32 @@ def test_jax_matches_oracle_random_programs(seed):
             np.testing.assert_array_equal(got, want, err_msg=f'core{c} {k}')
 
 
+def test_time_wrap_int32_parity():
+    """Past 2^31 the 32-bit hardware counters wrap; engine and oracle
+    must diverge identically (two's-complement semantics, oracle doc)."""
+    cmds = [
+        isa.alu_cmd('inc_qclk', 'i', 0x7ff00000),
+        isa.alu_cmd('inc_qclk', 'i', 0x7ff00000),     # qclk wraps negative
+        isa.pulse_cmd(freq_word=1, cfg_word=0, env_word=(2 << 12),
+                      cmd_time=0xffe00100),           # trig in wrapped region
+        isa.alu_cmd('reg_alu', 'i', 0x7fffffff, 'add', 0, write_reg_addr=0),
+        isa.done_cmd(),
+    ]
+    prog = mp_of(cmds)
+    jx = simulate(prog, max_pulses=4)
+    orc = run_oracle(prog)
+    np.testing.assert_array_equal(np.asarray(jx['time']), orc['time'])
+    np.testing.assert_array_equal(np.asarray(jx['qclk']), orc['qclk'])
+    np.testing.assert_array_equal(np.asarray(jx['regs']), orc['regs'])
+    assert int(jx['qclk'][0]) < 0                     # wrap actually happened
+    n = int(jx['n_pulses'][0])
+    assert n == len(orc['pulses'][0])
+    for k in ('qtime', 'gtime'):
+        np.testing.assert_array_equal(
+            np.asarray(jx['rec_' + k][0, :n]),
+            np.array([p[k] for p in orc['pulses'][0]], dtype=int))
+
+
 def test_batched_shots_divergent_control_flow():
     # active reset over a shot batch: per-shot branch divergence
     cmds = [

@@ -4,7 +4,7 @@ import numpy as np
 
 from distributed_processor_tpu.parallel import (
     swept_pulse_machine_program, grid_init_regs, sweep_cfg, make_mesh,
-    sharded_simulate)
+    sharded_simulate, sweep_stats)
 from distributed_processor_tpu.sim import simulate_batch
 
 
@@ -43,3 +43,36 @@ def test_grid_sweep_sharded_over_mesh():
                                   np.asarray(local['rec_amp']))
     np.testing.assert_array_equal(np.asarray(out['rec_gtime']),
                                   np.asarray(local['rec_gtime']))
+
+
+def test_sweep_stats_uses_init_regs():
+    """Regression: sweep statistics must see the per-point register file,
+    not an all-zero one (advisor finding).  Register 2 gates a branch
+    around the pulse, so mean_pulses depends on init_regs."""
+    from distributed_processor_tpu import isa
+    from distributed_processor_tpu.decoder import machine_program_from_cmds
+    from distributed_processor_tpu.sim.oracle import START_NCLKS
+
+    n_cores = 2
+    cmds = [
+        isa.alu_cmd('jump_cond', 'r', 2, 'id0', jump_cmd_ptr=2),
+        isa.pulse_cmd(freq_word=0, phase_word=0, amp_word=0x8000,
+                      env_word=(3 << 12), cfg_word=0,
+                      cmd_time=START_NCLKS + 8),
+        isa.done_cmd(),
+    ]
+    mp = machine_program_from_cmds([list(cmds) for _ in range(n_cores)])
+    cfg = sweep_cfg(mp, n_pulses_per_core=2)
+    # 4 sweep points: reg2 = 0, 1, 0, 1  ->  pulse plays on points 0 and 2
+    regs = np.zeros((4, n_cores, isa.N_REGS), dtype=np.int32)
+    regs[1, :, 2] = 1
+    regs[3, :, 2] = 1
+    bits = np.zeros((4, n_cores, cfg.max_meas), int)
+    mesh = make_mesh(n_dp=4)
+    stats = sweep_stats(mp, bits, mesh, init_regs=regs, cfg=cfg)
+    assert float(stats['err_rate']) == 0.0
+    np.testing.assert_allclose(np.asarray(stats['mean_pulses']),
+                               np.full(n_cores, 0.5))
+    local = simulate_batch(mp, bits, init_regs=regs, cfg=cfg)
+    np.testing.assert_array_equal(np.asarray(local['n_pulses']),
+                                  [[1, 1], [0, 0], [1, 1], [0, 0]])
