@@ -1,22 +1,38 @@
 #!/usr/bin/env python
 """Headline benchmark: 8-qubit active-reset + randomized-benchmarking
-sweep on one chip.
+sweep on one chip, with the measurement loop closed by the real DSP
+chain (nothing injected).
 
-Pipeline measured per batch (steady state, post-jit):
+Measured per batch (steady state, post-jit), all inside ONE jitted XLA
+computation (sim/physics.py epoch loop):
 
-  measurement-bit sampling -> batched ISA interpretation (per-shot
-  divergent control flow through the active-reset branch) -> IQ readout
-  model -> discrimination
+  thermal init-state sampling -> batched ISA interpretation (per-shot
+  divergent control flow) -> for every fired readout window: waveform
+  synthesis (envelope playback + phase-coherent carrier) -> state-
+  dependent channel response + per-sample ADC noise -> matched-filter
+  demodulation -> state discrimination -> the emergent bits feed the
+  fproc fabric and resolve the active-reset branches -> execution
+  resumes until all shots complete.
 
-Prints ONE JSON line: shots/sec/chip, with vs_baseline relative to the
-north-star target of 1e6 shots in 60 s (BASELINE.md) — there is no
-reference number to compare against (the reference publishes none; it
-executes shots on FPGA hardware one at a time, host-sequenced).
+This is the numeric analog of the reference's hardware loop (rdlo pulse
+-> external demod -> meas/meas_valid -> core_state_mgr.sv:45-56 ->
+branch); the readout word contract is asmparse.py:46-86.
+
+Before timing, both Pallas kernels (ops/waveform_pallas.py synthesis,
+ops/demod.demod_iq_pallas) run COMPILED (interpret=False) on the bench
+device and are parity-checked against their XLA reference
+implementations; the result is recorded in the detail dict.
+
+Prints ONE JSON line: shots/sec/chip, vs_baseline relative to the
+north-star target of 1e6 shots in 60 s (BASELINE.md) — the reference
+publishes no numbers (it executes shots one at a time on FPGA hardware,
+host-sequenced).
 
 Env knobs: BENCH_SHOTS (total, default 1048576), BENCH_BATCH (per-device
-batch, default 262144), BENCH_DEPTH (RB depth, default 12).  Batch size
-matters: big batches amortise the per-step while_loop dispatch; 262144
-is the largest whose loop-carried record state fits HBM comfortably.
+batch, default 131072 — the largest fitting HBM with the loop-carried
+record state), BENCH_DEPTH (RB depth, default 12), BENCH_SIGMA (ADC
+noise, default 0.05), BENCH_CHUNK (matched-filter resolve chunk in
+samples, default 512 — smaller trades speed for peak memory).
 """
 
 import json
@@ -33,11 +49,10 @@ import jax.numpy as jnp
 
 from distributed_processor_tpu.pipeline import compile_to_machine
 from distributed_processor_tpu.models import (
-    active_reset, rb_program, make_default_qchip, sample_meas_bits,
-    IQReadoutModel)
-from distributed_processor_tpu.sim.interpreter import (
-    InterpreterConfig, _program_constants, _run_batch)
-from distributed_processor_tpu.ops.demod import discriminate
+    active_reset, rb_program, make_default_qchip)
+from distributed_processor_tpu.sim.interpreter import InterpreterConfig
+from distributed_processor_tpu.sim.physics import (
+    ReadoutPhysics, run_physics_batch)
 
 NORTH_STAR_SHOTS_PER_SEC = 1e6 / 60.0
 
@@ -49,14 +64,30 @@ def build_machine_program(n_qubits: int, depth: int):
     return compile_to_machine(program, qchip, n_qubits=n_qubits)
 
 
+def pallas_compiled_parity() -> bool:
+    """Run both Pallas kernels on this device and assert parity with the
+    XLA reference implementations (shared assertions:
+    ops/selftest.py, also run by tests/test_tpu_kernels.py).  Compiled
+    (interpret=False) on TPU; interpret mode elsewhere so the bench
+    still runs."""
+    from distributed_processor_tpu.ops.selftest import pallas_parity_check
+    interpret = jax.devices()[0].platform != 'tpu'
+    pallas_parity_check(interpret)
+    return not interpret
+
+
 def main():
     n_qubits = int(os.environ.get('BENCH_QUBITS', 8))
     depth = int(os.environ.get('BENCH_DEPTH', 12))
     total_shots = int(os.environ.get('BENCH_SHOTS', 1048576))
-    batch = int(os.environ.get('BENCH_BATCH', 262144))
+    batch = int(os.environ.get('BENCH_BATCH', 131072))
+    sigma = float(os.environ.get('BENCH_SIGMA', 0.05))
+    chunk = int(os.environ.get('BENCH_CHUNK', 512))
     batch = min(batch, total_shots)
     n_batches = max(total_shots // batch, 1)
     total_shots = batch * n_batches
+
+    pallas_compiled = pallas_compiled_parity()
 
     t0 = time.perf_counter()
     mp = build_machine_program(n_qubits, depth)
@@ -64,28 +95,20 @@ def main():
 
     n_instr = mp.n_instr
     cfg = InterpreterConfig(
-        max_steps=n_instr + 16,
+        max_steps=2 * n_instr + 64,
         max_pulses=int(mp.max_pulses_per_core(1)) + 4,
-        max_meas=4, max_resets=2)
-    soa, spc, interp, sync_part = _program_constants(mp, cfg)
+        max_meas=2, max_resets=2)
+    model = ReadoutPhysics(sigma=sigma, p1_init=0.15, resolve_chunk=chunk)
     C = mp.n_cores
-
-    readout = IQReadoutModel(
-        centers0=np.full(C, 1.0 + 0.0j), centers1=np.full(C, -0.6 + 0.8j),
-        sigma=0.3)
 
     @jax.jit
     def step(key):
-        kb, ki = jax.random.split(key)
-        bits = sample_meas_bits(kb, jnp.full((C,), 0.15), batch, cfg.max_meas)
-        out = _run_batch(soa, spc, interp, sync_part, bits, cfg, C)
-        # readout physics on the final measurement of each core
-        states = bits[:, :, 1]
-        iq = readout.sample_iq(ki, states)
-        final_bits = discriminate(iq, readout.c0, readout.c1)
-        return (jnp.sum(out['n_pulses'], axis=0),
-                jnp.sum(out['err']), jnp.sum(final_bits, axis=0),
-                out['steps'])
+        out = run_physics_batch(mp, model, key, batch, cfg=cfg)
+        # reductions inside the jit: XLA dead-code-eliminates the big
+        # per-shot record outputs instead of materializing them
+        return (jnp.sum(out['n_pulses'], axis=0), jnp.sum(out['err']),
+                jnp.sum(out['meas_bits'][:, :, 0], axis=0),
+                out['steps'], out['epochs'], out['incomplete'])
 
     key = jax.random.PRNGKey(0)
     # warm-up / compile
@@ -93,20 +116,23 @@ def main():
     res = jax.block_until_ready(step(key))
     t_jit = time.perf_counter() - t0
     err_total = int(res[1])
+    assert not bool(res[5]), 'warm-up batch did not complete in max_steps'
 
     t0 = time.perf_counter()
     for i in range(n_batches):
         key, sub = jax.random.split(key)
         # block per batch: queueing several in-flight steps multiplies
-        # peak HBM (each holds ~100s of MB of loop-carried state) and
-        # stalls the allocator, measured ~3x slower than synchronous
+        # peak HBM (each holds the full loop-carried state) and stalls
+        # the allocator, measured ~3x slower than synchronous
         res = jax.block_until_ready(step(sub))
     elapsed = time.perf_counter() - t0
     err_total += int(res[1])
 
     shots_per_sec = total_shots / elapsed
+    bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
     result = {
-        'metric': 'shots/sec/chip, 8q active-reset+RB sweep (sim+readout)',
+        'metric': 'shots/sec/chip, 8q active-reset+RB, physics-closed '
+                  '(synth+demod+discriminate in-loop)',
         'value': round(shots_per_sec, 1),
         'unit': 'shots/s',
         'vs_baseline': round(shots_per_sec / NORTH_STAR_SHOTS_PER_SEC, 3),
@@ -114,8 +140,11 @@ def main():
             'n_qubits': n_qubits, 'rb_depth': depth,
             'total_shots': total_shots, 'batch': batch,
             'n_instr': n_instr, 'interp_steps': int(res[3]),
+            'epochs': int(res[4]), 'sigma': sigma,
+            'meas1_frac': round(bit1_frac, 4),
             'compile_s': round(t_compile, 3), 'jit_s': round(t_jit, 3),
             'run_s': round(elapsed, 3), 'err_shots': err_total,
+            'pallas_compiled': pallas_compiled,
             'platform': jax.devices()[0].platform,
             'device': str(jax.devices()[0]),
         },

@@ -1,0 +1,57 @@
+"""Pallas-kernel parity self-test, shared by bench.py and the
+``tpu``-marked test suite so the 'bench runs the same assertions'
+guarantee can't silently diverge.
+
+The reference implementations being checked against are the pure-XLA
+:func:`.demod.demod_iq` and :func:`.waveform.synthesize_element`; the
+kernels are :func:`.demod.demod_iq_pallas` and
+:func:`.waveform_pallas.synthesize_element_pallas`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..elements import ENV_CW_SENTINEL
+from .demod import demod_iq, demod_iq_pallas
+from .waveform import synthesize_element
+from .waveform_pallas import synthesize_element_pallas
+
+
+def check_demod_parity(interpret: bool):
+    """MXU demod kernel vs XLA matmul; raises on mismatch."""
+    rng = np.random.default_rng(0)
+    adc = rng.standard_normal((1000, 1024)).astype(np.float32)
+    w = rng.standard_normal((1024, 8)).astype(np.float32)
+    got = np.asarray(demod_iq_pallas(adc, w, interpret=interpret))
+    want = np.asarray(demod_iq(adc, w))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+
+def check_waveform_parity(interpret: bool):
+    """NCO synthesis kernel vs XLA element model; raises on mismatch."""
+    rng = np.random.default_rng(1)
+    env = (rng.standard_normal(256) + 1j * rng.standard_normal(256)) * 0.5
+    rec = {
+        'gtime': jnp.asarray([4, 40, 90, 0], jnp.int32),
+        'env': jnp.asarray([(32 << 12) | 0, (48 << 12) | 16,
+                            (ENV_CW_SENTINEL << 12) | 8, 0], jnp.int32),
+        'phase': jnp.asarray([0, 1 << 15, 1 << 14, 0], jnp.int32),
+        'freq_rel': jnp.asarray([0.1, 0.23, 0.05, 0], jnp.float32),
+        'amp': jnp.asarray([0xffff, 0x8000, 0x4000, 0], jnp.int32),
+        'elem': jnp.asarray([0, 0, 0, 0], jnp.int32),
+        'n_pulses': jnp.int32(3),
+    }
+    got = np.asarray(synthesize_element_pallas(
+        rec, env, spc=4, interp=1, n_clks=128, block=512,
+        interpret=interpret))
+    want = np.asarray(synthesize_element(rec, env, spc=4, interp=1,
+                                         n_clks=128))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def pallas_parity_check(interpret: bool) -> None:
+    """Run both kernel parity checks; raises AssertionError on mismatch."""
+    check_demod_parity(interpret)
+    check_waveform_parity(interpret)

@@ -174,7 +174,10 @@ def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
         time=jnp.full((B, C), INIT_TIME, jnp.int32), offset=z(B, C),
         done=jnp.zeros((B, C), bool), err=z(B, C), pp=z(B, C, 5),
         n_pulses=z(B, C),
-        rec=z(B, C, P, len(_REC_FIELDS)),
+        # field-major flat [B, C, F*P]: a trailing axis of F=9 would
+        # lane-pad to 128 on TPU (14x HBM + write traffic per step);
+        # F*P lands near a tile multiple.  Views reshape to [B,C,F,P].
+        rec=z(B, C, len(_REC_FIELDS) * P),
         n_resets=z(B, C), rst_time=z(B, C, R),
         n_meas=z(B, C),
         meas_avail=jnp.full((B, C, M), INT32_MAX, jnp.int32),
@@ -383,7 +386,10 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                        cfg.max_pulses)                           # [B, C, P]
     pwrite = (oh_pslot == 1) & (fire & (st['n_pulses'] < cfg.max_pulses)
                                 )[..., None]
-    rec = jnp.where(pwrite[..., None], rec_vals[:, :, None, :], st['rec'])
+    F, P = len(_REC_FIELDS), cfg.max_pulses
+    rec = jnp.where(pwrite[:, :, None, :],
+                    rec_vals[:, :, :, None],
+                    st['rec'].reshape(B, C, F, P)).reshape(B, C, F * P)
     n_pulses = st['n_pulses'] + fire.astype(jnp.int32)
 
     is_meas_pulse = fire & (elem == cfg.meas_elem)
@@ -499,9 +505,11 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
 
 
 def _split_records(rec) -> dict:
-    """Split the slot-indexed ``[B, C, P, F]`` record tensor into named
-    ``rec_*`` field arrays."""
-    return {'rec_' + n: rec[..., i] for i, n in enumerate(_REC_FIELDS)}
+    """Split the flat field-major ``[B, C, F*P]`` record tensor into
+    named ``rec_*`` field arrays (each ``[B, C, P]``)."""
+    F = len(_REC_FIELDS)
+    rec4 = rec.reshape(rec.shape[:-1] + (F, rec.shape[-1] // F))
+    return {'rec_' + n: rec4[..., i, :] for i, n in enumerate(_REC_FIELDS)}
 
 
 def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,

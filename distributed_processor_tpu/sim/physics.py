@@ -82,6 +82,11 @@ class ReadoutPhysics:
     drive_elem: int = 0
     meas_elem: int = 2
     window_samples: int = None
+    # samples per resolve step: the matched filter streams over the
+    # window in chunks of this size (lax.scan), so peak memory is
+    # O(B*C*M*chunk) instead of O(B*C*M*W) — million-shot batches with
+    # 2k-sample readout windows fit HBM
+    resolve_chunk: int = 512
 
 
 def _physics_tables(mp, meas_elem: int):
@@ -116,20 +121,16 @@ def _physics_tables(mp, meas_elem: int):
         env_stack[c, :len(envs[c])] = envs[c]
         freq_stack[c, :len(frels[c])] = frels[c]
     w_auto = max((len(envs[c]) * interps[c] for c in range(C)), default=0) or 1
+    # spc/interp stay numpy: they parameterize static (compile-time)
+    # structure, and callers may run under an outer trace where jnp
+    # constants would become tracers
     return (jnp.asarray(env_stack), jnp.asarray(freq_stack),
-            jnp.asarray(np.asarray(spcs, np.int32)),
-            jnp.asarray(np.asarray(interps, np.int32)), int(w_auto))
+            np.asarray(spcs, np.int32), np.asarray(interps, np.int32),
+            int(w_auto))
 
 
-def _synth_windows(st: dict, tables, W: int):
-    """Synthesize every recorded readout window: ``[B,C,M,W]`` I/Q.
-
-    Same numeric contract as :func:`..ops.waveform.synthesize_element`
-    (env addressing ``(env&0xfff)*4 + s//interp``, phase-coherent
-    carrier from the global phase origin, ``amp/AMP_SCALE`` scaling) in
-    windowed per-measurement form — pinned against it by
-    tests/test_physics.py::test_window_matches_synthesize_element.
-    """
+def _window_scalars(st: dict, tables):
+    """Per-measurement synthesis scalars, ``[B,C,M]`` each."""
     env_stack, freq_stack, spc_m, interp_m = tables
     B, C, M = st['meas_env'].shape
     amp = st['meas_amp'].astype(jnp.float32) / AMP_SCALE          # [B,C,M]
@@ -144,28 +145,99 @@ def _synth_windows(st: dict, tables, W: int):
     interp_c = interp_m[None, :, None]
     spc_c = spc_m[None, :, None]
     n_samp = jnp.where(nw == ENV_CW_SENTINEL, 0, nw * 4 * interp_c)
+    n0_car = st['meas_gtime'] * spc_c
+    return dict(amp=amp, ph=ph, f_rel=f_rel, addr=addr, n_samp=n_samp,
+                interp_c=interp_c, n0_car=n0_car, c_idx=c_idx)
 
-    s = jnp.arange(W, dtype=jnp.int32)[None, None, None, :]      # [1,1,1,W]
-    in_win = s < n_samp[..., None]
-    L = env_stack.shape[1]
-    eidx = jnp.clip(addr[..., None] + s // interp_c[..., None], 0, L - 1)
-    env = env_stack[c_idx[..., None], eidx]                      # [B,C,M,W,2]
-    e_i, e_q = env[..., 0], env[..., 1]
+
+def _aligned_chunk(chunk: int, W: int, interps) -> int:
+    """Resolve-chunk width actually used: capped at W and rounded up so
+    every core's chunk covers whole envelope samples (multiple of each
+    interp ratio) — the same value must size the env-plane padding."""
+    chunk = min(chunk or W, W)
+    align = int(np.lcm.reduce(np.asarray(interps))) if len(interps) else 1
+    return -(-chunk // align) * align
+
+
+def _pad_env_planes(env_stack, pad: int):
+    """Split ``[C,L,2]`` env tables into I/Q planes padded with ``pad``
+    copies of the final sample, so a window chunk reads a contiguous
+    ``dynamic_slice`` with the reference's hold-last-sample overrun
+    semantics (the clamp in :func:`..ops.waveform.synthesize_element`)."""
+    C = env_stack.shape[0]
+    last = env_stack[:, -1:, :]
+    env_pad = jnp.concatenate(
+        [env_stack, jnp.broadcast_to(last, (C, pad, 2))], axis=1)
+    return env_pad[..., 0], env_pad[..., 1]
+
+
+def _synth_window_chunk(sc: dict, env_pads, s0, width: int, interps):
+    """Synthesize samples ``[s0, s0+width)`` of every recorded readout
+    window: ``[B,C,M,width]`` I/Q.
+
+    Same numeric contract as :func:`..ops.waveform.synthesize_element`
+    (env addressing ``(env&0xfff)*4 + s//interp``, phase-coherent
+    carrier from the global phase origin, ``amp/AMP_SCALE`` scaling) in
+    windowed per-measurement form — pinned against it by
+    tests/test_physics.py::test_window_matches_synthesize_element.
+
+    The envelope read rides the MXU: each window's contiguous env
+    segment is fetched as ``one_hot(start) @ T`` where ``T`` is the
+    sliding-window (Toeplitz) view of the padded per-core table — TPU
+    per-element gathers serialize, and even batched ``dynamic_slice``
+    lowers to a slow gather; a [B*M, R] x [R, seg] matmul against a
+    few-hundred-row table is data-independent and fast.  Requires
+    ``s0`` divisible by each core's interp ratio (chunk sizes are
+    multiples of every interp ratio by construction).
+    """
+    env_i_pad, env_q_pad = env_pads                   # [C, Lp] each
+    B, C, M = sc['amp'].shape
+    Lp = env_i_pad.shape[1]
+    e_is, e_qs = [], []
+    for c in range(C):
+        interp = int(interps[c])
+        seg = -(-width // interp)
+        R = Lp - seg + 1                              # valid slice starts
+        win = jnp.arange(R)[:, None] + jnp.arange(seg)[None, :]
+        T = jnp.stack([env_i_pad[c][win], env_q_pad[c][win]], 0)  # [2,R,seg]
+        base = jnp.clip(sc['addr'][:, c, :] + s0 // interp, 0, R - 1)
+        oh = jax.nn.one_hot(base.reshape(-1), R, dtype=jnp.float32)
+        segs = jnp.einsum('br,prs->pbs', oh, T,
+                          preferred_element_type=jnp.float32)
+        rep = lambda a: jnp.repeat(
+            a.reshape(B, M, seg), interp, axis=-1)[..., :width]
+        e_is.append(rep(segs[0]))
+        e_qs.append(rep(segs[1]))
+    e_i = jnp.stack(e_is, axis=1)                     # [B, C, M, width]
+    e_q = jnp.stack(e_qs, axis=1)
+
+    s = s0 + jnp.arange(width, dtype=jnp.int32)[None, None, None, :]
+    in_win = s < sc['n_samp'][..., None]
 
     # phase-coherent carrier from the global phase origin — identical in
     # the synthesized signal and the matched-filter reference, so float32
     # carrier-phase rounding cancels in the demod product
-    n_car = (st['meas_gtime'] * spc_c)[..., None] + s
-    theta = 2 * jnp.pi * f_rel[..., None] * n_car.astype(jnp.float32) \
-        + ph[..., None]
+    n_car = sc['n0_car'][..., None] + s
+    theta = 2 * jnp.pi * sc['f_rel'][..., None] * n_car.astype(jnp.float32) \
+        + sc['ph'][..., None]
     cth, sth = jnp.cos(theta), jnp.sin(theta)
     zero = jnp.float32(0)
+    amp = sc['amp']
     y_i = jnp.where(in_win, amp[..., None] * (e_i * cth - e_q * sth), zero)
     y_q = jnp.where(in_win, amp[..., None] * (e_i * sth + e_q * cth), zero)
     return y_i, y_q
 
 
-def _resolve(st: dict, bits, valid, key, tables, response, W: int):
+def _synth_windows(st: dict, tables, W: int):
+    """Full-window synthesis (``[B,C,M,W]`` I/Q) — one chunk of width W."""
+    sc = _window_scalars(st, tables)
+    interps = tuple(int(x) for x in np.asarray(tables[3]))
+    env_pads = _pad_env_planes(tables[0], W)
+    return _synth_window_chunk(sc, env_pads, jnp.int32(0), W, interps)
+
+
+def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
+             W: int, chunk: int = None, interps=None):
     """Demodulate every fired-but-unresolved readout window into a bit.
 
     The measurement contract being implemented numerically is the
@@ -173,24 +245,56 @@ def _resolve(st: dict, bits, valid, key, tables, response, W: int):
     (reference: python/distproc/asmparse.py:46-86, hwconfig.py:112-115);
     the bit produced here is what hardware presents on the fabric's
     ``meas`` inputs.
+
+    The window streams through a ``lax.scan`` in chunks of ``chunk``
+    samples (synthesis + channel response + ADC noise + matched-filter
+    accumulation per chunk), so peak memory is independent of W.  Noise
+    is keyed by (run key, chunk index), deterministic per measurement
+    slot regardless of which epoch resolves it.
     """
     g0, g1, sigma = response                  # [C,2], [C,2], scalar
     B, C, M = bits.shape
+    if interps is None:
+        interps = tuple(int(x) for x in np.asarray(tables[3]))
+    chunk = _aligned_chunk(chunk, W, interps)
+    n_chunks = -(-W // chunk)
     fired = jnp.arange(M)[None, None, :] < st['n_meas'][..., None]
     pending = fired & ~valid
-    y_i, y_q = _synth_windows(st, tables, W)
+    sc = _window_scalars(st, tables)
+    # honor the W truncation exactly (the last chunk may run past W, and
+    # a model.window_samples shorter than the natural envelope window
+    # must clip the integration the way the unchunked path's shape did)
+    sc = dict(sc, n_samp=jnp.minimum(sc['n_samp'], W))
 
-    # state-dependent channel response + ADC noise
+    # state-dependent channel response
     gs = jnp.where(st['meas_state'][..., None] == 1,
                    g1[None, :, None, :], g0[None, :, None, :])   # [B,C,M,2]
-    nz = sigma * jax.random.normal(key, (B, C, M, W, 2), jnp.float32)
-    r_i = gs[..., 0:1] * y_i - gs[..., 1:2] * y_q + nz[..., 0]
-    r_q = gs[..., 0:1] * y_q + gs[..., 1:2] * y_i + nz[..., 1]
+    gs_i, gs_q = gs[..., 0:1], gs[..., 1:2]
 
-    # matched filter: acc = sum conj(y) * r; clean responses a_s = g_s * E
-    acc_i = jnp.sum(r_i * y_i + r_q * y_q, axis=-1)              # [B,C,M]
-    acc_q = jnp.sum(r_q * y_i - r_i * y_q, axis=-1)
-    energy = jnp.sum(y_i * y_i + y_q * y_q, axis=-1)
+    def chunk_body(carry, k):
+        acc_i, acc_q, energy = carry
+        y_i, y_q = _synth_window_chunk(sc, env_pads, k * chunk, chunk,
+                                       interps)
+        # I/Q noise as two [..., chunk] draws: a trailing axis of 2 would
+        # tile-pad 64x on TPU ((8,128) lanes) and blow HBM
+        shape = (B, C, M, chunk)
+        nz_i = sigma * jax.random.normal(
+            jax.random.fold_in(key, 2 * k), shape, jnp.float32)
+        nz_q = sigma * jax.random.normal(
+            jax.random.fold_in(key, 2 * k + 1), shape, jnp.float32)
+        r_i = gs_i * y_i - gs_q * y_q + nz_i
+        r_q = gs_i * y_q + gs_q * y_i + nz_q
+        # matched filter: acc = sum conj(y) * r
+        acc_i = acc_i + jnp.sum(r_i * y_i + r_q * y_q, axis=-1)  # [B,C,M]
+        acc_q = acc_q + jnp.sum(r_q * y_i - r_i * y_q, axis=-1)
+        energy = energy + jnp.sum(y_i * y_i + y_q * y_q, axis=-1)
+        return (acc_i, acc_q, energy), None
+
+    zeros = jnp.zeros((B, C, M), jnp.float32)
+    (acc_i, acc_q, energy), _ = jax.lax.scan(
+        chunk_body, (zeros, zeros, zeros),
+        jnp.arange(n_chunks, dtype=jnp.int32))
+    # clean responses a_s = g_s * E
     a0_i = g0[None, :, None, 0] * energy
     a0_q = g0[None, :, None, 1] * energy
     a1_i = g1[None, :, None, 0] * energy
@@ -204,11 +308,13 @@ def _resolve(st: dict, bits, valid, key, tables, response, W: int):
 
 
 @functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'W',
-                                             'max_epochs'))
+                                             'max_epochs', 'chunk',
+                                             'spcs', 'interps'))
 def _run_physics_jit(soa, spc, interp, sync_part, qturns0, init_regs,
-                     env_stack, freq_stack, spc_m, interp_m, g0, g1, sigma,
+                     env_stack, freq_stack, g0, g1, sigma,
                      key, cfg: InterpreterConfig, n_cores: int, W: int,
-                     max_epochs: int) -> dict:
+                     max_epochs: int, chunk: int = None,
+                     spcs: tuple = (), interps: tuple = ()) -> dict:
     B = qturns0.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
@@ -217,7 +323,9 @@ def _run_physics_jit(soa, spc, interp, sync_part, qturns0, init_regs,
     st0['paused'] = jnp.zeros((B,), bool)
     bits0 = jnp.zeros((B, C, M), jnp.int32)
     valid0 = jnp.zeros((B, C, M), bool)
-    tables = (env_stack, freq_stack, spc_m, interp_m)
+    tables = (env_stack, freq_stack,
+              jnp.asarray(spcs, jnp.int32), jnp.asarray(interps, jnp.int32))
+    env_pads = _pad_env_planes(env_stack, _aligned_chunk(chunk, W, interps))
     response = (g0, g1, sigma)
 
     def cond(carry):
@@ -231,7 +339,8 @@ def _run_physics_jit(soa, spc, interp, sync_part, qturns0, init_regs,
     def body(carry):
         st, bits, valid, ep = carry
         st = _exec_loop(st, soa, spc, interp, sync_part, bits, valid, cfg)
-        bits, valid = _resolve(st, bits, valid, key, tables, response, W)
+        bits, valid = _resolve(st, bits, valid, key, tables, env_pads,
+                               response, W, chunk, interps)
         st = dict(st, paused=jnp.zeros_like(st['paused']))
         return st, bits, valid, ep + 1
 
@@ -316,6 +425,8 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     # worst case (the loop exits early once every shot is done)
     return _run_physics_jit(
         soa, spc, interp, sync_part, qturns0, init_regs, env_stack,
-        freq_stack, spc_m, interp_m, as_iq(model.g0), as_iq(model.g1),
+        freq_stack, as_iq(model.g0), as_iq(model.g1),
         jnp.float32(model.sigma), key_noise, cfg, C, W,
-        C * cfg.max_meas + 1)
+        C * cfg.max_meas + 1, model.resolve_chunk,
+        tuple(int(x) for x in np.asarray(spc_m)),
+        tuple(int(x) for x in np.asarray(interp_m)))

@@ -2,22 +2,31 @@
 
 JAX tests run on a virtual 8-device CPU mesh (the driver separately
 dry-runs the multi-chip path); set platform flags before jax ever imports.
+
+``DPROC_TPU_TESTS=1`` keeps the real accelerator platform instead, for
+the ``tpu``-marked kernel-parity tests on the bench host:
+
+    DPROC_TPU_TESTS=1 pytest tests/ -m tpu
 """
 
 import os
 import sys
 
-os.environ['JAX_PLATFORMS'] = 'cpu'
-flags = os.environ.get('XLA_FLAGS', '')
-if '--xla_force_host_platform_device_count' not in flags:
-    os.environ['XLA_FLAGS'] = (
-        flags + ' --xla_force_host_platform_device_count=8').strip()
+_USE_REAL_PLATFORM = os.environ.get('DPROC_TPU_TESTS') == '1'
+
+if not _USE_REAL_PLATFORM:
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    flags = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=8').strip()
 
 # the environment's sitecustomize imports jax at interpreter start (with
 # JAX_PLATFORMS=axon already in the env), so the env var alone is locked
 # in; override through the config API before any backend initialises.
 import jax
-jax.config.update('jax_platforms', 'cpu')
+if not _USE_REAL_PLATFORM:
+    jax.config.update('jax_platforms', 'cpu')
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,35 @@
+"""Compiled (non-interpret) Pallas kernel parity on real TPU hardware.
+
+The rest of the suite runs the Pallas kernels in interpret mode on the
+CPU mesh; these tests compile them for the actual TPU and assert parity
+with the XLA reference implementations — the bench-environment check
+demanded by the round-1 review.  The assertions live in
+ops/selftest.py and are the exact ones bench.py runs before timing.
+Run on the bench host with::
+
+    DPROC_TPU_TESTS=1 python -m pytest tests/ -m tpu
+
+Under the default CPU-forced suite they skip.
+"""
+
+import pytest
+import jax
+
+from distributed_processor_tpu.ops.selftest import (
+    check_demod_parity, check_waveform_parity)
+
+pytestmark = pytest.mark.tpu
+
+needs_tpu = pytest.mark.skipif(
+    jax.devices()[0].platform != 'tpu',
+    reason='needs a real TPU (DPROC_TPU_TESTS=1 on the bench host)')
+
+
+@needs_tpu
+def test_demod_pallas_compiled_matches_reference():
+    check_demod_parity(interpret=False)
+
+
+@needs_tpu
+def test_waveform_pallas_compiled_matches_reference():
+    check_waveform_parity(interpret=False)
