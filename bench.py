@@ -117,16 +117,21 @@ def main():
     t_jit = time.perf_counter() - t0
     err_total = int(res[1])
     assert not bool(res[5]), 'warm-up batch did not complete in max_steps'
+    # timed batches are checked too (err/incomplete accumulated below)
 
     t0 = time.perf_counter()
+    incomplete = 0
     for i in range(n_batches):
         key, sub = jax.random.split(key)
         # block per batch: queueing several in-flight steps multiplies
         # peak HBM (each holds the full loop-carried state) and stalls
         # the allocator, measured ~3x slower than synchronous
         res = jax.block_until_ready(step(sub))
+        err_total += int(res[1])
+        incomplete += int(res[5])
     elapsed = time.perf_counter() - t0
-    err_total += int(res[1])
+    assert not incomplete, \
+        f'{incomplete} batches did not complete within max_steps'
 
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)

@@ -75,10 +75,10 @@ _FIELDS = ('kind', 'alu_op', 'in0_is_reg', 'imm', 'in0_reg', 'in1_reg',
            'p_wen', 'p_regsel', 'p_reg')
 _F = {name: i for i, name in enumerate(_FIELDS)}
 
-# pulse-record layout: slot-indexed [B, C, max_pulses, F] — memory is
-# bounded by the pulse budget, not the step budget, so deep on-device
-# loops (many steps, few live pulses... or many pulses) don't scale the
-# loop-carried state with max_steps
+# pulse-record layout: slot-indexed, field-major flat [B, C, F*P]
+# (views reshape to [B, C, F, P]) — memory is bounded by the pulse
+# budget, not the step budget, and the flat trailing axis avoids TPU
+# lane padding (a trailing F=9 would tile-pad to 128, 14x HBM)
 _REC_FIELDS = ('qtime', 'gtime', 'env', 'phase', 'freq', 'amp', 'cfg',
                'elem', 'dur')
 

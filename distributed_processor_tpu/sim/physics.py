@@ -171,7 +171,23 @@ def _pad_env_planes(env_stack, pad: int):
     return env_pad[..., 0], env_pad[..., 1]
 
 
-def _synth_window_chunk(sc: dict, env_pads, s0, width: int, interps):
+def _toeplitz_tables(env_pads, width: int, interps):
+    """Per-core sliding-window (Toeplitz) env tables for a fixed chunk
+    width: ``T[c][p, i, j] = env_plane_p[c][i + j]``, ``[2, R, seg]``
+    per core.  Chunk-invariant — build once per resolve, outside the
+    scan (XLA does not reliably hoist the gather out of while bodies)."""
+    env_i_pad, env_q_pad = env_pads                   # [C, Lp] each
+    Lp = env_i_pad.shape[1]
+    tables = []
+    for c in range(len(interps)):
+        seg = -(-width // int(interps[c]))
+        R = Lp - seg + 1                              # valid slice starts
+        win = jnp.arange(R)[:, None] + jnp.arange(seg)[None, :]
+        tables.append(jnp.stack([env_i_pad[c][win], env_q_pad[c][win]], 0))
+    return tables
+
+
+def _synth_window_chunk(sc: dict, toeplitz, s0, width: int, interps):
     """Synthesize samples ``[s0, s0+width)`` of every recorded readout
     window: ``[B,C,M,width]`` I/Q.
 
@@ -190,20 +206,21 @@ def _synth_window_chunk(sc: dict, env_pads, s0, width: int, interps):
     ``s0`` divisible by each core's interp ratio (chunk sizes are
     multiples of every interp ratio by construction).
     """
-    env_i_pad, env_q_pad = env_pads                   # [C, Lp] each
     B, C, M = sc['amp'].shape
-    Lp = env_i_pad.shape[1]
     e_is, e_qs = [], []
     for c in range(C):
         interp = int(interps[c])
         seg = -(-width // interp)
-        R = Lp - seg + 1                              # valid slice starts
-        win = jnp.arange(R)[:, None] + jnp.arange(seg)[None, :]
-        T = jnp.stack([env_i_pad[c][win], env_q_pad[c][win]], 0)  # [2,R,seg]
+        T = toeplitz[c]                               # [2, R, seg]
+        R = T.shape[1]
         base = jnp.clip(sc['addr'][:, c, :] + s0 // interp, 0, R - 1)
         oh = jax.nn.one_hot(base.reshape(-1), R, dtype=jnp.float32)
+        # HIGHEST precision: the default MXU bf16 operand rounding would
+        # quantize env samples past the synthesize_element parity
+        # tolerance (the one_hot side is exact either way)
         segs = jnp.einsum('br,prs->pbs', oh, T,
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
         rep = lambda a: jnp.repeat(
             a.reshape(B, M, seg), interp, axis=-1)[..., :width]
         e_is.append(rep(segs[0]))
@@ -232,8 +249,8 @@ def _synth_windows(st: dict, tables, W: int):
     """Full-window synthesis (``[B,C,M,W]`` I/Q) — one chunk of width W."""
     sc = _window_scalars(st, tables)
     interps = tuple(int(x) for x in np.asarray(tables[3]))
-    env_pads = _pad_env_planes(tables[0], W)
-    return _synth_window_chunk(sc, env_pads, jnp.int32(0), W, interps)
+    toeplitz = _toeplitz_tables(_pad_env_planes(tables[0], W), W, interps)
+    return _synth_window_chunk(sc, toeplitz, jnp.int32(0), W, interps)
 
 
 def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
@@ -271,9 +288,11 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
                    g1[None, :, None, :], g0[None, :, None, :])   # [B,C,M,2]
     gs_i, gs_q = gs[..., 0:1], gs[..., 1:2]
 
+    toeplitz = _toeplitz_tables(env_pads, chunk, interps)
+
     def chunk_body(carry, k):
         acc_i, acc_q, energy = carry
-        y_i, y_q = _synth_window_chunk(sc, env_pads, k * chunk, chunk,
+        y_i, y_q = _synth_window_chunk(sc, toeplitz, k * chunk, chunk,
                                        interps)
         # I/Q noise as two [..., chunk] draws: a trailing axis of 2 would
         # tile-pad 64x on TPU ((8,128) lanes) and blow HBM

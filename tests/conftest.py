@@ -33,7 +33,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import pytest
 
-REFERENCE_ROOT = '/root/reference'
+REFERENCE_ROOT = os.environ.get('DPROC_REFERENCE_ROOT', '/root/reference')
+
+
+def pytest_collection_modifyitems(config, items):
+    """Everything touching the reference checkout is an *optional* oracle
+    comparison (marked ``reference_oracle``, auto-skipped when absent);
+    the committed tests/goldens/ files pin the compiler in bare
+    checkouts (tests/test_goldens_self.py)."""
+    for item in items:
+        if 'reference_root' in getattr(item, 'fixturenames', ()):
+            item.add_marker(pytest.mark.reference_oracle)
 
 
 @pytest.fixture(scope='session')

@@ -1,0 +1,48 @@
+"""Self-contained golden tests: compiler + assembler outputs pinned by
+committed files, no reference checkout needed.
+
+Mirrors the reference's golden-file strategy (reference:
+python/test/test_compiler.py str()-comparison against
+test_outputs/*.txt, with *_err.txt dumps on mismatch) using this repo's
+own programs and built-in calibration (models/golden_suite.py).  On
+mismatch the actual output is written next to the golden as
+``<name>_err.json`` for diffing, the same workflow the reference uses.
+
+Regenerate after an intentional compiler change with::
+
+    python -m distributed_processor_tpu.models.golden_suite
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_processor_tpu.models.golden_suite import (
+    GOLDEN_PROGRAMS, compile_golden, canonical_json)
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), 'goldens')
+
+
+@pytest.mark.parametrize('name', sorted(GOLDEN_PROGRAMS))
+def test_golden(name):
+    path = os.path.join(GOLDEN_DIR, name + '.json')
+    assert os.path.exists(path), \
+        f'missing golden {path}: run python -m ' \
+        f'distributed_processor_tpu.models.golden_suite'
+    actual = json.loads(canonical_json(compile_golden(name)))
+    with open(path) as f:
+        golden = json.load(f)
+    if actual != golden:
+        err_path = os.path.join(GOLDEN_DIR, name + '_err.json')
+        with open(err_path, 'w') as f:
+            f.write(canonical_json(actual) + '\n')
+        # byte-level buffers are the tightest signal — name them first
+        for core in golden.get('assembled', {}):
+            for k in ('cmd_buf', 'env_buffers', 'freq_buffers'):
+                assert actual['assembled'][core][k] \
+                    == golden['assembled'][core][k], \
+                    f'{name}: core {core} {k} differs (actual written ' \
+                    f'to {err_path})'
+        assert actual == golden, \
+            f'{name}: asm output differs (actual written to {err_path})'

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Fail CI on any JUnit <failure>/<error> element.
+
+The reference gates its pipelines the same way (reference:
+cocotb/.check_xml.py, invoked from .gitlab-ci.yml) — a belt-and-braces
+check that a runner swallowing pytest's exit code can't turn a red
+suite green.
+"""
+
+import sys
+import xml.etree.ElementTree as ET
+
+
+def main(path: str) -> int:
+    root = ET.parse(path).getroot()
+    failures = root.findall('.//failure') + root.findall('.//error')
+    if failures:
+        for f in failures:
+            print(f'FAILURE: {f.get("message", "")[:200]}')
+        return 1
+    n_tests = sum(int(s.get('tests', 0))
+                  for s in root.iter('testsuite')) or int(
+                      root.get('tests', 0))
+    if n_tests == 0:
+        print('FAILURE: no tests ran')
+        return 1
+    print(f'junit OK: {n_tests} tests, no failures')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1]))
