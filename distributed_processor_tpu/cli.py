@@ -36,13 +36,7 @@ def _make_sim(args):
 
 
 def cmd_compile(args):
-    sim = _make_sim(args)
-    program = _load_program(args.program, args.qasm)
-    if isinstance(program, str):
-        from .frontend import qasm_to_program
-        program = qasm_to_program(program)
-    from .pipeline import compile_program
-    prog = compile_program(program, sim.qchip, fpga_config=sim.fpga_config)
+    prog, _ = _compile_asm(args)
     if args.output:
         prog.save(args.output)
         print(f'wrote {args.output}')
@@ -53,17 +47,101 @@ def cmd_compile(args):
                 print(f'  {i}')
 
 
-def cmd_disasm(args):
+def _compile_asm(args):
+    """Load + compile to a CompiledProgram (shared by compile/disasm/
+    dump commands); returns (CompiledProgram, Simulator)."""
     sim = _make_sim(args)
-    mp = sim.compile(_load_program(args.program, args.qasm))
+    program = _load_program(args.program, args.qasm)
+    if isinstance(program, str):
+        from .frontend import qasm_to_program
+        program = qasm_to_program(program)
+    from .pipeline import compile_program
+    prog = compile_program(program, sim.qchip, fpga_config=sim.fpga_config)
+    return prog, sim
+
+
+def _assemble(args):
+    """Compile + assemble; returns (assembled bufs, channel_configs)."""
+    from .assembler import GlobalAssembler
+    from .elements import TPUElementConfig
+    prog, sim = _compile_asm(args)
+    asm = GlobalAssembler(prog, sim.channel_configs, TPUElementConfig)
+    return asm.get_assembled_program(), sim.channel_configs
+
+
+def _select_cores(assembled, core) -> list:
+    """Numerically ordered core keys, or the validated --core choice."""
+    if core is None:
+        return sorted(assembled, key=int)
+    key = str(core)
+    if key not in assembled:
+        raise SystemExit(
+            f'no core {key} in this program (has: '
+            f'{", ".join(sorted(assembled, key=int))})')
+    return [key]
+
+
+def _fmt_operands(d: dict) -> str:
+    parts = []
+    for k, v in d.items():
+        if k == 'op':
+            continue
+        if isinstance(v, tuple) or (isinstance(v, list) and len(v) == 2
+                                    and v[0] == 'reg'):
+            v = f'r{v[1]}'
+        parts.append(f'{k}={v}')
+    return ' '.join(parts)
+
+
+def cmd_disasm(args):
+    """Full-operand disassembly of the assembled command buffers — the
+    analog of the reference's ``asmparse.cmdparse`` field dump
+    (reference: python/distproc/asmparse.py:12-44)."""
+    assembled, _ = _assemble(args)
     from . import isa
-    for c in range(mp.n_cores) if args.core is None else [args.core]:
-        print(f'# core {mp.core_inds[c]}')
-        soa = mp.soa
-        from .isa import _KIND_NAMES
-        for i in range(mp.n_instr):
-            kind = int(soa.kind[c, i])
-            print(f'  {i:4d}: {_KIND_NAMES[kind]}')
+    for core in _select_cores(assembled, args.core):
+        print(f'# core {core}')
+        for i, d in enumerate(isa.disassemble(assembled[core]['cmd_buf'])):
+            print(f'  {i:4d}: {d["op"]:<17s} {_fmt_operands(d)}'.rstrip())
+
+
+def cmd_envdump(args):
+    """Decode env buffers to complex I/Q samples (reference:
+    asmparse.envparse, asmparse.py:46-63)."""
+    assembled, _ = _assemble(args)
+    from .elements import parse_env_buffer
+    for core in _select_cores(assembled, args.core):
+        for e, buf in enumerate(assembled[core]['env_buffers']):
+            iq = parse_env_buffer(buf)
+            print(f'# core {core} elem {e}: {len(iq)} samples')
+            for k in range(0, len(iq), 1 if args.full else max(len(iq)//8, 1)):
+                print(f'  [{k:5d}] {iq[k].real:+.6f} {iq[k].imag:+.6f}j')
+
+
+def cmd_freqdump(args):
+    """Decode freq buffers: word 0 = freq/fsamp*2^32, words 1-15 = IQ
+    phase offsets of the 16-sample parallel NCO (reference:
+    asmparse.freqparse, asmparse.py:64-86)."""
+    assembled, ccfgs = _assemble(args)
+    from .elements import parse_freq_buffer
+    # fsamp per element from any qubit's channel configs on that core
+    for core in _select_cores(assembled, args.core):
+        elems = {}
+        for name, cc in ccfgs.items():
+            if not hasattr(cc, 'core_ind') or str(cc.core_ind) != core:
+                continue
+            elems[cc.elem_ind] = \
+                cc.elem_params['samples_per_clk'] * ccfgs['fpga_clk_freq']
+        for e, buf in enumerate(assembled[core]['freq_buffers']):
+            if not len(buf):
+                continue
+            fsamp = elems.get(e, 1.0)
+            parsed = parse_freq_buffer(buf, fsamp)
+            print(f'# core {core} elem {e} (fsamp {fsamp:.3e})')
+            for k, f in enumerate(parsed['freq']):
+                iq0 = parsed['iq15'][k, 0]
+                print(f'  [{k:3d}] freq {f:.6e} Hz  '
+                      f'iq[1] {iq0.real:+.5f}{iq0.imag:+.5f}j')
 
 
 def cmd_run(args):
@@ -109,10 +187,24 @@ def main(argv=None):
     p.add_argument('-o', '--output')
     p.set_defaults(fn=cmd_compile)
 
-    p = sub.add_parser('disasm', help='decode the assembled machine program')
+    p = sub.add_parser('disasm', help='full-operand disassembly of the '
+                                      'assembled command buffers')
     p.add_argument('program')
     p.add_argument('--core', type=int)
     p.set_defaults(fn=cmd_disasm)
+
+    p = sub.add_parser('envdump', help='decode envelope BRAM buffers to I/Q')
+    p.add_argument('program')
+    p.add_argument('--core', type=int)
+    p.add_argument('--full', action='store_true',
+                   help='print every sample (default: 8 per buffer)')
+    p.set_defaults(fn=cmd_envdump)
+
+    p = sub.add_parser('freqdump', help='decode frequency BRAM buffers '
+                                        '(16-word parallel-NCO entries)')
+    p.add_argument('program')
+    p.add_argument('--core', type=int)
+    p.set_defaults(fn=cmd_freqdump)
 
     p = sub.add_parser('run', help='simulate shots')
     p.add_argument('program')

@@ -1,6 +1,6 @@
 from .interpreter import (InterpreterConfig, simulate, simulate_batch,
                           ERR_MISSED_TRIG, ERR_PULSE_OVERFLOW,
                           ERR_MEAS_OVERFLOW, ERR_FPROC_DEADLOCK,
-                          ERR_SYNC_DONE)
+                          ERR_SYNC_DONE, ERR_FPROC_ID, ERR_STICKY_RACE)
 from .oracle import OracleCore, run_oracle
 from .physics import ReadoutPhysics, run_physics_batch

@@ -49,7 +49,8 @@ import jax.numpy as jnp
 
 from .. import isa
 from ..hwconfig import FPGAConfig
-from .oracle import INIT_TIME, QCLK_RST_DELAY, MEAS_LATENCY
+from .oracle import (INIT_TIME, QCLK_RST_DELAY, MEAS_LATENCY,
+                     STICKY_RACE_MARGIN)
 
 INT32_MAX = np.int32(2**31 - 1)
 
@@ -60,6 +61,10 @@ ERR_MEAS_OVERFLOW = 4    # more measurements than meas_bits provides
 ERR_FPROC_DEADLOCK = 8   # fproc read with producer halted and no data
 ERR_SYNC_DONE = 16       # barrier released with a participant already done
 ERR_FPROC_ID = 32        # fproc func_id out of range
+ERR_STICKY_RACE = 64     # sticky read raced a measurement's arrival (a
+                         # bit landed within STICKY_RACE_MARGIN clks of
+                         # the read — hardware's 2-cycle handshake makes
+                         # the latched value timing-dependent there)
 
 # program-fetch strategy crossover: one-hot multiply-reduce up to this
 # many instructions, per-lane gather beyond (see _step fetch comment)
@@ -265,6 +270,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         return ready | dead, data, tready, dead, phys
 
     fid_bad = jnp.zeros((B, C), bool)
+    f_race = jnp.zeros((B, C), bool)
     if cfg.fabric == 'sticky':
         # bit latched at read time; producer must have simulated past `req`
         fid_bad = fid >= C
@@ -282,6 +288,12 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         f_data = jnp.where(m_cnt > 0, _ohsel(bits_p, oh_latest), 0)
         f_tready = req
         f_deadlock = jnp.zeros((B, C), bool)
+        # a measurement landing within the handshake window of the read
+        # makes the hardware-latched value timing-dependent: flag it
+        # (see oracle.STICKY_RACE_MARGIN)
+        f_race = jnp.any(
+            (mavail_p > (req - STICKY_RACE_MARGIN)[..., None])
+            & (mavail_p <= (req + STICKY_RACE_MARGIN)[..., None]), -1)
     elif cfg.fabric == 'fresh':
         fid_bad = fid >= C
         oh_prod = _onehot(jnp.clip(fid, 0, C - 1), C)
@@ -487,6 +499,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         | jnp.where(missed_trig | missed_idle, ERR_MISSED_TRIG, 0) \
         | jnp.where(is_fproc & adv & fid_bad, ERR_FPROC_ID, 0) \
         | jnp.where(is_fproc & adv & f_deadlock, ERR_FPROC_DEADLOCK, 0) \
+        | jnp.where(is_fproc & adv & f_race, ERR_STICKY_RACE, 0) \
         | jnp.where(sync_adv & sync_err[:, None], ERR_SYNC_DONE, 0)
 
     tr = {}

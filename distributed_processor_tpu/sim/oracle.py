@@ -60,6 +60,14 @@ START_NCLKS = 5       # schedule origin (ir/passes.py START_NCLKS)
 INIT_TIME = 2
 QCLK_RST_DELAY = 4    # sync release -> qclk zero (cocotb test_proc.py:17)
 MEAS_LATENCY = 64     # rdlo pulse end -> bit available (hwconfig FPROC_MEAS_CLKS)
+# Sticky-fabric race window: hardware serves the latched bit through a
+# 2-cycle registered handshake (reference: hdl/fproc_meas.sv:23-34), so
+# a measurement landing within this many clks of the read time may or
+# may not be included in the latched value on real hardware.  Both
+# engines serve the deterministic latched-at-read-time bit AND flag the
+# read ('sticky_race' / ERR_STICKY_RACE) so users see the hazard the
+# simulation's determinism would otherwise hide (round-1 review item).
+STICKY_RACE_MARGIN = 2
 
 MASK32 = 0xffffffff
 
@@ -192,6 +200,9 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
         if fabric == 'sticky':
             if not (prod.done or prod.time >= req):
                 return False, 0, 0
+            if any(req - STICKY_RACE_MARGIN < t <= req + STICKY_RACE_MARGIN
+                   for t in prod.meas_avail):
+                core.err.append('sticky_race')
             m = sum(1 for t in prod.meas_avail if t <= req)
             data = int(meas_bits[func_id, m - 1]) \
                 if 0 < m <= meas_bits.shape[1] else 0   # zero-pad past budget

@@ -1,0 +1,69 @@
+"""Worker process for the real 2-process multihost test (not collected
+by pytest — launched by tests/test_multihost.py).
+
+Each worker is one JAX controller: 4 virtual CPU devices, wired to its
+peers via ``jax.distributed.initialize``, computing global sweep
+statistics over a mesh spanning both processes.  Prints one JSON line.
+"""
+
+import json
+import os
+import sys
+
+PID = int(sys.argv[1])
+NPROC = int(sys.argv[2])
+PORT = sys.argv[3]
+LOCAL_DEVICES = 4
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+flags = [f for f in os.environ.get('XLA_FLAGS', '').split()
+         if not f.startswith('--xla_force_host_platform_device_count')]
+flags.append(f'--xla_force_host_platform_device_count={LOCAL_DEVICES}')
+os.environ['XLA_FLAGS'] = ' '.join(flags)
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+from distributed_processor_tpu.parallel.multihost import (
+    initialize_multihost, make_global_mesh, host_local_batch,
+    global_shot_array)
+from distributed_processor_tpu.parallel import sweep_stats
+from distributed_processor_tpu.pipeline import compile_to_machine
+from distributed_processor_tpu.models import active_reset, make_default_qchip
+from distributed_processor_tpu.sim.interpreter import InterpreterConfig
+
+
+def main():
+    info = initialize_multihost(f'127.0.0.1:{PORT}', NPROC, PID)
+    assert info['process_count'] == NPROC, info
+    assert info['global_devices'] == NPROC * LOCAL_DEVICES, info
+
+    mp = compile_to_machine(active_reset(['Q0']), make_default_qchip(2),
+                            n_qubits=1)
+    cfg = InterpreterConfig(max_steps=mp.n_instr + 8, max_pulses=8,
+                            max_meas=2, max_resets=1)
+    shots = 16
+    rng = np.random.default_rng(7)            # same stream on every host
+    bits = rng.integers(0, 2, size=(shots, mp.n_cores, cfg.max_meas))
+
+    mesh = make_global_mesh()
+    local_shots, offset = host_local_batch(mesh, shots)
+    gbits = global_shot_array(mesh, bits[offset:offset + local_shots],
+                              bits.shape)
+    stats = sweep_stats(mp, gbits, mesh, cfg=cfg)
+    print(json.dumps({
+        'pid': PID,
+        'info': info,
+        'local_shots': local_shots,
+        'offset': offset,
+        'mean_pulses': np.asarray(stats['mean_pulses']).tolist(),
+        'err_rate': float(stats['err_rate']),
+        'mean_qclk': np.asarray(stats['mean_qclk']).tolist(),
+    }))
+
+
+if __name__ == '__main__':
+    main()

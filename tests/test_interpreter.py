@@ -175,6 +175,44 @@ def test_cross_core_fproc_read():
     assert int(out['err'][1]) == 0
 
 
+def test_sticky_race_window_flagged():
+    """A sticky read landing within STICKY_RACE_MARGIN of a measurement's
+    arrival is served deterministically but flagged ERR_STICKY_RACE /
+    'sticky_race' in BOTH engines: hardware's 2-cycle handshake
+    (fproc_meas.sv:23-34) makes the latched value timing-dependent
+    there (docs/TIMING.md 'Race flagging')."""
+    from distributed_processor_tpu.sim import ERR_STICKY_RACE
+    # core0's rdlo pulse: avail = 10 (trig) + 2 (dur) + 64 = 76
+    core0 = [
+        isa.pulse_cmd(freq_word=3, cfg_word=2, env_word=(2 << 12) | 0,
+                      cmd_time=10),
+        isa.done_cmd(),
+    ]
+
+    def reader(idle_end):
+        # read issues at idle_end + pulse_load_clks(3)
+        return [isa.idle(idle_end),
+                isa.read_fproc(func_id=0, write_reg_addr=7),
+                isa.done_cmd()]
+
+    # racy: read at t=71+3=74; 76 in (72, 76] -> flagged, bit still the
+    # pre-measurement latch (0 measurements <= 74 -> data 0)
+    prog = mp_of(core0, reader(71))
+    bits = np.array([[1], [0]])
+    out = simulate(prog, meas_bits=bits)
+    orc = run_oracle(prog, meas_bits=bits)
+    assert int(out['err'][1]) & ERR_STICKY_RACE
+    assert 'sticky_race' in orc['err'][1]
+    assert int(out['regs'][1, 7]) == 0 and orc['regs'][1, 7] == 0
+
+    # safe: read at t=100+3=103; margin clear -> served, no flag
+    prog = mp_of(core0, reader(100))
+    out = simulate(prog, meas_bits=bits)
+    orc = run_oracle(prog, meas_bits=bits)
+    assert int(out['err'][1]) == 0 and orc['err'][1] == []
+    assert int(out['regs'][1, 7]) == 1 and orc['regs'][1, 7] == 1
+
+
 def test_sync_barrier_aligns_cores():
     # cores reach the barrier at different times; both pulse together after
     core0 = [

@@ -1,0 +1,87 @@
+"""Real multi-process multihost execution (VERDICT round-1 item 7).
+
+Launches 2 OS processes, each a separate JAX controller with 4 virtual
+CPU devices, wired by ``jax.distributed.initialize`` over a localhost
+coordinator — the same multi-controller model that spans hosts over DCN
+on a TPU pod.  Asserts that ``make_global_mesh`` / ``host_local_batch``
+/ ``global_shot_array`` / ``sweep_stats`` produce statistics identical
+to a single-process run of the same shots.
+
+The reference has no multi-host analog (its fabric is on-chip wiring);
+this pins the capability the TPU build adds.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, 'multihost_worker.py')
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.multihost
+def test_two_process_sweep_stats_matches_single():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop('JAX_PLATFORMS', None)            # workers set their own
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(pid), '2', str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            cwd=HERE, text=True)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f'worker failed:\n{err[-3000:]}'
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # a failed/timed-out worker must not orphan its peer (which
+        # would sit blocked on the coordinator holding the port)
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+
+    # topology: 2 controllers x 4 local = 8 global devices, disjoint
+    # host-local shot shards covering all 16 shots
+    for o in outs:
+        assert o['info']['process_count'] == 2
+        assert o['info']['global_devices'] == 8
+        assert o['local_shots'] == 8
+    assert sorted(o['offset'] for o in outs) == [0, 8]
+
+    # both controllers computed identical (psum-replicated) statistics
+    assert outs[0]['mean_pulses'] == outs[1]['mean_pulses']
+    assert outs[0]['mean_qclk'] == outs[1]['mean_qclk']
+    assert outs[0]['err_rate'] == outs[1]['err_rate'] == 0.0
+
+    # ... equal to the single-process run of the same global batch
+    from distributed_processor_tpu.parallel import sweep_stats, make_mesh
+    from distributed_processor_tpu.pipeline import compile_to_machine
+    from distributed_processor_tpu.models import (active_reset,
+                                                  make_default_qchip)
+    from distributed_processor_tpu.sim.interpreter import InterpreterConfig
+    mp = compile_to_machine(active_reset(['Q0']), make_default_qchip(2),
+                            n_qubits=1)
+    cfg = InterpreterConfig(max_steps=mp.n_instr + 8, max_pulses=8,
+                            max_meas=2, max_resets=1)
+    rng = np.random.default_rng(7)            # worker's stream
+    bits = rng.integers(0, 2, size=(16, mp.n_cores, cfg.max_meas))
+    stats = sweep_stats(mp, bits, make_mesh(n_dp=8), cfg=cfg)
+    np.testing.assert_allclose(np.asarray(stats['mean_pulses']),
+                               outs[0]['mean_pulses'])
+    np.testing.assert_allclose(np.asarray(stats['mean_qclk']),
+                               outs[0]['mean_qclk'])

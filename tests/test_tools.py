@@ -36,6 +36,36 @@ def test_cli_qasm_trace(tmp_path, capsys):
     assert 'core 0' in out and 'pc=' in out
 
 
+def test_cli_disasm_full_operands(tmp_path, capsys):
+    """disasm prints every operand field (amp/phase/env/time...), the
+    analog of the reference's asmparse.cmdparse dump — not just opcode
+    names (round-1 review item)."""
+    prog_path = tmp_path / 'prog.json'
+    prog_path.write_text(json.dumps(
+        [{'name': 'X90', 'qubit': ['Q0']},
+         {'name': 'read', 'qubit': ['Q0']}]))
+    cli_main(['--qubits', '1', 'disasm', str(prog_path)])
+    out = capsys.readouterr().out
+    assert 'pulse_write_trig' in out
+    for field in ('amp=', 'phase=', 'freq=', 'cfg=', 'cmd_time=',
+                  'env_start=', 'env_length='):
+        assert field in out, f'missing {field} in disasm output:\n{out}'
+
+
+def test_cli_envdump_freqdump(tmp_path, capsys):
+    prog_path = tmp_path / 'prog.json'
+    prog_path.write_text(json.dumps(
+        [{'name': 'X90', 'qubit': ['Q0']},
+         {'name': 'read', 'qubit': ['Q0']}]))
+    cli_main(['--qubits', '1', 'envdump', str(prog_path)])
+    out = capsys.readouterr().out
+    assert 'elem 0' in out and 'j' in out      # complex samples printed
+    cli_main(['--qubits', '1', 'freqdump', str(prog_path)])
+    out = capsys.readouterr().out
+    assert 'freq 4.2' in out                   # Q0 drive frequency
+    assert 'fsamp 8.0' in out                  # 16 spc @ 500 MHz
+
+
 def test_results_roundtrip(tmp_path):
     path = str(tmp_path / 'res.npz')
     save_results(path, {'counts': np.arange(8), '_private': 1},
