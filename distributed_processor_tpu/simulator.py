@@ -55,14 +55,23 @@ class Simulator:
 
     def interpreter_config(self, mp: MachineProgram,
                            **kw) -> InterpreterConfig:
-        """Sized-to-the-program interpreter config."""
-        defaults = dict(
-            max_steps=mp.n_instr + 16 if not kw.get('has_loops')
-            else 64 * mp.n_instr,
-            max_pulses=min(int(mp.max_pulses_per_core(64)) + 4, 4096),
-            max_meas=16, max_resets=4)
-        defaults.pop('has_loops', None)
-        kw.pop('has_loops', None)
+        """Sized-to-the-program interpreter config.
+
+        Budgets come from static loop analysis
+        (:meth:`~.decoder.MachineProgram.static_bounds`): counter loops
+        the compiler emits are sized exactly; unanalyzable back-edges
+        get a bounded fallback.  Pass ``max_steps``/``max_pulses``
+        explicitly for programs whose iteration counts are data-driven.
+        """
+        kw.pop('has_loops', None)       # superseded by static analysis
+        defaults = dict(max_meas=16, max_resets=4)
+        if 'max_steps' not in kw or 'max_pulses' not in kw:
+            # the pure-Python scan is skipped when both budgets are
+            # caller-supplied (large programs in hot paths)
+            bounds = mp.static_bounds()
+            defaults.update(
+                max_steps=bounds['max_steps'],
+                max_pulses=min(bounds['max_pulses'], 4096))
         defaults.update(kw)
         return InterpreterConfig.from_fpga_config(self.fpga_config,
                                                   **defaults)
@@ -93,6 +102,7 @@ class Simulator:
             out = dict(run_physics_batch(
                 mp, physics, key if key is not None else jax.random.PRNGKey(0),
                 shots, init_regs=init_regs, cfg=cfg))
+            self._warn_truncation(out, cfg)
             out['_mp'] = mp
             out['_cfg'] = physics_config(cfg, physics)  # effective config
             return out
@@ -111,9 +121,29 @@ class Simulator:
                 meas_bits = np.zeros((shots, mp.n_cores, cfg.max_meas), int)
             out = dict(simulate_batch(mp, meas_bits, init_regs=init_regs,
                                       cfg=cfg))
+        self._warn_truncation(out, cfg)
         out['_mp'] = mp
         out['_cfg'] = cfg
         return out
+
+    @staticmethod
+    def _warn_truncation(out: dict, cfg) -> None:
+        """A run that exhausted its step or pulse budget is truncated,
+        not merely erroneous — say so loudly instead of leaving a quiet
+        error bit (round-1 review: deep loops silently truncated)."""
+        import warnings
+        from .sim.interpreter import ERR_PULSE_OVERFLOW
+        if bool(np.asarray(out.get('incomplete', False))):
+            warnings.warn(
+                f'run truncated: not all shots finished within max_steps='
+                f'{cfg.max_steps}; results are partial — raise max_steps '
+                f'(data-driven loops cannot be sized statically)',
+                RuntimeWarning, stacklevel=3)
+        if np.any(np.asarray(out['err']) & ERR_PULSE_OVERFLOW):
+            warnings.warn(
+                f'pulse records truncated: a core emitted more than '
+                f'max_pulses={cfg.max_pulses} pulses; raise max_pulses',
+                RuntimeWarning, stacklevel=3)
 
     # -- rendering -------------------------------------------------------
 

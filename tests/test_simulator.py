@@ -125,3 +125,80 @@ def test_deep_on_device_loop_bounded_memory(sim2):
     deltas = np.diff(gt)
     assert np.all(deltas == deltas[0])
     assert np.array_equal(gt, [p['gtime'] for p in o['pulses'][0]])
+
+
+def test_static_loop_bounds_size_deep_loops():
+    """interpreter_config sizes budgets from static loop analysis, so a
+    deep counter loop runs to completion with NO explicit budget
+    overrides (round-1 review: deep loops silently truncated under the
+    old 64*n_instr heuristic)."""
+    from distributed_processor_tpu.models.experiments import loop_shots_program
+
+    sim = Simulator(n_qubits=1)
+    n_iter = 300                        # > the old fallback of 64
+    prog = loop_shots_program([{'name': 'X90', 'qubit': ['Q0']}],
+                              n_iter, scope=['Q0'])
+    mp = sim.compile(prog)
+    # the analysis recognizes the counter idiom exactly
+    loops = mp.loop_bounds(0)
+    assert len(loops) == 1 and loops[0][2] == n_iter + 1
+    bounds = mp.static_bounds()
+    assert bounds['max_pulses'] >= n_iter + 1
+    out = sim.run(mp, shots=2, max_meas=1)      # no budget overrides
+    assert not bool(out['incomplete'])
+    assert np.all(np.asarray(out['err']) == 0)
+    assert int(np.asarray(out['n_pulses'])[0, 0]) >= n_iter
+
+
+def test_loop_bounds_refuses_data_driven_loops():
+    """Static analysis must return None (fallback), never a confident
+    wrong bound, when the counter is data-driven: seeded via init_regs,
+    updated from fproc data, or looping via a backward jump_i."""
+    from distributed_processor_tpu import isa
+    from distributed_processor_tpu.decoder import machine_program_from_cmds
+
+    # counter seeded only by init_regs (no in-program initializer)
+    mp = machine_program_from_cmds([[
+        isa.alu_cmd('reg_alu', 'i', -1, 'add', 1, write_reg_addr=1),  # 0
+        isa.alu_cmd('jump_cond', 'i', 0, 'le', 1, jump_cmd_ptr=0),    # 1
+        isa.done_cmd(),
+    ]])
+    assert mp.loop_bounds(0) == [(0, 1, None)]
+
+    # fproc-driven counter update inside the body
+    mp = machine_program_from_cmds([[
+        isa.alu_cmd('reg_alu', 'i', 0, 'id0', write_reg_addr=1),      # 0
+        isa.alu_cmd('alu_fproc', 'i', 0, 'add', write_reg_addr=1,
+                    func_id=0),                                       # 1
+        isa.alu_cmd('reg_alu', 'i', 1, 'add', 1, write_reg_addr=1),   # 2
+        isa.alu_cmd('jump_cond', 'i', 10, 'ge', 1, jump_cmd_ptr=1),   # 3
+        isa.done_cmd(),
+    ]])
+    assert mp.loop_bounds(0) == [(1, 3, None)]
+
+    # poll loop: forward jump_fproc exit + backward jump_i
+    mp = machine_program_from_cmds([[
+        isa.alu_cmd('jump_fproc', 'i', 1, 'eq', jump_cmd_ptr=2,
+                    func_id=0),                                       # 0
+        isa.jump_i(0),                                                # 1
+        isa.done_cmd(),                                               # 2
+    ]])
+    bounds = mp.static_bounds(loop_fallback=50)
+    assert bounds['max_steps'] > 50     # fallback applied to the span
+
+
+def test_truncation_warns_loudly():
+    """Exhausting max_steps raises a RuntimeWarning naming the budget,
+    instead of only setting a quiet flag."""
+    import warnings
+    from distributed_processor_tpu.models.experiments import loop_shots_program
+
+    sim = Simulator(n_qubits=1)
+    prog = loop_shots_program([{'name': 'X90', 'qubit': ['Q0']}],
+                              200, scope=['Q0'])
+    mp = sim.compile(prog)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter('always')
+        out = sim.run(mp, shots=2, max_steps=32, max_meas=1)
+    assert bool(out['incomplete'])
+    assert any('max_steps' in str(w.message) for w in caught)
