@@ -55,6 +55,23 @@ def complex_to_iq(z) -> np.ndarray:
     return np.stack([np.real(z), np.imag(z)], axis=-1).astype(np.float32)
 
 
+def carrier_phase(freq_rel, n, phase0=0.0):
+    """Phase-coherent carrier phase ``2*pi*freq_rel*n + phase0`` via a
+    split-precision NCO: the frequency's 16-bit-exact head accumulates
+    in wrapping integer arithmetic (exact mod-1, like the hardware NCO
+    and the Pallas kernel), and only the tiny residual (< 2^-17
+    cycles/sample) multiplies ``n`` in float32.  The naive f32
+    ``2*pi*f*n`` loses ~1e-4 rad by a few hundred carrier cycles, which
+    shows up as window-synthesis mismatches on long traces.
+    ``n`` must be int32; broadcasting applies.
+    """
+    freq_rel = jnp.asarray(freq_rel, jnp.float32)
+    inc_hi = jnp.round(freq_rel * 65536.0).astype(jnp.int32)
+    resid = freq_rel - inc_hi.astype(jnp.float32) / 65536.0
+    frac = ((inc_hi * n) & 0xffff).astype(jnp.float32) / 65536.0
+    return 2 * jnp.pi * (frac + resid * n.astype(jnp.float32)) + phase0
+
+
 def synthesize_element(rec: dict, env_table, spc: int, interp: int,
                        n_clks: int, elem: int = 0):
     """Render one element's baseband trace from pulse records.
@@ -109,8 +126,8 @@ def synthesize_element(rec: dict, env_table, spc: int, interp: int,
                         env_len_mem)                  # padded zero slot
     env_i = env_table[env_idx, 0]                     # [P, N]
     env_q = env_table[env_idx, 1]
-    theta = 2 * jnp.pi * freq_rel[:, None] * n[None, :].astype(jnp.float32) \
-        + phase0[:, None]
+    theta = carrier_phase(freq_rel[:, None], n[None, :].astype(jnp.int32),
+                          phase0[:, None])
     c, s = jnp.cos(theta), jnp.sin(theta)
     out_i = amp[:, None] * (env_i * c - env_q * s)
     out_q = amp[:, None] * (env_i * s + env_q * c)

@@ -51,7 +51,8 @@ import jax
 import jax.numpy as jnp
 
 from ..elements import ENV_CW_SENTINEL, IQ_SCALE
-from ..ops.waveform import PHASE_BITS, AMP_SCALE, complex_to_iq
+from ..ops.waveform import (PHASE_BITS, AMP_SCALE, complex_to_iq,
+                            carrier_phase)
 from .interpreter import (InterpreterConfig, _program_constants, _init_state,
                           _exec_loop, _finalize, _check_fabric)
 
@@ -87,6 +88,14 @@ class ReadoutPhysics:
     # O(B*C*M*chunk) instead of O(B*C*M*W) — million-shot batches with
     # 2k-sample readout windows fit HBM
     resolve_chunk: int = 512
+    # 'persample': synthesize + demodulate every window sample (the
+    # general path — required once the channel model grows structure a
+    # matched filter can't collapse).  'analytic': the EXACT
+    # distributional shortcut for this white-noise matched-filter
+    # model — the filter is linear, so acc = g_s*E + sigma*sqrt(E)*xi
+    # with window energy E from an envelope prefix sum; same bit
+    # distribution at O(B*C*M) instead of O(B*C*M*W)
+    resolve_mode: str = 'persample'
 
 
 def _physics_tables(mp, meas_elem: int):
@@ -146,8 +155,15 @@ def _window_scalars(st: dict, tables):
     spc_c = spc_m[None, :, None]
     n_samp = jnp.where(nw == ENV_CW_SENTINEL, 0, nw * 4 * interp_c)
     n0_car = st['meas_gtime'] * spc_c
+    # factored carrier: theta(s) = A + 2*pi*f*s with the per-window
+    # scalar A = 2*pi*f*n0 + ph — the only transcendentals taken at
+    # [B,C,M] scale; the s-dependence comes from the basis table.
+    # carrier_phase keeps A exact at large n0 (split-precision NCO)
+    A = carrier_phase(f_rel, n0_car, ph)
     return dict(amp=amp, ph=ph, f_rel=f_rel, addr=addr, n_samp=n_samp,
-                interp_c=interp_c, n0_car=n0_car, c_idx=c_idx)
+                interp_c=interp_c, n0_car=n0_car, c_idx=c_idx,
+                cosA=jnp.cos(A), sinA=jnp.sin(A),
+                f_idx=jnp.clip(st['meas_freq'], 0, F - 1))
 
 
 def _aligned_chunk(chunk: int, W: int, interps) -> int:
@@ -187,7 +203,18 @@ def _toeplitz_tables(env_pads, width: int, interps):
     return tables
 
 
-def _synth_window_chunk(sc: dict, toeplitz, s0, width: int, interps):
+def _carrier_basis(freq_stack, W: int):
+    """Carrier basis ``cos/sin(2*pi*f*s)`` for every table frequency:
+    two ``[C, F, W]`` arrays, a few KB — computed once per resolve so
+    the per-sample carrier needs no transcendentals (the old direct
+    ``cos(2*pi*f*(n0+s))`` ran at ~2 GS/s on the VPU and dominated the
+    resolve; the factored form is two small MXU matmuls + multiplies)."""
+    s = jnp.arange(W, dtype=jnp.int32)
+    theta = carrier_phase(freq_stack[..., None], s)               # [C,F,W]
+    return jnp.cos(theta), jnp.sin(theta)
+
+
+def _synth_window_chunk(sc: dict, toeplitz, basis, s0, width: int, interps):
     """Synthesize samples ``[s0, s0+width)`` of every recorded readout
     window: ``[B,C,M,width]`` I/Q.
 
@@ -207,7 +234,23 @@ def _synth_window_chunk(sc: dict, toeplitz, s0, width: int, interps):
     multiples of every interp ratio by construction).
     """
     B, C, M = sc['amp'].shape
-    e_is, e_qs = [], []
+    # phase-coherent carrier from the global phase origin — identical in
+    # the synthesized signal and the matched-filter reference, so float32
+    # carrier-phase rounding cancels in the demod product.  Factored as
+    # e^{i theta} = e^{iA} * basis(f, s): per-window scalar rotation of
+    # the precomputed per-frequency basis rows (fetched with the same
+    # one-hot MXU pattern as the envelope)
+    basis_cos, basis_sin = basis                      # [C, F, W] each
+    F = basis_cos.shape[1]
+    bslice = jax.lax.dynamic_slice(
+        jnp.stack([basis_cos, basis_sin], 0), (0, 0, 0, s0),
+        (2, C, F, width))
+    s_lane = s0 + jnp.arange(width, dtype=jnp.int32)[None, None, :]
+    zero = jnp.float32(0)
+    y_is, y_qs = [], []
+    # everything per core stays [B, M, width] and fuses into the two
+    # final stacks — materializing separate env and carrier stacks
+    # doubles peak HBM at bench batch sizes
     for c in range(C):
         interp = int(interps[c])
         seg = -(-width // interp)
@@ -223,26 +266,24 @@ def _synth_window_chunk(sc: dict, toeplitz, s0, width: int, interps):
                           precision=jax.lax.Precision.HIGHEST)
         rep = lambda a: jnp.repeat(
             a.reshape(B, M, seg), interp, axis=-1)[..., :width]
-        e_is.append(rep(segs[0]))
-        e_qs.append(rep(segs[1]))
-    e_i = jnp.stack(e_is, axis=1)                     # [B, C, M, width]
-    e_q = jnp.stack(e_qs, axis=1)
+        e_i, e_q = rep(segs[0]), rep(segs[1])         # [B, M, width]
 
-    s = s0 + jnp.arange(width, dtype=jnp.int32)[None, None, None, :]
-    in_win = s < sc['n_samp'][..., None]
-
-    # phase-coherent carrier from the global phase origin — identical in
-    # the synthesized signal and the matched-filter reference, so float32
-    # carrier-phase rounding cancels in the demod product
-    n_car = sc['n0_car'][..., None] + s
-    theta = 2 * jnp.pi * sc['f_rel'][..., None] * n_car.astype(jnp.float32) \
-        + sc['ph'][..., None]
-    cth, sth = jnp.cos(theta), jnp.sin(theta)
-    zero = jnp.float32(0)
-    amp = sc['amp']
-    y_i = jnp.where(in_win, amp[..., None] * (e_i * cth - e_q * sth), zero)
-    y_q = jnp.where(in_win, amp[..., None] * (e_i * sth + e_q * cth), zero)
-    return y_i, y_q
+        oh_f = jax.nn.one_hot(sc['f_idx'][:, c, :].reshape(-1), F,
+                              dtype=jnp.float32)      # [B*M, F]
+        rows = jnp.einsum('bf,pfs->pbs', oh_f, bslice[:, c],
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+        bc = rows[0].reshape(B, M, width)
+        bs = rows[1].reshape(B, M, width)
+        cosA = sc['cosA'][:, c, :, None]
+        sinA = sc['sinA'][:, c, :, None]
+        cth = cosA * bc - sinA * bs
+        sth = sinA * bc + cosA * bs
+        amp = sc['amp'][:, c, :, None]
+        in_win = s_lane < sc['n_samp'][:, c, :, None]
+        y_is.append(jnp.where(in_win, amp * (e_i * cth - e_q * sth), zero))
+        y_qs.append(jnp.where(in_win, amp * (e_i * sth + e_q * cth), zero))
+    return jnp.stack(y_is, axis=1), jnp.stack(y_qs, axis=1)
 
 
 def _synth_windows(st: dict, tables, W: int):
@@ -250,7 +291,8 @@ def _synth_windows(st: dict, tables, W: int):
     sc = _window_scalars(st, tables)
     interps = tuple(int(x) for x in np.asarray(tables[3]))
     toeplitz = _toeplitz_tables(_pad_env_planes(tables[0], W), W, interps)
-    return _synth_window_chunk(sc, toeplitz, jnp.int32(0), W, interps)
+    basis = _carrier_basis(tables[1], W)
+    return _synth_window_chunk(sc, toeplitz, basis, jnp.int32(0), W, interps)
 
 
 def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
@@ -289,11 +331,14 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
     gs_i, gs_q = gs[..., 0:1], gs[..., 1:2]
 
     toeplitz = _toeplitz_tables(env_pads, chunk, interps)
+    # basis covers the padded span so the last chunk's slice stays in
+    # range (samples past W are masked by in_win anyway)
+    basis = _carrier_basis(tables[1], n_chunks * chunk)
 
     def chunk_body(carry, k):
         acc_i, acc_q, energy = carry
-        y_i, y_q = _synth_window_chunk(sc, toeplitz, k * chunk, chunk,
-                                       interps)
+        y_i, y_q = _synth_window_chunk(sc, toeplitz, basis, k * chunk,
+                                       chunk, interps)
         # I/Q noise as two [..., chunk] draws: a trailing axis of 2 would
         # tile-pad 64x on TPU ((8,128) lanes) and blow HBM
         shape = (B, C, M, chunk)
@@ -313,27 +358,93 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
     (acc_i, acc_q, energy), _ = jax.lax.scan(
         chunk_body, (zeros, zeros, zeros),
         jnp.arange(n_chunks, dtype=jnp.int32))
-    # clean responses a_s = g_s * E
+    new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)
+    bits = jnp.where(pending, new_bit, bits)
+    return bits, valid | fired
+
+
+def _discriminate_acc(acc_i, acc_q, energy, g0, g1):
+    """Project the matched-filter accumulation onto the |0>-|1> axis
+    (clean responses a_s = g_s * E) and threshold."""
     a0_i = g0[None, :, None, 0] * energy
     a0_q = g0[None, :, None, 1] * energy
     a1_i = g1[None, :, None, 0] * energy
     a1_q = g1[None, :, None, 1] * energy
     proj = (acc_i - (a0_i + a1_i) / 2) * (a1_i - a0_i) \
         + (acc_q - (a0_q + a1_q) / 2) * (a1_q - a0_q)
-    new_bit = (proj > 0).astype(jnp.int32)
+    return (proj > 0).astype(jnp.int32)
 
+
+def _resolve_analytic(st: dict, bits, valid, key, tables, env_pads,
+                      response, W: int):
+    """Exact distributional shortcut of :func:`_resolve` for the
+    white-noise matched-filter model.
+
+    The matched filter is linear, so demodulating (g_s*y + noise)
+    against y gives exactly ``acc = g_s*E + sigma*sqrt(E)*xi`` with
+    ``E = sum |y|^2`` and ``xi ~ N(0, I2)`` — same bit distribution as
+    the per-sample path, no per-sample computation.  The carrier drops
+    out of E (|e^{i theta}| = 1), so the window energy is
+    ``amp^2 * interp * (pref[b] - pref[a])`` from a prefix sum of
+    |env|^2 over the padded plane — the pad reproduces the
+    hold-last-sample overrun semantics.  Noise stays deterministic per
+    (shot, core, slot) given the run key.
+
+    Use when the channel model is exactly state-scaled response plus
+    white noise (ReadoutPhysics today); per-sample mode is the general
+    path for structured models.
+    """
+    g0, g1, sigma = response
+    B, C, M = bits.shape
+    fired = jnp.arange(M)[None, None, :] < st['n_meas'][..., None]
+    pending = fired & ~valid
+    sc = _window_scalars(st, tables)
+
+    env_i_pad, env_q_pad = env_pads                   # [C, Lp]
+    Lp = env_i_pad.shape[1]
+    env2 = env_i_pad ** 2 + env_q_pad ** 2
+    pref = jnp.concatenate(
+        [jnp.zeros((C, 1), jnp.float32), jnp.cumsum(env2, axis=-1)], -1)
+    last2 = env2[:, -1]                               # held overrun value
+    interp_c = sc['interp_c']                         # [1, C, 1]
+    count = jnp.minimum(sc['n_samp'], W)              # DAC samples
+    n_full = count // interp_c                        # whole env samples
+    n_part = count % interp_c                         # trailing partial
+    a = jnp.clip(sc['addr'], 0, Lp)
+    b = jnp.clip(sc['addr'] + n_full, 0, Lp)
+    c_idx = sc['c_idx']
+    in_table = pref[c_idx, b] - pref[c_idx, a]        # [B, C, M]
+    # samples past the padded table hold the final value indefinitely
+    # (the per-sample path's clamped Toeplitz base reads pure pad rows)
+    held = (n_full - (b - a)).astype(jnp.float32) * last2[c_idx]
+    part_val = env2[c_idx, jnp.clip(sc['addr'] + n_full, 0, Lp - 1)]
+    energy = sc['amp'] ** 2 * (
+        interp_c.astype(jnp.float32) * (in_table + held)
+        + n_part.astype(jnp.float32) * part_val)
+
+    gs = jnp.where(st['meas_state'][..., None] == 1,
+                   g1[None, :, None, :], g0[None, :, None, :])
+    root_e = jnp.sqrt(energy)
+    k_i, k_q = jax.random.split(key)
+    shape = (B, C, M)
+    acc_i = gs[..., 0] * energy + sigma * root_e * \
+        jax.random.normal(k_i, shape, jnp.float32)
+    acc_q = gs[..., 1] * energy + sigma * root_e * \
+        jax.random.normal(k_q, shape, jnp.float32)
+    new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)
     bits = jnp.where(pending, new_bit, bits)
     return bits, valid | fired
 
 
 @functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'W',
                                              'max_epochs', 'chunk',
-                                             'spcs', 'interps'))
+                                             'spcs', 'interps', 'mode'))
 def _run_physics_jit(soa, spc, interp, sync_part, qturns0, init_regs,
                      env_stack, freq_stack, g0, g1, sigma,
                      key, cfg: InterpreterConfig, n_cores: int, W: int,
                      max_epochs: int, chunk: int = None,
-                     spcs: tuple = (), interps: tuple = ()) -> dict:
+                     spcs: tuple = (), interps: tuple = (),
+                     mode: str = 'persample') -> dict:
     B = qturns0.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
@@ -358,8 +469,12 @@ def _run_physics_jit(soa, spc, interp, sync_part, qturns0, init_regs,
     def body(carry):
         st, bits, valid, ep = carry
         st = _exec_loop(st, soa, spc, interp, sync_part, bits, valid, cfg)
-        bits, valid = _resolve(st, bits, valid, key, tables, env_pads,
-                               response, W, chunk, interps)
+        if mode == 'analytic':
+            bits, valid = _resolve_analytic(st, bits, valid, key, tables,
+                                            env_pads, response, W)
+        else:
+            bits, valid = _resolve(st, bits, valid, key, tables, env_pads,
+                                   response, W, chunk, interps)
         st = dict(st, paused=jnp.zeros_like(st['paused']))
         return st, bits, valid, ep + 1
 
@@ -442,10 +557,13 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     # epoch bound: each epoch resolves at least one measurement, and a
     # cross-core dependency chain can serialize them — C*M+1 covers the
     # worst case (the loop exits early once every shot is done)
+    if model.resolve_mode not in ('persample', 'analytic'):
+        raise ValueError(f'unknown resolve_mode {model.resolve_mode!r}')
     return _run_physics_jit(
         soa, spc, interp, sync_part, qturns0, init_regs, env_stack,
         freq_stack, as_iq(model.g0), as_iq(model.g1),
         jnp.float32(model.sigma), key_noise, cfg, C, W,
         C * cfg.max_meas + 1, model.resolve_chunk,
         tuple(int(x) for x in np.asarray(spc_m)),
-        tuple(int(x) for x in np.asarray(interp_m)))
+        tuple(int(x) for x in np.asarray(interp_m)),
+        model.resolve_mode)
