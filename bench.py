@@ -81,6 +81,48 @@ def pallas_compiled_parity() -> bool:
     return not interpret
 
 
+def large_program_scaling(n_qubits: int, small_depth: int,
+                          batch: int = 32768):
+    """Per-instruction throughput on a deep program (depth-100 RB, past
+    the one-hot/gather fetch crossover) vs the headline program — the
+    round-1 review's scale-test criterion.  Injected-bits interpretation
+    only (the RB body has no feedback), one steady-state batch each."""
+    from distributed_processor_tpu.sim.interpreter import (
+        _run_batch, _program_constants)
+
+    results = {}
+    for label, depth in (('small', small_depth), ('large', 100)):
+        mp = build_machine_program(n_qubits, depth)
+        cfg = InterpreterConfig(
+            max_steps=2 * mp.n_instr + 64,
+            max_pulses=int(mp.max_pulses_per_core(1)) + 4,
+            max_meas=2, max_resets=2)
+        soa, spc, interp, sync_part = _program_constants(mp, cfg)
+        C = mp.n_cores
+
+        @jax.jit
+        def run(bits):
+            out = _run_batch(soa, spc, interp, sync_part, bits, cfg, C)
+            return (out['n_pulses'].sum(), out['err'].sum(),
+                    out['incomplete'])
+
+        bits = jnp.zeros((batch, C, cfg.max_meas), jnp.int32)
+        jax.block_until_ready(run(bits))
+        t0 = time.perf_counter()
+        res = jax.block_until_ready(run(bits))
+        dt = time.perf_counter() - t0
+        assert not bool(res[2]), f'{label} scaling run truncated'
+        assert int(res[1]) == 0, f'{label} scaling run set error bits'
+        results[label] = {
+            'n_instr': mp.n_instr,
+            'instr_shots_per_sec': round(batch * mp.n_instr / dt, 0),
+        }
+    small = results['small']['instr_shots_per_sec']
+    large = results['large']['instr_shots_per_sec']
+    results['large_vs_small_per_instr'] = round(large / small, 3)
+    return results
+
+
 def main():
     n_qubits = int(os.environ.get('BENCH_QUBITS', 8))
     depth = int(os.environ.get('BENCH_DEPTH', 12))
@@ -160,6 +202,13 @@ def main():
     assert not a_incomplete, \
         f'{a_incomplete} analytic batches did not complete'
 
+    # guarded: a failure here must not discard the minutes of headline
+    # measurement already taken
+    try:
+        scaling = large_program_scaling(n_qubits, small_depth=depth)
+    except Exception as e:      # pragma: no cover - defensive
+        scaling = {'error': f'{type(e).__name__}: {e}'[:200]}
+
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
     result = {
@@ -177,6 +226,7 @@ def main():
             'compile_s': round(t_compile, 3), 'jit_s': round(t_jit, 3),
             'run_s': round(elapsed, 3), 'err_shots': err_total,
             'analytic_shots_per_sec': round(analytic_sps, 1),
+            'scaling': scaling,
             'pallas_compiled': pallas_compiled,
             'platform': jax.devices()[0].platform,
             'device': str(jax.devices()[0]),
