@@ -13,6 +13,9 @@ translator consumes:
 * ``c[i] = measure q[j];`` and bare ``measure q[j];``
 * classical assignment ``x = a + 2 * b;``
 * ``if (cond) { ... } else { ... }`` with comparison conditions
+* ``for uint i in [a:b] { ... }`` / ``[a:step:b]`` (inclusive ranges)
+* ``while (cond) { ... }``
+* ``delay[100ns] q[0];`` (units ns/us/ms/s)
 * ``barrier q;``
 
 Output is a tiny AST of plain dataclasses consumed by
@@ -81,6 +84,29 @@ class If:
 
 
 @dataclass
+class For:
+    var: str
+    start: object        # expressions (folded to ints by the visitor)
+    step: object
+    stop: object
+    body: list = field(default_factory=list)
+
+
+@dataclass
+class While:
+    lhs: object
+    op: str
+    rhs: object
+    body: list = field(default_factory=list)
+
+
+@dataclass
+class Delay:
+    duration: float      # seconds
+    operands: list = field(default_factory=list)
+
+
+@dataclass
 class Barrier:
     operands: list = field(default_factory=list)
 
@@ -101,7 +127,7 @@ _TOKEN_RE = re.compile(r'''
   | (?P<num>\d+\.\d*(e[+-]?\d+)?|\.\d+(e[+-]?\d+)?|\d+(e[+-]?\d+)?)
   | (?P<id>[A-Za-z_$][A-Za-z_0-9]*)
   | (?P<str>"[^"]*")
-  | (?P<op>==|!=|<=|>=|->|[-+*/%(){}\[\];,=<>])
+  | (?P<op>==|!=|<=|>=|->|[-+*/%(){}\[\];,=<>:])
 ''', re.VERBOSE | re.DOTALL)
 
 
@@ -125,7 +151,10 @@ def tokenize(src: str) -> list[tuple[str, str]]:
 # ---------------------------------------------------------------------------
 
 _KEYWORDS = {'qubit', 'bit', 'int', 'float', 'reset', 'measure', 'if',
-             'else', 'barrier', 'include', 'OPENQASM', 'pragma', 'const'}
+             'else', 'barrier', 'include', 'OPENQASM', 'pragma', 'const',
+             'for', 'while', 'in', 'delay', 'uint', 'angle'}
+
+_TIME_UNITS = {'ns': 1e-9, 'us': 1e-6, 'ms': 1e-3, 's': 1.0}
 
 
 class Parser:
@@ -179,8 +208,15 @@ class Parser:
             while self.next()[1] != ';':
                 pass
             return None
-        if val in ('qubit', 'bit', 'int', 'float', 'const'):
+        if val in ('qubit', 'bit', 'int', 'float', 'uint', 'angle',
+                   'const'):
             return self.decl()
+        if val == 'for':
+            return self.for_stmt()
+        if val == 'while':
+            return self.while_stmt()
+        if val == 'delay':
+            return self.delay_stmt()
         if val == 'reset':
             self.next()
             t = self.ref()
@@ -237,6 +273,63 @@ class Parser:
         self.expect(';')
         return Decl(kind, name, size, init)
 
+    def for_stmt(self) -> For:
+        """``for <type> name in [start:(step:)?stop] block`` — QASM3
+        ranges are inclusive on both ends."""
+        self.expect('for')
+        if self.peek()[1] in ('int', 'uint', 'float', 'angle'):
+            self.next()
+            if self.peek()[1] == '[':        # width designator
+                self.next()
+                self.next()
+                self.expect(']')
+        name = self.next()[1]
+        self.expect('in')
+        self.expect('[')
+        parts = [self.expr()]
+        while self.peek()[1] == ':':
+            self.next()
+            parts.append(self.expr())
+        self.expect(']')
+        if len(parts) == 2:
+            start, step, stop = parts[0], 1, parts[1]
+        elif len(parts) == 3:
+            start, step, stop = parts
+        else:
+            raise QASMSyntaxError('range must be [start:stop] or '
+                                  '[start:step:stop]')
+        return For(name, start, step, stop, self.block())
+
+    def while_stmt(self) -> While:
+        self.expect('while')
+        self.expect('(')
+        lhs = self.expr()
+        op = self.next()[1]
+        if op not in ('==', '!=', '<', '<=', '>', '>='):
+            raise QASMSyntaxError(f'bad comparison {op!r}')
+        rhs = self.expr()
+        self.expect(')')
+        return While(lhs, op, rhs, self.block())
+
+    def delay_stmt(self) -> Delay:
+        self.expect('delay')
+        self.expect('[')
+        kind, val = self.next()
+        if kind != 'num':
+            raise QASMSyntaxError(f'expected duration, got {val!r}')
+        ukind, unit = self.next()
+        if unit not in _TIME_UNITS:
+            raise QASMSyntaxError(
+                f'unknown time unit {unit!r} (use ns/us/ms/s)')
+        self.expect(']')
+        ops = []
+        while self.peek()[1] != ';':
+            ops.append(self.ref())
+            if self.peek()[1] == ',':
+                self.next()
+        self.next()
+        return Delay(float(val) * _TIME_UNITS[unit], ops)
+
     def if_stmt(self) -> If:
         self.expect('if')
         self.expect('(')
@@ -274,6 +367,8 @@ class Parser:
         kind, name = self.next()
         if kind != 'id':
             raise QASMSyntaxError(f'expected identifier, got {name!r}')
+        if name in _KEYWORDS:
+            raise QASMSyntaxError(f'{name!r} is a reserved keyword')
         index = None
         if self.peek()[1] == '[':
             self.next()

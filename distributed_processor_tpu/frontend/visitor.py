@@ -64,6 +64,17 @@ class QASMTranslator:
             raise QASMTranslationError(f'{ref.name!r} is not a qubit register')
         return self.qubit_map.get_hardware_qubit(ref.name, ref.index)
 
+    def _qubits_of(self, ref: qp.Ref) -> list[str]:
+        """One hardware qubit for an indexed ref; the whole register for
+        a bare-register ref (``delay[...] q;`` touches every qubit)."""
+        if ref.index is None:
+            if ref.name not in self.qubit_regs:
+                raise QASMTranslationError(
+                    f'{ref.name!r} is not a qubit register')
+            return [self.qubit_map.get_hardware_qubit(ref.name, i)
+                    for i in range(self.qubit_regs[ref.name])]
+        return [self._qubit(ref)]
+
     def _tmpvar(self) -> str:
         self._tmp += 1
         return f'_qasm_tmp{self._tmp}'
@@ -94,12 +105,21 @@ class QASMTranslator:
                 self.bit_sources[(s.out.name, s.out.index)] = q
             return [{'name': 'read', 'qubit': [q]}]
         if isinstance(s, qp.Barrier):
-            qubits = [self._qubit(r) for r in s.operands] or self.all_qubits
+            qubits = [q for r in s.operands for q in self._qubits_of(r)] \
+                or self.all_qubits
             return [{'name': 'barrier', 'qubit': qubits}]
         if isinstance(s, qp.Assign):
             return self._assign(s)
         if isinstance(s, qp.If):
             return self._if(s)
+        if isinstance(s, qp.For):
+            return self._for(s)
+        if isinstance(s, qp.While):
+            return self._while(s)
+        if isinstance(s, qp.Delay):
+            qubits = [q for r in s.operands for q in self._qubits_of(r)] \
+                or self.all_qubits
+            return [{'name': 'delay', 't': s.duration, 'qubit': qubits}]
         raise QASMTranslationError(f'unsupported statement {s}')
 
     def _decl(self, s: qp.Decl) -> list[dict]:
@@ -163,6 +183,77 @@ class QASMTranslator:
                            'true': true, 'false': false}]
         raise QASMTranslationError(
             f'{rhs.name!r} is neither a measured bit nor a variable')
+
+    def _loop_cond(self, lhs, op: str, rhs) -> tuple[int, str, str]:
+        """Normalise a comparison to the hardware loop/branch triple
+        ``(cond_lhs const, alu_cond in eq/ge/le, cond_rhs var)``.
+        Strict comparisons fold into the integer constant (``x < K`` ==
+        ``K-1 >= x``)."""
+        flipped = {'<': '>', '<=': '>=', '>': '<', '>=': '<=',
+                   '==': '=='}
+        if isinstance(lhs, qp.Ref) and lhs.name in self.int_vars:
+            if isinstance(rhs, qp.Ref):
+                raise QASMTranslationError(
+                    'loop conditions need one constant side')
+            lhs, rhs, op = rhs, lhs, flipped.get(op, op)
+        if not (isinstance(rhs, qp.Ref) and rhs.name in self.int_vars):
+            raise QASMTranslationError(
+                'loop condition must compare a declared variable')
+        const = self._const_expr(lhs)
+        if const != int(const):
+            raise QASMTranslationError('loop bounds must be integers')
+        const = int(const)
+        # condition is "const <alu_cond> var"
+        if op == '==':
+            return const, 'eq', rhs.name
+        if op == '<=':
+            return const, 'le', rhs.name
+        if op == '>=':
+            return const, 'ge', rhs.name
+        if op == '<':
+            return const + 1, 'le', rhs.name
+        if op == '>':
+            return const - 1, 'ge', rhs.name
+        raise QASMTranslationError(f'unsupported loop comparison {op!r}')
+
+    def _for(self, s: qp.For) -> list[dict]:
+        """``for i in [a:step:b]`` -> hardware counter loop (the
+        reference's loop instruction; the back-edge tests after each
+        iteration, and constant bounds make zero-trip ranges an error
+        the compiler's static analysis would otherwise mis-size)."""
+        start = int(self._const_expr(s.start))
+        step = int(self._const_expr(s.step))
+        stop = int(self._const_expr(s.stop))
+        if step == 0 or (stop < start if step > 0 else stop > start):
+            raise QASMTranslationError(
+                f'empty or non-terminating range [{start}:{step}:{stop}]')
+        declare = []
+        if s.var not in self.int_vars:       # sequential loops may reuse
+            self.int_vars.add(s.var)
+            declare = [{'name': 'declare', 'var': s.var, 'dtype': 'int',
+                        'scope': self.all_qubits}]
+        body = [i for st in s.body for i in self._stmt(st)]
+        body.append({'name': 'alu', 'op': 'add', 'lhs': step,
+                     'rhs': s.var, 'out': s.var})
+        return declare + [
+            {'name': 'set_var', 'var': s.var, 'value': start},
+            {'name': 'loop', 'cond_lhs': stop,
+             'alu_cond': 'ge' if step > 0 else 'le',
+             'cond_rhs': s.var, 'scope': self.all_qubits, 'body': body},
+        ]
+
+    def _while(self, s: qp.While) -> list[dict]:
+        """``while (cond)`` -> branch_var guard around a do-while
+        hardware loop (the loop's back-edge tests after the body, so the
+        guard supplies the test-before-first-iteration semantics)."""
+        cond_lhs, alu_cond, var = self._loop_cond(s.lhs, s.op, s.rhs)
+        body = [i for st in s.body for i in self._stmt(st)]
+        loop = {'name': 'loop', 'cond_lhs': cond_lhs,
+                'alu_cond': alu_cond, 'cond_rhs': var,
+                'scope': self.all_qubits, 'body': body}
+        return [{'name': 'branch_var', 'alu_cond': alu_cond,
+                 'cond_lhs': cond_lhs, 'cond_rhs': var,
+                 'scope': self.all_qubits, 'true': [loop], 'false': []}]
 
     # -- expressions -----------------------------------------------------
 

@@ -227,6 +227,18 @@ class RegisterVarsAndFreqs(Pass):
                     if isinstance(instr.lhs, str):
                         instr.scope = set(ir_prog.vars[instr.lhs].scope)
 
+        # widen block scopes with the var-derived instruction scopes: a
+        # block whose only instructions are var-scoped (e.g. a bare
+        # set_var between two hardware loops) otherwise has an empty
+        # scope, gets no sequential CFG edge, and the scheduler never
+        # seeds its clocks (KeyError in Schedule)
+        for node in ir_prog.blocks:
+            blk = ir_prog.blocks[node]
+            for instr in blk['instructions']:
+                sc = getattr(instr, 'scope', None)
+                if sc:
+                    blk['scope'] = set(blk['scope']) | set(sc)
+
 
 class ResolveGates(Pass):
     """Expand Gate instructions into Barrier + Pulse/VirtualZ sequences

@@ -139,3 +139,113 @@ def test_qasm_end_to_end_simulation():
     assert np.all(np.asarray(out1['err']) == 0)
     # measured-1 branch adds the two X90 flip pulses on core 0
     assert int(out1['n_pulses'][0]) == int(out0['n_pulses'][0]) + 2
+
+
+def test_for_loop_lowers_to_hardware_loop():
+    prog = qasm_to_program('''
+        qubit[1] q;
+        for uint i in [0:9] { sx q[0]; }
+    ''')
+    loop = next(i for i in prog if i['name'] == 'loop')
+    assert loop['cond_lhs'] == 9 and loop['alu_cond'] == 'ge'
+    assert loop['cond_rhs'] == 'i'
+    incr = loop['body'][-1]
+    assert incr == {'name': 'alu', 'op': 'add', 'lhs': 1, 'rhs': 'i',
+                    'out': 'i'}
+    # executes exactly 10 iterations on device
+    import numpy as np
+    from distributed_processor_tpu.simulator import Simulator
+    sim = Simulator(n_qubits=1)
+    out = sim.run(sim.compile(prog), shots=1, max_meas=1)
+    assert not bool(out['incomplete'])
+    assert np.all(np.asarray(out['err']) == 0)
+    assert int(np.asarray(out['n_pulses'])[0]) == 10
+
+
+def test_for_loop_step_and_empty_range():
+    prog = qasm_to_program('''
+        qubit[1] q;
+        for int i in [10:-2:0] { sx q[0]; }
+    ''')
+    loop = next(i for i in prog if i['name'] == 'loop')
+    assert loop['cond_lhs'] == 0 and loop['alu_cond'] == 'le'
+    import pytest
+    with pytest.raises(Exception, match='empty or non-terminating'):
+        qasm_to_program('qubit[1] q; for uint i in [5:1] { sx q[0]; }')
+
+
+def test_while_loop_guard_and_body():
+    prog = qasm_to_program('''
+        qubit[1] q;
+        int[32] n = 0;
+        while (n < 3) { sx q[0]; n = n + 1; }
+    ''')
+    guard = prog[-1]
+    assert guard['name'] == 'branch_var'
+    assert guard['cond_lhs'] == 2 and guard['alu_cond'] == 'ge'
+    assert guard['false'] == []
+    loop = guard['true'][0]
+    assert loop['name'] == 'loop' and loop['cond_rhs'] == 'n'
+    # while (n < 3) with n starting at 3: body never runs
+    import numpy as np
+    from distributed_processor_tpu.simulator import Simulator
+    sim = Simulator(n_qubits=1)
+    prog0 = qasm_to_program('''
+        qubit[1] q;
+        int[32] n = 3;
+        while (n < 3) { sx q[0]; n = n + 1; }
+    ''')
+    out = sim.run(sim.compile(prog0), shots=1, max_meas=1)
+    assert int(np.asarray(out['n_pulses'])[0]) == 0
+    # and starting at 0: exactly 3 iterations
+    out = sim.run(sim.compile(prog), shots=1, max_meas=1)
+    assert int(np.asarray(out['n_pulses'])[0]) == 3
+
+
+def test_delay_statement():
+    prog = qasm_to_program('''
+        qubit[2] q;
+        sx q[0];
+        delay[500ns] q[0];
+        sx q[0];
+    ''')
+    d = next(i for i in prog if i['name'] == 'delay')
+    assert abs(d['t'] - 5e-7) < 1e-15 and d['qubit'] == ['Q0']
+    # the delay shows up as a gap in scheduled pulse times
+    import numpy as np
+    from distributed_processor_tpu.simulator import Simulator
+    sim = Simulator(n_qubits=2)
+    out = sim.run(sim.compile(prog), shots=1, max_meas=1)
+    gt = np.asarray(out['rec_gtime'])[0]
+    # 500 ns = 250 clks at 2 ns/clk
+    assert gt[1] - gt[0] >= 250
+
+
+def test_for_loop_var_reuse_and_single_element_ranges():
+    """Review regressions: sequential loops reusing a variable compile;
+    single-element negative-step ranges are valid."""
+    import numpy as np
+    from distributed_processor_tpu.simulator import Simulator
+    prog = qasm_to_program('''
+        qubit[1] q;
+        for uint i in [0:1] { sx q[0]; }
+        for uint i in [0:2] { sx q[0]; }
+        for int j in [3:-1:3] { sx q[0]; }
+    ''')
+    sim = Simulator(n_qubits=1)
+    out = sim.run(sim.compile(prog), shots=1, max_meas=1)
+    assert int(np.asarray(out['n_pulses'])[0]) == 2 + 3 + 1
+
+
+def test_whole_register_delay_and_barrier():
+    """`delay[...] q;` / `barrier q;` touch every qubit of the register,
+    not just element 0 (review regression)."""
+    prog = qasm_to_program('''
+        qubit[2] q;
+        barrier q;
+        delay[100ns] q;
+    ''')
+    b = next(i for i in prog if i['name'] == 'barrier')
+    d = next(i for i in prog if i['name'] == 'delay')
+    assert b['qubit'] == ['Q0', 'Q1']
+    assert d['qubit'] == ['Q0', 'Q1']
