@@ -56,12 +56,19 @@ class SingleCoreAssembler:
 
     def from_list(self, cmd_list: list[dict]):
         cmd_list = [dict(c) for c in cmd_list]   # do not mutate caller's program
-        pending_label = None
+        pending_labels = []
         for cmd in cmd_list:
-            if pending_label is not None:
-                cmd = {**cmd, 'label': pending_label}
-                pending_label = None
             op = cmd['op']
+            # declare_* emit no machine instruction: labels pending at a
+            # declaration bind to the next real instruction (e.g. a loop
+            # label whose block starts with a declare).  Several labels
+            # may accumulate (label, declares, label); all alias the
+            # same instruction address.
+            if pending_labels and op not in ('declare_reg', 'declare_freq',
+                                             'jump_label'):
+                cmd = {**cmd, 'label': pending_labels[0]
+                       if len(pending_labels) == 1 else tuple(pending_labels)}
+                pending_labels = []
             args = {k: v for k, v in cmd.items() if k != 'op'}
             if op == 'pulse':
                 n_reg_params = sum(isinstance(cmd.get(k), str)
@@ -90,11 +97,12 @@ class SingleCoreAssembler:
             elif op == 'jump_i':
                 self.add_jump_i(**args)
             elif op == 'jump_label':
-                pending_label = args['dest_label']
+                pending_labels.append(args['dest_label'])
             else:
                 raise ValueError(f'unsupported assembly op: {cmd}')
-        if pending_label is not None:
-            raise ValueError(f'jump label {pending_label} at end of program')
+        if pending_labels:
+            raise ValueError(
+                f'jump label(s) {pending_labels} at end of program')
 
     def declare_reg(self, name: str, dtype=('int',)):
         if name in self._regs:
@@ -345,9 +353,12 @@ class SingleCoreAssembler:
         labelmap = {}
         for i, cmd in enumerate(self._program):
             if 'label' in cmd:
-                if cmd['label'] in labelmap:
-                    raise ValueError(f"label {cmd['label']} used twice")
-                labelmap[cmd['label']] = i
+                labels = cmd['label'] if isinstance(cmd['label'], tuple) \
+                    else (cmd['label'],)
+                for label in labels:
+                    if label in labelmap:
+                        raise ValueError(f'label {label} used twice')
+                    labelmap[label] = i
         return labelmap
 
     def _get_env_buffer(self, elem_ind):

@@ -224,13 +224,7 @@ class Parser:
             return Reset(t)
         if val == 'barrier':
             self.next()
-            ops = []
-            while self.peek()[1] != ';':
-                ops.append(self.ref())
-                if self.peek()[1] == ',':
-                    self.next()
-            self.next()
-            return Barrier(ops)
+            return Barrier(self._ref_list())
         if val == 'if':
             return self.if_stmt()
         if val == 'measure':
@@ -283,7 +277,9 @@ class Parser:
                 self.next()
                 self.next()
                 self.expect(']')
-        name = self.next()[1]
+        kind, name = self.next()
+        if kind != 'id' or name in _KEYWORDS:
+            raise QASMSyntaxError(f'bad loop variable {name!r}')
         self.expect('in')
         self.expect('[')
         parts = [self.expr()]
@@ -305,8 +301,10 @@ class Parser:
         self.expect('(')
         lhs = self.expr()
         op = self.next()[1]
-        if op not in ('==', '!=', '<', '<=', '>', '>='):
-            raise QASMSyntaxError(f'bad comparison {op!r}')
+        # '!=' has no eq/ge/le hardware-loop lowering: reject at parse
+        if op not in ('==', '<', '<=', '>', '>='):
+            raise QASMSyntaxError(
+                f'unsupported while comparison {op!r} (use ==/</<=/>/>=)')
         rhs = self.expr()
         self.expect(')')
         return While(lhs, op, rhs, self.block())
@@ -322,13 +320,7 @@ class Parser:
             raise QASMSyntaxError(
                 f'unknown time unit {unit!r} (use ns/us/ms/s)')
         self.expect(']')
-        ops = []
-        while self.peek()[1] != ';':
-            ops.append(self.ref())
-            if self.peek()[1] == ',':
-                self.next()
-        self.next()
-        return Delay(float(val) * _TIME_UNITS[unit], ops)
+        return Delay(float(val) * _TIME_UNITS[unit], self._ref_list())
 
     def if_stmt(self) -> If:
         self.expect('if')
@@ -362,6 +354,16 @@ class Parser:
             operands.append(self.ref())
         self.expect(';')
         return GateCall(name, params, operands)
+
+    def _ref_list(self) -> list:
+        """Comma-separated operand refs terminated by ';' (consumed)."""
+        ops = []
+        while self.peek()[1] != ';':
+            ops.append(self.ref())
+            if self.peek()[1] == ',':
+                self.next()
+        self.next()
+        return ops
 
     def ref(self) -> Ref:
         kind, name = self.next()

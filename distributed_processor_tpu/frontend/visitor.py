@@ -40,6 +40,9 @@ class QASMTranslator:
         self.bit_regs: dict[str, int] = {}
         self.int_vars: set[str] = set()
         self.bit_sources: dict[tuple, str] = {}  # (reg, idx) -> qubit name
+        # QASM3 loop variables are loop-scoped: shadowing names map to
+        # unique internal vars for the body's duration
+        self._var_alias: dict[str, str] = {}
         self._tmp = 0
 
     # -- public ----------------------------------------------------------
@@ -79,6 +82,14 @@ class QASMTranslator:
         self._tmp += 1
         return f'_qasm_tmp{self._tmp}'
 
+    def _varname(self, name: str) -> str:
+        """Resolve a source-level variable through active loop aliases."""
+        return self._var_alias.get(name, name)
+
+    def _operands_or_all(self, operands) -> list[str]:
+        return [q for r in operands for q in self._qubits_of(r)] \
+            or self.all_qubits
+
     # -- statements ------------------------------------------------------
 
     def _stmt(self, s) -> list[dict]:
@@ -105,9 +116,8 @@ class QASMTranslator:
                 self.bit_sources[(s.out.name, s.out.index)] = q
             return [{'name': 'read', 'qubit': [q]}]
         if isinstance(s, qp.Barrier):
-            qubits = [q for r in s.operands for q in self._qubits_of(r)] \
-                or self.all_qubits
-            return [{'name': 'barrier', 'qubit': qubits}]
+            return [{'name': 'barrier',
+                     'qubit': self._operands_or_all(s.operands)}]
         if isinstance(s, qp.Assign):
             return self._assign(s)
         if isinstance(s, qp.If):
@@ -117,9 +127,8 @@ class QASMTranslator:
         if isinstance(s, qp.While):
             return self._while(s)
         if isinstance(s, qp.Delay):
-            qubits = [q for r in s.operands for q in self._qubits_of(r)] \
-                or self.all_qubits
-            return [{'name': 'delay', 't': s.duration, 'qubit': qubits}]
+            return [{'name': 'delay', 't': s.duration,
+                     'qubit': self._operands_or_all(s.operands)}]
         raise QASMTranslationError(f'unsupported statement {s}')
 
     def _decl(self, s: qp.Decl) -> list[dict]:
@@ -140,18 +149,19 @@ class QASMTranslator:
         return out
 
     def _assign(self, s: qp.Assign) -> list[dict]:
-        if s.target.name not in self.int_vars:
+        target = self._varname(s.target.name)
+        if target not in self.int_vars:
             raise QASMTranslationError(
                 f'{s.target.name!r} is not a declared variable')
         pre, val = self._expr(s.expr)
         if isinstance(val, str) or not pre:
             # simple value or variable: set_var / alu-into-target
             if pre and pre[-1].get('out') is not None:
-                pre[-1]['out'] = s.target.name
+                pre[-1]['out'] = target
                 return pre
-            return pre + [{'name': 'set_var', 'var': s.target.name,
+            return pre + [{'name': 'set_var', 'var': target,
                            'value': val}]
-        pre[-1]['out'] = s.target.name
+        pre[-1]['out'] = target
         return pre
 
     def _if(self, s: qp.If) -> list[dict]:
@@ -176,9 +186,10 @@ class QASMTranslator:
                            'cond_lhs': lhs_val, 'func_id': f'{q}.meas',
                            'scope': self.all_qubits,
                            'true': true, 'false': false}]
-        if rhs.name in self.int_vars:        # variable branch
+        if self._varname(rhs.name) in self.int_vars:   # variable branch
             return pre + [{'name': 'branch_var', 'alu_cond': cond,
-                           'cond_lhs': lhs_val, 'cond_rhs': rhs.name,
+                           'cond_lhs': lhs_val,
+                           'cond_rhs': self._varname(rhs.name),
                            'scope': self.all_qubits,
                            'true': true, 'false': false}]
         raise QASMTranslationError(
@@ -191,55 +202,70 @@ class QASMTranslator:
         ``K-1 >= x``)."""
         flipped = {'<': '>', '<=': '>=', '>': '<', '>=': '<=',
                    '==': '=='}
-        if isinstance(lhs, qp.Ref) and lhs.name in self.int_vars:
+        if isinstance(lhs, qp.Ref) and self._varname(lhs.name) \
+                in self.int_vars:
             if isinstance(rhs, qp.Ref):
                 raise QASMTranslationError(
                     'loop conditions need one constant side')
             lhs, rhs, op = rhs, lhs, flipped.get(op, op)
-        if not (isinstance(rhs, qp.Ref) and rhs.name in self.int_vars):
+        if not (isinstance(rhs, qp.Ref)
+                and self._varname(rhs.name) in self.int_vars):
             raise QASMTranslationError(
                 'loop condition must compare a declared variable')
+        var = self._varname(rhs.name)
         const = self._const_expr(lhs)
         if const != int(const):
             raise QASMTranslationError('loop bounds must be integers')
         const = int(const)
         # condition is "const <alu_cond> var"
         if op == '==':
-            return const, 'eq', rhs.name
+            return const, 'eq', var
         if op == '<=':
-            return const, 'le', rhs.name
+            return const, 'le', var
         if op == '>=':
-            return const, 'ge', rhs.name
+            return const, 'ge', var
         if op == '<':
-            return const + 1, 'le', rhs.name
+            return const + 1, 'le', var
         if op == '>':
-            return const - 1, 'ge', rhs.name
+            return const - 1, 'ge', var
         raise QASMTranslationError(f'unsupported loop comparison {op!r}')
 
     def _for(self, s: qp.For) -> list[dict]:
         """``for i in [a:step:b]`` -> hardware counter loop (the
         reference's loop instruction; the back-edge tests after each
-        iteration, and constant bounds make zero-trip ranges an error
-        the compiler's static analysis would otherwise mis-size)."""
+        iteration, so a statically-empty range lowers to a no-op).
+        The loop variable is loop-scoped per QASM3: shadowing an outer
+        name maps it to a unique internal var for the body."""
         start = int(self._const_expr(s.start))
         step = int(self._const_expr(s.step))
         stop = int(self._const_expr(s.stop))
-        if step == 0 or (stop < start if step > 0 else stop > start):
-            raise QASMTranslationError(
-                f'empty or non-terminating range [{start}:{step}:{stop}]')
-        declare = []
-        if s.var not in self.int_vars:       # sequential loops may reuse
-            self.int_vars.add(s.var)
-            declare = [{'name': 'declare', 'var': s.var, 'dtype': 'int',
-                        'scope': self.all_qubits}]
-        body = [i for st in s.body for i in self._stmt(st)]
+        if step == 0:
+            raise QASMTranslationError('range step must be nonzero')
+        if stop < start if step > 0 else stop > start:
+            return []                        # statically empty: zero trips
+        var = s.var
+        if var in self.int_vars:             # shadow or sequential reuse
+            self._tmp += 1
+            var = f'{s.var}__loop{self._tmp}'
+        self.int_vars.add(var)
+        outer = self._var_alias.get(s.var)
+        self._var_alias[s.var] = var
+        try:
+            body = [i for st in s.body for i in self._stmt(st)]
+        finally:
+            if outer is None:
+                self._var_alias.pop(s.var, None)
+            else:
+                self._var_alias[s.var] = outer
         body.append({'name': 'alu', 'op': 'add', 'lhs': step,
-                     'rhs': s.var, 'out': s.var})
-        return declare + [
-            {'name': 'set_var', 'var': s.var, 'value': start},
+                     'rhs': var, 'out': var})
+        return [
+            {'name': 'declare', 'var': var, 'dtype': 'int',
+             'scope': self.all_qubits},
+            {'name': 'set_var', 'var': var, 'value': start},
             {'name': 'loop', 'cond_lhs': stop,
              'alu_cond': 'ge' if step > 0 else 'le',
-             'cond_rhs': s.var, 'scope': self.all_qubits, 'body': body},
+             'cond_rhs': var, 'scope': self.all_qubits, 'body': body},
         ]
 
     def _while(self, s: qp.While) -> list[dict]:
@@ -282,8 +308,9 @@ class QASMTranslator:
         if isinstance(e, (int, float)):
             return [], int(e)
         if isinstance(e, qp.Ref):
-            if e.name in self.int_vars:
-                return [], e.name
+            name = self._varname(e.name)
+            if name in self.int_vars:
+                return [], name
             if e.name in ('pi', 'π'):
                 return [], np.pi
             raise QASMTranslationError(f'unknown variable {e.name!r}')

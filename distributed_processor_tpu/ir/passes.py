@@ -46,7 +46,22 @@ class FlattenProgram(Pass):
         assert len(ir_prog.control_flow_graph.nodes) == 1
         blockname = next(iter(ir_prog.control_flow_graph.nodes))
         instrs = ir_prog.blocks[blockname]['instructions']
+        self._used_labels = set()
         ir_prog.blocks[blockname]['instructions'] = self._flatten(instrs)
+
+    def _unique(self, label: str) -> str:
+        """Sibling bodies flattened in separate recursive calls restart
+        their local index, so generated names can collide (e.g. two
+        sequential branch-wrapped loops both yielding
+        ``true_loop_0_loopctrl``); MakeBasicBlocks would then silently
+        overwrite the first block.  First occurrence keeps the
+        reference-compatible name; collisions get a ``_u<n>`` suffix."""
+        out, n = label, 0
+        while out in self._used_labels:
+            n += 1
+            out = f'{label}_u{n}'
+        self._used_labels.add(out)
+        return out
 
     def _flatten(self, program, label_prefix=''):
         out = []
@@ -56,8 +71,8 @@ class FlattenProgram(Pass):
             if statement.name in ('branch_fproc', 'branch_var'):
                 flat_true = self._flatten(statement.true, 'true_' + label_prefix)
                 flat_false = self._flatten(statement.false, 'false_' + label_prefix)
-                label_false = f'{label_prefix}false_{branchind}'
-                label_end = f'{label_prefix}end_{branchind}'
+                label_false = self._unique(f'{label_prefix}false_{branchind}')
+                label_end = self._unique(f'{label_prefix}end_{branchind}')
 
                 if statement.name == 'branch_fproc':
                     jump = iri.JumpFproc(alu_cond=statement.alu_cond,
@@ -69,7 +84,7 @@ class FlattenProgram(Pass):
                                         cond_lhs=statement.cond_lhs,
                                         cond_rhs=statement.cond_rhs,
                                         scope=statement.scope, jump_label=None)
-                label_true = f'{label_prefix}true_{branchind}'
+                label_true = self._unique(f'{label_prefix}true_{branchind}')
                 jump.jump_label = label_true if flat_true else label_end
                 out.append(jump)
 
@@ -84,7 +99,15 @@ class FlattenProgram(Pass):
 
             elif statement.name == 'loop':
                 flat_body = self._flatten(statement.body, 'loop_body_' + label_prefix)
-                loop_label = f'{label_prefix}loop_{branchind}_loopctrl'
+                # loopctrl suffix is load-bearing (block naming): keep it
+                # terminal when disambiguating
+                base = f'{label_prefix}loop_{branchind}'
+                out_base, n = base, 0
+                while f'{out_base}_loopctrl' in self._used_labels:
+                    n += 1
+                    out_base = f'{base}_u{n}'
+                loop_label = f'{out_base}_loopctrl'
+                self._used_labels.add(loop_label)
                 out.append(iri.JumpLabel(label=loop_label, scope=statement.scope))
                 out.append(iri.Barrier(qubit=statement.scope))
                 out.extend(flat_body)
@@ -127,6 +150,14 @@ class MakeBasicBlocks(Pass):
                     ctrl_blockname = f'{statement.jump_label}_ctrl'
                 else:
                     ctrl_blockname = f'{cur_blockname}_ctrl'
+                # networkx add_node REPLACES a same-named node: a branch
+                # jump inside a loop body would otherwise collide with
+                # (and be overwritten by) the loop back-edge's
+                # '<label>_ctrl' block, silently dropping the branch
+                base, n = ctrl_blockname, 0
+                while ctrl_blockname in g:
+                    n += 1
+                    ctrl_blockname = f'{base}_u{n}'
                 g.add_node(ctrl_blockname, instructions=[statement], ind=block_ind)
                 block_ind += 1
                 cur_blockname = f'block_{blockname_ind}'

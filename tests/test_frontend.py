@@ -170,8 +170,8 @@ def test_for_loop_step_and_empty_range():
     loop = next(i for i in prog if i['name'] == 'loop')
     assert loop['cond_lhs'] == 0 and loop['alu_cond'] == 'le'
     import pytest
-    with pytest.raises(Exception, match='empty or non-terminating'):
-        qasm_to_program('qubit[1] q; for uint i in [5:1] { sx q[0]; }')
+    with pytest.raises(Exception, match='step must be nonzero'):
+        qasm_to_program('qubit[1] q; for uint i in [0:0:5] { sx q[0]; }')
 
 
 def test_while_loop_guard_and_body():
@@ -249,3 +249,67 @@ def test_whole_register_delay_and_barrier():
     d = next(i for i in prog if i['name'] == 'delay')
     assert b['qubit'] == ['Q0', 'Q1']
     assert d['qubit'] == ['Q0', 'Q1']
+
+
+def test_nested_loop_var_shadowing():
+    """QASM3 loop variables are loop-scoped: nested loops sharing a name
+    must iterate independently (review regression)."""
+    import numpy as np
+    from distributed_processor_tpu.simulator import Simulator
+    prog = qasm_to_program('''
+        qubit[1] q;
+        for uint i in [0:1] { for uint i in [0:1] { sx q[0]; } }
+    ''')
+    sim = Simulator(n_qubits=1)
+    out = sim.run(sim.compile(prog), shots=1, max_meas=1)
+    assert int(np.asarray(out['n_pulses'])[0]) == 4    # 2 outer x 2 inner
+    # shadowing must not clobber an outer user variable
+    prog2 = qasm_to_program('''
+        qubit[1] q;
+        int[32] n = 7;
+        for uint n in [0:2] { sx q[0]; }
+        if (n == 7) { sx q[0]; }
+    ''')
+    out2 = sim.run(sim.compile(prog2), shots=1, max_meas=1)
+    assert int(np.asarray(out2['n_pulses'])[0]) == 3 + 1
+
+
+def test_zero_trip_range_is_noop():
+    prog = qasm_to_program('qubit[1] q; for uint i in [5:1] { sx q[0]; } sx q[0];')
+    names = [i['name'] for i in prog]
+    assert 'loop' not in names and names[-1] == 'X90'
+
+
+def test_parser_rejects_bad_loop_syntax():
+    import pytest
+    with pytest.raises(QASMSyntaxError):
+        parse_qasm('qubit[1] q; for uint 5 in [0:1] { sx q[0]; }')
+    with pytest.raises(QASMSyntaxError, match='unsupported while'):
+        parse_qasm('qubit[1] q; while (1 != 2) { sx q[0]; }')
+
+
+def test_sequential_whiles_and_branchy_fors():
+    """Review regression: sibling bodies flattened in separate recursive
+    calls must not collide on generated jump labels."""
+    import numpy as np
+    from distributed_processor_tpu.simulator import Simulator
+    sim = Simulator(n_qubits=1)
+    prog = qasm_to_program('''
+        qubit[1] q;
+        int[32] n = 0;
+        while (n < 2) { sx q[0]; n = n + 1; }
+        int[32] m = 0;
+        while (m < 3) { sx q[0]; m = m + 1; }
+    ''')
+    out = sim.run(sim.compile(prog), shots=1, max_meas=1)
+    assert int(np.asarray(out['n_pulses'])[0]) == 2 + 3
+    # two for-loops each containing an if: the bodies' branch labels
+    # collided before the fix
+    prog2 = qasm_to_program('''
+        qubit[1] q;
+        int[32] a = 1;
+        for uint i in [0:1] { if (a == 1) { sx q[0]; } }
+        for uint j in [0:2] { if (a == 1) { sx q[0]; } }
+    ''')
+    out2 = sim.run(sim.compile(prog2), shots=1, max_meas=1)
+    assert int(np.asarray(out2['n_pulses'])[0]) == 2 + 3

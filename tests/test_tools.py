@@ -129,3 +129,32 @@ def test_assembler_programmatic_equals_from_list(channelcfg_path):
         np.testing.assert_array_equal(x, y)
     for x, y in zip(f1, f2):
         np.testing.assert_array_equal(x, y)
+
+
+def test_assembler_label_aliases_through_declares():
+    """Review regression: consecutive labels separated only by
+    declarations all bind to the next instruction address."""
+    from distributed_processor_tpu.assembler import SingleCoreAssembler
+    from distributed_processor_tpu.models.channels import make_channel_configs
+    from distributed_processor_tpu.elements import TPUElementConfig
+    from distributed_processor_tpu import isa
+
+    ccfg = make_channel_configs(1)
+    elems = [TPUElementConfig(samples_per_clk=16),
+             TPUElementConfig(samples_per_clk=16),
+             TPUElementConfig(samples_per_clk=4)]
+    asm = SingleCoreAssembler(elems)
+    asm.from_list([
+        {'op': 'jump_label', 'dest_label': 'L1'},
+        {'op': 'declare_reg', 'name': 'r0'},
+        {'op': 'jump_label', 'dest_label': 'L2'},
+        {'op': 'reg_alu', 'in0': 1, 'alu_op': 'id0', 'in1_reg': 'r0',
+         'out_reg': 'r0'},
+        {'op': 'jump_i', 'jump_label': 'L1'},
+        {'op': 'jump_i', 'jump_label': 'L2'},
+        {'op': 'done_stb'},
+    ])
+    cmd_buf, _, _ = asm.get_compiled_program()
+    dis = isa.disassemble(cmd_buf)
+    assert dis[1]['op'] == 'jump_i' and dis[1]['jump_addr'] == 0
+    assert dis[2]['op'] == 'jump_i' and dis[2]['jump_addr'] == 0
