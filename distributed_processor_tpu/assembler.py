@@ -248,12 +248,20 @@ class SingleCoreAssembler:
         reg_params = [k for k, v in (('freq', freq), ('amp', amp), ('phase', phase))
                       if isinstance(v, str)]
         params = {'freq': freq, 'amp': amp, 'phase': phase}
+        first = True
         for extra in reg_params[:-1]:
-            self._program.append({'op': 'pulse', extra: params.pop(extra),
-                                  'elem': elem_ind})
+            write = {'op': 'pulse', extra: params.pop(extra),
+                     'elem': elem_ind}
+            if label is not None and first:
+                # the label must address the whole split group: a jump
+                # landing here (e.g. a loop back-edge) must re-execute
+                # the parameter writes, not just the final trigger
+                write['label'] = label
+                first = False
+            self._program.append(write)
         cmd = {'op': 'pulse', **params, 'start_time': start_time,
                'env': envkey, 'elem': elem_ind}
-        if label is not None:
+        if label is not None and first:
             cmd['label'] = label
         if tag is not None:
             cmd['tag'] = tag

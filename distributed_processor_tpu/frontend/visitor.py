@@ -41,8 +41,10 @@ class QASMTranslator:
         self.int_vars: set[str] = set()
         self.bit_sources: dict[tuple, str] = {}  # (reg, idx) -> qubit name
         # QASM3 loop variables are loop-scoped: shadowing names map to
-        # unique internal vars for the body's duration
+        # unique internal vars for the body's duration; sequential
+        # sibling loops reuse one minted var (one hardware register)
         self._var_alias: dict[str, str] = {}
+        self._loop_minted: dict[str, str] = {}
         self._tmp = 0
 
     # -- public ----------------------------------------------------------
@@ -243,11 +245,29 @@ class QASMTranslator:
             raise QASMTranslationError('range step must be nonzero')
         if stop < start if step > 0 else stop > start:
             return []                        # statically empty: zero trips
-        var = s.var
-        if var in self.int_vars:             # shadow or sequential reuse
+        if s.var in self._var_alias:
+            # active shadowing (an enclosing loop is using the name):
+            # mint a distinct internal var
             self._tmp += 1
             var = f'{s.var}__loop{self._tmp}'
-        self.int_vars.add(var)
+        elif s.var in self._loop_minted:
+            # sequential sibling loop: reuse the minted var (one
+            # hardware register — fresh vars per loop would exhaust the
+            # 16-register file); set_var re-seeds it
+            var = self._loop_minted[s.var]
+        elif s.var in self.int_vars:
+            # loop var shadows a USER variable: never clobber it
+            self._tmp += 1
+            var = f'{s.var}__loop{self._tmp}'
+            self._loop_minted[s.var] = var
+        else:
+            var = s.var
+            self._loop_minted[s.var] = var
+        declare = []
+        if var not in self.int_vars:
+            self.int_vars.add(var)
+            declare = [{'name': 'declare', 'var': var, 'dtype': 'int',
+                        'scope': self.all_qubits}]
         outer = self._var_alias.get(s.var)
         self._var_alias[s.var] = var
         try:
@@ -259,9 +279,7 @@ class QASMTranslator:
                 self._var_alias[s.var] = outer
         body.append({'name': 'alu', 'op': 'add', 'lhs': step,
                      'rhs': var, 'out': var})
-        return [
-            {'name': 'declare', 'var': var, 'dtype': 'int',
-             'scope': self.all_qubits},
+        return declare + [
             {'name': 'set_var', 'var': var, 'value': start},
             {'name': 'loop', 'cond_lhs': stop,
              'alu_cond': 'ge' if step > 0 else 'le',

@@ -1,5 +1,6 @@
 from .mesh import make_mesh, shot_sharding
-from .sweep import sharded_simulate, sweep_stats, sharded_demod
+from .sweep import (sharded_simulate, sweep_stats, sharded_demod,
+                    sharded_physics_stats)
 from .param_sweep import (swept_pulse_machine_program, grid_init_regs,
                           sweep_cfg, AMP_REG, FREQ_REG)
 from .multihost import (initialize_multihost, make_global_mesh,

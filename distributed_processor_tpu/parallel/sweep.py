@@ -106,6 +106,50 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
                 mean_qclk=out['qclk_sum'] / n_shots)
 
 
+def sharded_physics_stats(mp, model, key, shots: int, mesh,
+                          cfg=None, **kw):
+    """Physics-closed execution sharded over the mesh dp axis: every
+    shard runs its own epoch loop (thermal sampling -> interpretation ->
+    window synthesis -> matched-filter demod -> branch resolution, see
+    sim/physics.py) on its local shots, statistics psum over ICI.
+
+    The epoch while_loop's completion test is shard-local, so shards
+    finish independently — no cross-shard synchronisation beyond the
+    final reduction.  Each shard derives its noise key by folding the
+    dp axis index into ``key``.
+
+    Returns mean_pulses [n_cores], err_rate, meas1_rate [n_cores]
+    (fraction of first-slot measurement bits reading 1).
+    """
+    from ..sim.physics import run_physics_batch
+    from dataclasses import replace
+    from ..sim.interpreter import InterpreterConfig
+    cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    n_dp = mesh.shape['dp']
+    if shots % n_dp:
+        raise ValueError(f'{shots} shots not divisible by dp={n_dp}')
+    local_shots = shots // n_dp
+    if isinstance(key, int):
+        key = jax.random.PRNGKey(key)
+
+    def local():
+        k_local = jax.random.fold_in(key, jax.lax.axis_index('dp'))
+        out = run_physics_batch(mp, model, k_local, local_shots, cfg=cfg)
+        stats = dict(
+            pulse_sum=jnp.sum(out['n_pulses'], axis=0),
+            err_shots=jnp.sum(jnp.any(out['err'] != 0, axis=1)),
+            meas1_sum=jnp.sum(out['meas_bits'][:, :, 0], axis=0),
+        )
+        return jax.tree.map(lambda x: jax.lax.psum(x, 'dp'), stats)
+
+    fn = shard_map(local, mesh=mesh, in_specs=(), out_specs=P(),
+                   check_vma=False)
+    out = jax.jit(fn)()
+    return dict(mean_pulses=out['pulse_sum'] / shots,
+                err_rate=out['err_shots'] / shots,
+                meas1_rate=out['meas1_sum'] / shots)
+
+
 def sharded_demod(adc, weights, mesh):
     """Demod with shots over 'dp' and the sample contraction over 'mp':
     each device holds a ``[S/dp, N/mp]`` ADC block and a ``[N/mp, 2M]``

@@ -313,3 +313,15 @@ def test_sequential_whiles_and_branchy_fors():
     ''')
     out2 = sim.run(sim.compile(prog2), shots=1, max_meas=1)
     assert int(np.asarray(out2['n_pulses'])[0]) == 2 + 3
+
+
+def test_many_sequential_loops_share_one_register():
+    """Review regression: 20 sequential loops reusing one name must not
+    exhaust the 16-register file."""
+    import numpy as np
+    from distributed_processor_tpu.simulator import Simulator
+    body = 'for uint i in [0:1] { sx q[0]; }\n' * 20
+    prog = qasm_to_program('qubit[1] q;\n' + body)
+    sim = Simulator(n_qubits=1)
+    out = sim.run(sim.compile(prog), shots=1, max_meas=1)
+    assert int(np.asarray(out['n_pulses'])[0]) == 40

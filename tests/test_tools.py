@@ -135,11 +135,8 @@ def test_assembler_label_aliases_through_declares():
     """Review regression: consecutive labels separated only by
     declarations all bind to the next instruction address."""
     from distributed_processor_tpu.assembler import SingleCoreAssembler
-    from distributed_processor_tpu.models.channels import make_channel_configs
     from distributed_processor_tpu.elements import TPUElementConfig
-    from distributed_processor_tpu import isa
 
-    ccfg = make_channel_configs(1)
     elems = [TPUElementConfig(samples_per_clk=16),
              TPUElementConfig(samples_per_clk=16),
              TPUElementConfig(samples_per_clk=4)]
@@ -158,3 +155,22 @@ def test_assembler_label_aliases_through_declares():
     dis = isa.disassemble(cmd_buf)
     assert dis[1]['op'] == 'jump_i' and dis[1]['jump_addr'] == 0
     assert dis[2]['op'] == 'jump_i' and dis[2]['jump_addr'] == 0
+
+
+def test_pulse_split_label_binds_first_instruction():
+    """Review regression: a label on a multi-register-parameter pulse
+    must address the first instruction of the split group, so loop
+    back-edges re-execute the parameter writes."""
+    from distributed_processor_tpu.assembler import SingleCoreAssembler
+    from distributed_processor_tpu.elements import TPUElementConfig
+
+    elems = [TPUElementConfig(samples_per_clk=16)]
+    asm = SingleCoreAssembler(elems)
+    asm.declare_reg('rf', dtype='int')
+    asm.declare_reg('ra', dtype=('amp', 0))
+    asm.add_pulse(freq='rf', phase=0.0, amp='ra', start_time=10,
+                  env=np.ones(32, complex) * 0.5, elem_ind=0,
+                  label='L')
+    asm.add_done_stb()
+    assert len(asm._program) == 3            # write-only + main + done
+    assert asm._get_cmd_labelmap()['L'] == 0
