@@ -44,7 +44,7 @@ class QASMTranslator:
         # unique internal vars for the body's duration; sequential
         # sibling loops reuse one minted var (one hardware register)
         self._var_alias: dict[str, str] = {}
-        self._loop_minted: dict[str, str] = {}
+        self._loop_minted: dict[tuple, str] = {}
         self._tmp = 0
 
     # -- public ----------------------------------------------------------
@@ -245,24 +245,22 @@ class QASMTranslator:
             raise QASMTranslationError('range step must be nonzero')
         if stop < start if step > 0 else stop > start:
             return []                        # statically empty: zero trips
-        if s.var in self._var_alias:
-            # active shadowing (an enclosing loop is using the name):
-            # mint a distinct internal var
+        # minted vars are keyed by (enclosing alias context, name):
+        # sequential siblings — at any nesting depth — share one
+        # register (fresh vars per loop would exhaust the 16-register
+        # file; set_var re-seeds it), while genuine shadowing (an
+        # enclosing loop or a user variable owns the name) mints a
+        # distinct internal var
+        ctx = (self._var_alias.get(s.var), s.var)
+        if ctx in self._loop_minted:
+            var = self._loop_minted[ctx]
+        elif ctx[0] is not None or s.var in self.int_vars:
             self._tmp += 1
             var = f'{s.var}__loop{self._tmp}'
-        elif s.var in self._loop_minted:
-            # sequential sibling loop: reuse the minted var (one
-            # hardware register — fresh vars per loop would exhaust the
-            # 16-register file); set_var re-seeds it
-            var = self._loop_minted[s.var]
-        elif s.var in self.int_vars:
-            # loop var shadows a USER variable: never clobber it
-            self._tmp += 1
-            var = f'{s.var}__loop{self._tmp}'
-            self._loop_minted[s.var] = var
+            self._loop_minted[ctx] = var
         else:
             var = s.var
-            self._loop_minted[s.var] = var
+            self._loop_minted[ctx] = var
         declare = []
         if var not in self.int_vars:
             self.int_vars.add(var)

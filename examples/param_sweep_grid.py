@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Register-parameterized 2D amplitude x frequency sweep, one compile.
+
+The reference re-runs or re-compiles per sweep point host-side; here
+the swept pulse reads its amplitude and frequency from processor
+registers, the full grid is the initial-register batch, and the whole
+sweep shards over the device mesh — one compile, one jit.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/param_sweep_grid.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# honor JAX_PLATFORMS even where site config pre-selects a backend
+if os.environ.get('JAX_PLATFORMS'):
+    import jax
+    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+import numpy as np
+
+from distributed_processor_tpu.parallel import (
+    swept_pulse_machine_program, grid_init_regs, sweep_cfg, make_mesh,
+    sharded_simulate)
+
+N_CORES = 2
+
+
+def main():
+    mp = swept_pulse_machine_program(N_CORES, n_pulses=2)
+    amps = [0x2000, 0x4000, 0x8000, 0xffff]
+    freqs = [0, 1]
+    regs = grid_init_regs(amps, freqs, N_CORES)      # [8 points, cores, 16]
+    cfg = sweep_cfg(mp, n_pulses_per_core=3)
+    bits = np.zeros((len(regs), N_CORES, cfg.max_meas), int)
+
+    import jax
+    mesh = make_mesh(n_dp=min(8, len(jax.devices())))
+    out = sharded_simulate(mp, bits, mesh, init_regs=regs, cfg=cfg)
+
+    amp_played = np.asarray(out['rec_amp'])[:, 0, 0]    # core 0, pulse 0
+    freq_played = np.asarray(out['rec_freq'])[:, 0, 0]
+    print(f'{"point":>6} {"amp reg":>8} {"amp word":>9} {"freq addr":>9}')
+    for p in range(len(regs)):
+        print(f'{p:6d} {regs[p, 0, 0]:#8x} {amp_played[p]:#9x} '
+              f'{freq_played[p]:9d}')
+    assert np.array_equal(amp_played, regs[:, 0, 0])
+    assert np.array_equal(freq_played, regs[:, 0, 1])
+    print('grid played back exactly: one compile, '
+          f'{len(regs)} sweep points over {mesh.shape} mesh')
+
+
+if __name__ == '__main__':
+    main()

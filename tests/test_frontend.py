@@ -325,3 +325,16 @@ def test_many_sequential_loops_share_one_register():
     sim = Simulator(n_qubits=1)
     out = sim.run(sim.compile(prog), shots=1, max_meas=1)
     assert int(np.asarray(out['n_pulses'])[0]) == 40
+
+
+def test_nested_sibling_loops_share_registers():
+    """Review regression: same-name sibling loops nested under a
+    shadowing loop reuse one minted register."""
+    import numpy as np
+    from distributed_processor_tpu.simulator import Simulator
+    inner = 'for uint i in [0:1] { sx q[0]; }\n' * 18
+    prog = qasm_to_program('qubit[1] q;\nfor uint i in [0:0] {\n'
+                           + inner + '}')
+    sim = Simulator(n_qubits=1)
+    out = sim.run(sim.compile(prog), shots=1, max_meas=1)
+    assert int(np.asarray(out['n_pulses'])[0]) == 36   # 18 inner x 2
