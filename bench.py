@@ -123,7 +123,35 @@ def large_program_scaling(n_qubits: int, small_depth: int,
     return results
 
 
+def _preflight(timeout_s: float = 180.0):
+    """Fail fast with a diagnostic JSON if the accelerator backend hangs
+    (a dead axon tunnel blocks forever inside backend init, which would
+    otherwise stall the whole bench run silently)."""
+    import threading
+    ok = threading.Event()
+
+    def probe():
+        x = jnp.ones((8,))
+        float(x.sum())
+        ok.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not ok.is_set():
+        print(json.dumps({
+            'metric': 'shots/sec/chip, 8q active-reset+RB, physics-closed '
+                      '(synth+demod+discriminate in-loop)',
+            'value': 0, 'unit': 'shots/s', 'vs_baseline': 0,
+            'detail': {'error': f'accelerator backend unresponsive after '
+                                f'{timeout_s:.0f}s (device init/compute '
+                                f'hang — tunnel down?)'},
+        }), flush=True)
+        os._exit(2)
+
+
 def main():
+    _preflight()
     n_qubits = int(os.environ.get('BENCH_QUBITS', 8))
     depth = int(os.environ.get('BENCH_DEPTH', 12))
     total_shots = int(os.environ.get('BENCH_SHOTS', 1048576))
