@@ -128,26 +128,33 @@ def _preflight(timeout_s: float = 180.0):
     (a dead axon tunnel blocks forever inside backend init, which would
     otherwise stall the whole bench run silently)."""
     import threading
-    ok = threading.Event()
+    done = threading.Event()
+    failure = []
 
     def probe():
-        x = jnp.ones((8,))
-        float(x.sum())
-        ok.set()
+        try:
+            x = jnp.ones((8,))
+            float(x.sum())
+        except Exception as e:          # fast failure: report, don't wait
+            failure.append(f'{type(e).__name__}: {e}'[:300])
+        finally:
+            done.set()
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
-    t.join(timeout_s)
-    if not ok.is_set():
-        print(json.dumps({
-            'metric': 'shots/sec/chip, 8q active-reset+RB, physics-closed '
-                      '(synth+demod+discriminate in-loop)',
-            'value': 0, 'unit': 'shots/s', 'vs_baseline': 0,
-            'detail': {'error': f'accelerator backend unresponsive after '
-                                f'{timeout_s:.0f}s (device init/compute '
-                                f'hang — tunnel down?)'},
-        }), flush=True)
-        os._exit(2)
+    done.wait(timeout_s)
+    if done.is_set() and not failure:
+        return
+    error = failure[0] if failure else (
+        f'accelerator backend unresponsive after {timeout_s:.0f}s '
+        f'(device init/compute hang — tunnel down?)')
+    print(json.dumps({
+        'metric': 'shots/sec/chip, 8q active-reset+RB, physics-closed '
+                  '(synth+demod+discriminate in-loop)',
+        'value': 0, 'unit': 'shots/s', 'vs_baseline': 0,
+        'detail': {'error': error},
+    }), flush=True)
+    os._exit(2)
 
 
 def main():

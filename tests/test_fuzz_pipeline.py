@@ -102,3 +102,24 @@ def test_random_program_engine_vs_oracle(seed):
     for c in range(mp.n_cores):
         assert (int(np.asarray(out['err'])[c]) != 0) \
             == (len(orc['err'][c]) != 0), (seed, c, orc['err'][c])
+
+
+@pytest.mark.parametrize('seed', range(3))
+def test_random_program_sharded_matches_local(seed):
+    """Sharding over the CPU mesh must be bit-identical to local
+    execution for arbitrary compiled programs."""
+    from distributed_processor_tpu.parallel import make_mesh, sharded_simulate
+    from distributed_processor_tpu.sim import simulate_batch
+
+    rng = np.random.default_rng(4000 + seed)
+    sim = Simulator(n_qubits=2)
+    mp = sim.compile(_random_program(rng, ['Q0', 'Q1']))
+    cfg = sim.interpreter_config(mp, max_meas=6)
+    bits = rng.integers(0, 2, size=(16, mp.n_cores, 6))
+    mesh = make_mesh(n_dp=8)
+    sharded = sharded_simulate(mp, bits, mesh, cfg=cfg)
+    local = simulate_batch(mp, bits, cfg=cfg)
+    for k in ('n_pulses', 'regs', 'qclk', 'err', 'rec_gtime', 'rec_amp'):
+        np.testing.assert_array_equal(
+            np.asarray(sharded[k]), np.asarray(local[k]),
+            err_msg=f'seed {seed} {k}')
