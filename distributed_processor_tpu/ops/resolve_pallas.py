@@ -1,0 +1,244 @@
+"""Fused Pallas TPU kernel for readout-window resolution.
+
+One kernel pass implements the whole per-sample readout chain of
+:mod:`..sim.physics` — envelope playback + phase-coherent carrier
+synthesis, state-dependent channel response, per-sample ADC noise,
+matched-filter demodulation — with every per-sample intermediate living
+in VMEM.  The XLA formulation (``physics._resolve``) materialises
+``[B, C, chunk]`` float32 arrays in HBM for the synthesized window, the
+received signal, and every fusion boundary between them; at bench batch
+sizes that is gigabytes of bandwidth per chunk.  Here HBM sees the
+per-window scalars, the streamed noise chunk, and three ``[C, B]``
+accumulators.
+
+Numeric contract (same as ``physics._synth_window_chunk``, pinned by
+tests): envelope sample at DAC index ``s`` is ``env[addr + s//interp]``
+with hold-last-sample overrun semantics; carrier is the factored
+phase-coherent form ``e^{i A} * basis(f, s)`` with the per-window scalar
+``A`` supplied by the caller; the envelope fetch rides the MXU as
+``one_hot(addr) @ T`` where ``T[r, j] = env[r + j//interp]`` is the
+DAC-resolution sliding-window (Toeplitz) table (per-lane gathers do not
+vectorise on TPU — the design rule everywhere in this repo).
+
+ADC noise is drawn OUTSIDE the kernel (``jax.random``, threefry) one
+chunk at a time inside the chunk ``lax.scan`` and streamed in: the
+stream is identical on every backend — TPU and the CPU interpret mode
+produce the same bits — and peak memory stays ``O(B*C*ck)``.  (The
+in-kernel ``pltpu.prng_random_bits`` alternative is not portable: the
+TPU interpret mode stubs it out to zeros, which would silently disable
+noise in off-TPU tests.)  The draw layout differs from the XLA
+per-sample path's, so the two paths agree bit-exactly at sigma=0 and
+statistically at finite sigma (tests/test_physics.py pins both).
+
+The reference implements this chain in dedicated FPGA hardware (rdlo
+pulse -> external demod -> meas bits, word formats
+python/distproc/asmparse.py:46-86); this kernel is its TPU equivalent.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except ImportError:      # pragma: no cover - pallas ships with jax
+    _HAS_PALLAS = False
+
+
+def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
+            fidx_ref, addr_ref, nsamp_ref, s0_ref,
+            t_ref, bas_ref, nz_ref,
+            acc_i_in, acc_q_in, energy_in,
+            acc_i_ref, acc_q_ref, energy_ref,
+            *, tb: int, ck: int, n_f: int):
+    # ---- envelope: one-hot(addr) @ Toeplitz on the MXU -----------------
+    r_rows = t_ref.shape[2]
+    addr = addr_ref[0, 0, :]                                  # [TB] int32
+    oh = (addr[:, None]
+          == jax.lax.broadcasted_iota(jnp.int32, (tb, r_rows), 1)
+          ).astype(jnp.float32)
+    # HIGHEST: bf16 operand rounding would quantize env samples past the
+    # synthesize_element parity tolerance (the one-hot side is exact)
+    e_i = jax.lax.dot_general(
+        oh, t_ref[0, 0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)                  # [TB, CK]
+    e_q = jax.lax.dot_general(
+        oh, t_ref[0, 1], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
+
+    # ---- carrier: basis row select (F is tiny), scalar rotation --------
+    f_idx = fidx_ref[0, 0, :]                                 # [TB]
+    bc = jnp.broadcast_to(bas_ref[0, 0, 0][None, :], (tb, ck))
+    bs = jnp.broadcast_to(bas_ref[0, 1, 0][None, :], (tb, ck))
+    for f in range(1, n_f):
+        sel = (f_idx == f)[:, None]
+        bc = jnp.where(sel, bas_ref[0, 0, f][None, :], bc)
+        bs = jnp.where(sel, bas_ref[0, 1, f][None, :], bs)
+    cosa = cosa_ref[0, 0, :][:, None]
+    sina = sina_ref[0, 0, :][:, None]
+    cth = cosa * bc - sina * bs
+    sth = sina * bc + cosa * bs
+
+    # ---- window assembly ----------------------------------------------
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tb, ck), 1)
+    s_abs = s0_ref[0] + lane
+    in_win = (s_abs < nsamp_ref[0, 0, :][:, None]).astype(jnp.float32)
+    amp = amp_ref[0, 0, :][:, None]
+    y_i = in_win * amp * (e_i * cth - e_q * sth)
+    y_q = in_win * amp * (e_i * sth + e_q * cth)
+
+    # ---- channel response + streamed ADC noise + matched filter -------
+    gs_i = gsi_ref[0, 0, :][:, None]
+    gs_q = gsq_ref[0, 0, :][:, None]
+    r_i = gs_i * y_i - gs_q * y_q + nz_ref[0, 0]
+    r_q = gs_i * y_q + gs_q * y_i + nz_ref[1, 0]
+    acc_i_ref[0, 0, :] = acc_i_in[0, 0, :] + jnp.sum(r_i * y_i + r_q * y_q,
+                                                     axis=1)
+    acc_q_ref[0, 0, :] = acc_q_in[0, 0, :] + jnp.sum(r_q * y_i - r_i * y_q,
+                                                     axis=1)
+    energy_ref[0, 0, :] = energy_in[0, 0, :] + jnp.sum(y_i * y_i + y_q * y_q,
+                                                       axis=1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=('tb', 'ck', 'w_pad', 'interpret'))
+def _resolve_call(amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
+                  key, sigma, t_dac, basis, tb, ck, w_pad, interpret):
+    C, _, B = amp.shape
+    n_chunks = w_pad // ck
+    R = t_dac.shape[2]
+    F = basis.shape[2]
+    if interpret:
+        # TPU interpret mode simulates VMEM/SMEM + grid pipelining on
+        # CPU (plain interpret=True has no lowering for SMEM scalars in
+        # some mosaic primitives); the kernel itself is backend-pure
+        interpret = pltpu.InterpretParams()
+    lane_spec = pl.BlockSpec((1, 1, tb), lambda c, t: (c, 0, t))
+    call = pl.pallas_call(
+        functools.partial(_kernel, tb=tb, ck=ck, n_f=F),
+        grid=(C, B // tb),
+        in_specs=[lane_spec] * 8 + [
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2, R, ck), lambda c, t: (c, 0, 0, 0)),
+            pl.BlockSpec((1, 2, F, ck), lambda c, t: (c, 0, 0, 0)),
+            pl.BlockSpec((2, 1, tb, ck), lambda c, t: (0, c, t, 0)),
+        ] + [lane_spec] * 3,
+        out_specs=[lane_spec] * 3,
+        out_shape=[jax.ShapeDtypeStruct((C, 1, B), jnp.float32)] * 3,
+        interpret=interpret,
+    )
+
+    def chunk_body(carry, k):
+        acc_i, acc_q, energy = carry
+        s0 = k * ck
+        t_k = jax.lax.dynamic_slice(t_dac, (0, 0, 0, s0), (C, 2, R, ck))
+        b_k = jax.lax.dynamic_slice(basis, (0, 0, 0, s0), (C, 2, F, ck))
+        nz = sigma * jax.random.normal(
+            jax.random.fold_in(key, k), (2, C, B, ck), jnp.float32)
+        acc_i, acc_q, energy = call(
+            amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
+            s0.reshape((1,)), t_k, b_k, nz, acc_i, acc_q, energy)
+        return (acc_i, acc_q, energy), None
+
+    zeros = jnp.zeros((C, 1, B), jnp.float32)
+    (acc_i, acc_q, energy), _ = jax.lax.scan(
+        chunk_body, (zeros, zeros, zeros),
+        jnp.arange(n_chunks, dtype=jnp.int32))
+    return acc_i, acc_q, energy
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def fused_chunk(chunk, W: int) -> int:
+    """Kernel chunk width for a requested ``resolve_chunk``: capped at W
+    and rounded up to the 128-lane tile (every interp ratio divides it)."""
+    return _round_up(min(chunk or W, W), 128)
+
+
+def build_fused_tables(env_pads, basis, W: int, interps, ck: int):
+    """Kernel constants for :func:`resolve_windows_fused` — build ONCE
+    per run, outside the epoch while_loop (XLA does not hoist the
+    gathers out of while bodies; rebuilding per epoch would re-pay the
+    full table materialisation every resolve).
+
+    Returns ``(t_dac, bas, w_pad)``: the DAC-resolution Toeplitz
+    envelope tables ``[C, 2, R, Wp]`` with
+    ``T[c, p, r, j] = env_p[c, r + j//interp]`` (hold-last-sample
+    overrun via the clamped env index), the stacked carrier basis
+    ``[C, 2, F, Wp]``, and the chunk-aligned window length.
+    """
+    env_i_pad, env_q_pad = env_pads
+    C, Lp = env_i_pad.shape
+    w_pad = _round_up(W, ck)
+    r_rows = _round_up(Lp, 128)
+    ts = []
+    for c in range(C):
+        interp = int(interps[c])
+        j_env = np.arange(w_pad) // interp
+        win = np.minimum(np.arange(r_rows)[:, None] + j_env[None, :], Lp - 1)
+        win_j = jnp.asarray(win)
+        ts.append(jnp.stack([env_i_pad[c][win_j], env_q_pad[c][win_j]], 0))
+    t_dac = jnp.stack(ts, 0)                        # [C, 2, R, Wp]
+
+    bas_cos, bas_sin = basis
+    pad_w = w_pad - bas_cos.shape[2]
+    if pad_w > 0:
+        bas_cos = jnp.pad(bas_cos, ((0, 0), (0, 0), (0, pad_w)))
+        bas_sin = jnp.pad(bas_sin, ((0, 0), (0, 0), (0, pad_w)))
+    bas = jnp.stack([bas_cos[:, :, :w_pad], bas_sin[:, :, :w_pad]], 1)
+    return t_dac, bas, w_pad
+
+
+def resolve_windows_fused(sc: dict, fused_tables, gs_i, gs_q,
+                          sigma, key, W: int, Lp: int,
+                          *, tb: int = 512, ck: int = 256,
+                          interpret: bool = False):
+    """Matched-filter accumulators for one compacted window per (B, C).
+
+    ``sc``: per-window scalars shaped ``[B, C, 1]`` (the compacted form
+    from ``physics._window_scalars``).  ``fused_tables``: the
+    :func:`build_fused_tables` output (built once per run).
+    ``gs_i``/``gs_q``: ``[B, C]`` state-dependent channel response.
+    ``key``: noise key for this resolve call (fold the epoch in before
+    calling).  ``Lp``: the padded envelope-plane length (the addr clip
+    domain).  Returns ``(acc_i, acc_q, energy)`` each ``[B, C]``.
+    """
+    if not _HAS_PALLAS:   # pragma: no cover - pallas ships with jax
+        raise RuntimeError(
+            'jax.experimental.pallas unavailable; use '
+            "resolve_mode='persample'")
+    t_dac, bas, w_pad = fused_tables
+    B, C = sc['amp'].shape[:2]
+
+    # lane arrays: [B, C, 1] -> [C, B], shot axis padded to the tile
+    b_pad = _round_up(B, tb)
+    def lanes(a, dtype):
+        a = jnp.transpose(a[..., 0], (1, 0)).astype(dtype)[:, None, :]
+        return jnp.pad(a, ((0, 0), (0, 0), (0, b_pad - B)))
+    amp = lanes(sc['amp'], jnp.float32)
+    cosa = lanes(sc['cosA'], jnp.float32)
+    sina = lanes(sc['sinA'], jnp.float32)
+    f_idx = lanes(sc['f_idx'], jnp.int32)
+    addr = lanes(jnp.clip(sc['addr'], 0, Lp - 1), jnp.int32)
+    nsamp = lanes(jnp.minimum(sc['n_samp'], W), jnp.int32)
+    gsi = jnp.pad(jnp.transpose(gs_i, (1, 0))[:, None, :],
+                  ((0, 0), (0, 0), (0, b_pad - B)))
+    gsq = jnp.pad(jnp.transpose(gs_q, (1, 0))[:, None, :],
+                  ((0, 0), (0, 0), (0, b_pad - B)))
+    sigma = jnp.asarray(sigma, jnp.float32)
+
+    acc_i, acc_q, energy = _resolve_call(
+        amp, cosa, sina, gsi, gsq, f_idx, addr, nsamp, key, sigma,
+        t_dac, bas, tb, ck, w_pad, interpret)
+    back = lambda a: jnp.transpose(a[:, 0, :B], (1, 0))[..., None]
+    return back(acc_i), back(acc_q), back(energy)

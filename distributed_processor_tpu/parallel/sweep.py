@@ -82,6 +82,9 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
     """
     from dataclasses import replace
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    # statistics only ever reduce n_pulses/err/qclk — don't carry the
+    # [B, C, 9*max_pulses] record state through the while_loop
+    cfg = replace(cfg, record_pulses=False)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)
     n_shots = meas_bits.shape[0]
@@ -125,6 +128,7 @@ def sharded_physics_stats(mp, model, key, shots: int, mesh,
     from dataclasses import replace
     from ..sim.interpreter import InterpreterConfig
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    cfg = replace(cfg, record_pulses=False)   # stats never read rec_*
     n_dp = mesh.shape['dp']
     if shots % n_dp:
         raise ValueError(f'{shots} shots not divisible by dp={n_dp}')

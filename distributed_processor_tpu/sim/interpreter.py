@@ -106,6 +106,12 @@ class InterpreterConfig:
     lut_mask: tuple = ()          # bool per core: LUT address inputs
     lut_table: tuple = ()         # [2^k] entries, bit c = output for core c
     trace: bool = False           # record per-step (pc, time) per core
+    # pulse-parameter records (the rec_* outputs waveform rendering
+    # consumes) are loop-carried state the while_loop forces XLA to
+    # keep alive — [B, C, 9*max_pulses] read+written EVERY step.  Turn
+    # off for statistics-only runs (sweeps, benchmarks): n_pulses,
+    # error bits, and measurement bookkeeping are all still tracked.
+    record_pulses: bool = True
     # physics-in-the-loop execution (sim/physics.py): measurement bits
     # start *invalid* and are resolved by the DSP chain between epochs;
     # fproc reads whose bit is pending stall the lane until resolve.
@@ -182,7 +188,8 @@ def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
         # field-major flat [B, C, F*P]: a trailing axis of F=9 would
         # lane-pad to 128 on TPU (14x HBM + write traffic per step);
         # F*P lands near a tile multiple.  Views reshape to [B,C,F,P].
-        rec=z(B, C, len(_REC_FIELDS) * P),
+        **({'rec': z(B, C, len(_REC_FIELDS) * P)}
+           if cfg.record_pulses else {}),
         n_resets=z(B, C), rst_time=z(B, C, R),
         n_meas=z(B, C),
         meas_avail=jnp.full((B, C, M), INT32_MAX, jnp.int32),
@@ -391,17 +398,19 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     fire = is_pt & adv
     rec_of = jnp.where(fire & (st['n_pulses'] >= cfg.max_pulses),
                        ERR_PULSE_OVERFLOW, 0)
-    rec_vals = jnp.stack(
-        [cmd_time, trig, pp[..., 0], pp[..., 1], pp[..., 2], pp[..., 3],
-         pp[..., 4], elem, dur], axis=-1)                        # [B, C, 9]
-    oh_pslot = _onehot(jnp.minimum(st['n_pulses'], cfg.max_pulses - 1),
-                       cfg.max_pulses)                           # [B, C, P]
-    pwrite = (oh_pslot == 1) & (fire & (st['n_pulses'] < cfg.max_pulses)
-                                )[..., None]
-    F, P = len(_REC_FIELDS), cfg.max_pulses
-    rec = jnp.where(pwrite[:, :, None, :],
-                    rec_vals[:, :, :, None],
-                    st['rec'].reshape(B, C, F, P)).reshape(B, C, F * P)
+    rec_update = {}
+    if cfg.record_pulses:
+        rec_vals = jnp.stack(
+            [cmd_time, trig, pp[..., 0], pp[..., 1], pp[..., 2], pp[..., 3],
+             pp[..., 4], elem, dur], axis=-1)                    # [B, C, 9]
+        oh_pslot = _onehot(jnp.minimum(st['n_pulses'], cfg.max_pulses - 1),
+                           cfg.max_pulses)                       # [B, C, P]
+        pwrite = (oh_pslot == 1) & (fire & (st['n_pulses'] < cfg.max_pulses)
+                                    )[..., None]
+        F, P = len(_REC_FIELDS), cfg.max_pulses
+        rec_update['rec'] = jnp.where(
+            pwrite[:, :, None, :], rec_vals[:, :, :, None],
+            st['rec'].reshape(B, C, F, P)).reshape(B, C, F * P)
     n_pulses = st['n_pulses'] + fire.astype(jnp.int32)
 
     is_meas_pulse = fire & (elem == cfg.meas_elem)
@@ -513,8 +522,9 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
 
     return dict(st, pc=pc_next, regs=regs, time=time_next, offset=offset_next,
                 done=st['done'] | is_done, err=err, pp=pp, n_pulses=n_pulses,
-                rec=rec, n_resets=n_resets, rst_time=rst_time,
-                n_meas=n_meas, meas_avail=meas_avail, **phys_updates, **tr)
+                n_resets=n_resets, rst_time=rst_time,
+                n_meas=n_meas, meas_avail=meas_avail,
+                **rec_update, **phys_updates, **tr)
 
 
 def _split_records(rec) -> dict:
@@ -567,7 +577,8 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
 
 def _finalize(st: dict, cfg: InterpreterConfig) -> dict:
     steps = st.pop('_steps')
-    st.update(_split_records(st.pop('rec')))
+    if cfg.record_pulses:
+        st.update(_split_records(st.pop('rec')))
     st['qclk'] = st['time'] - st['offset']
     st['steps'] = steps
     st['incomplete'] = ~jnp.all(st['done'])

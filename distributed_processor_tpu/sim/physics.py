@@ -37,8 +37,17 @@ simulation; swap :class:`ReadoutPhysics` response parameters for a
 better device model as needed.
 
 Noise is deterministic per (shot, core, measurement-slot) given the run
-key — the same slot resolves to the same bit regardless of which epoch
-resolves it.
+key: every slot is resolved exactly once (``valid`` masks resolved slots
+out of later epochs), in the epoch its lane first presents it.  The
+per-sample modes fold the epoch index into the resolve key; analytic
+keys its single draw per slot position, deterministic as-is.
+
+The per-sample resolver compacts the measurement axis: each epoch
+resolves the *first* pending slot of every (shot, core) lane, so the
+per-sample volume is ``[B, C, W]`` per epoch and the total synthesis
+work is proportional to the number of windows actually fired — not
+``max_meas`` times that, which is what an all-slots resolve pass costs
+for the common measure-then-branch program shape.
 """
 
 from __future__ import annotations
@@ -90,11 +99,16 @@ class ReadoutPhysics:
     resolve_chunk: int = 512
     # 'persample': synthesize + demodulate every window sample (the
     # general path — required once the channel model grows structure a
-    # matched filter can't collapse).  'analytic': the EXACT
-    # distributional shortcut for this white-noise matched-filter
-    # model — the filter is linear, so acc = g_s*E + sigma*sqrt(E)*xi
-    # with window energy E from an envelope prefix sum; same bit
-    # distribution at O(B*C*M) instead of O(B*C*M*W)
+    # matched filter can't collapse).  'fused': the same per-sample
+    # chain as one Pallas kernel (ops/resolve_pallas.py) — synthesis,
+    # in-kernel ADC noise, matched filter all in VMEM; same math,
+    # different noise generator (bit-identical to 'persample' at
+    # sigma=0, statistically equivalent at finite sigma), much faster
+    # on TPU.  'analytic': the EXACT distributional shortcut for this
+    # white-noise matched-filter model — the filter is linear, so
+    # acc = g_s*E + sigma*sqrt(E)*xi with window energy E from an
+    # envelope prefix sum; same bit distribution at O(B*C*M) instead
+    # of O(B*C*M*W)
     resolve_mode: str = 'persample'
 
 
@@ -216,7 +230,8 @@ def _carrier_basis(freq_stack, W: int):
 
 def _synth_window_chunk(sc: dict, toeplitz, basis, s0, width: int, interps):
     """Synthesize samples ``[s0, s0+width)`` of every recorded readout
-    window: ``[B,C,M,width]`` I/Q.
+    window: ``[B,C,M,width]`` I/Q (``M`` is whatever window axis ``sc``
+    carries — all slots, or the single compacted pending slot).
 
     Same numeric contract as :func:`..ops.waveform.synthesize_element`
     (env addressing ``(env&0xfff)*4 + s//interp``, phase-coherent
@@ -238,8 +253,7 @@ def _synth_window_chunk(sc: dict, toeplitz, basis, s0, width: int, interps):
     # the synthesized signal and the matched-filter reference, so float32
     # carrier-phase rounding cancels in the demod product.  Factored as
     # e^{i theta} = e^{iA} * basis(f, s): per-window scalar rotation of
-    # the precomputed per-frequency basis rows (fetched with the same
-    # one-hot MXU pattern as the envelope)
+    # the precomputed per-frequency basis rows
     basis_cos, basis_sin = basis                      # [C, F, W] each
     F = basis_cos.shape[1]
     bslice = jax.lax.dynamic_slice(
@@ -268,13 +282,19 @@ def _synth_window_chunk(sc: dict, toeplitz, basis, s0, width: int, interps):
             a.reshape(B, M, seg), interp, axis=-1)[..., :width]
         e_i, e_q = rep(segs[0]), rep(segs[1])         # [B, M, width]
 
-        oh_f = jax.nn.one_hot(sc['f_idx'][:, c, :].reshape(-1), F,
-                              dtype=jnp.float32)      # [B*M, F]
-        rows = jnp.einsum('bf,pfs->pbs', oh_f, bslice[:, c],
-                          preferred_element_type=jnp.float32,
-                          precision=jax.lax.Precision.HIGHEST)
-        bc = rows[0].reshape(B, M, width)
-        bs = rows[1].reshape(B, M, width)
+        # carrier row select: F is small (table frequencies per core), so
+        # a select chain stays elementwise and fuses into the final y
+        # kernel — the one-hot einsum here materialized a [B*M, width]
+        # f32 row matrix per core per chunk (GBs of pure HBM traffic at
+        # bench batch).  Numerically identical: a 0/1-weighted f32 sum
+        # of rows equals the selected row exactly.
+        f_idx = sc['f_idx'][:, c, :]                  # [B, M]
+        bc = jnp.broadcast_to(bslice[0, c, 0][None, None, :], (B, M, width))
+        bs = jnp.broadcast_to(bslice[1, c, 0][None, None, :], (B, M, width))
+        for f in range(1, F):
+            m = (f_idx == f)[..., None]
+            bc = jnp.where(m, bslice[0, c, f][None, None, :], bc)
+            bs = jnp.where(m, bslice[1, c, f][None, None, :], bs)
         cosA = sc['cosA'][:, c, :, None]
         sinA = sc['sinA'][:, c, :, None]
         cth = cosA * bc - sinA * bs
@@ -295,9 +315,46 @@ def _synth_windows(st: dict, tables, W: int):
     return _synth_window_chunk(sc, toeplitz, basis, jnp.int32(0), W, interps)
 
 
+def _compact_pending_slot(st: dict, valid, tables):
+    """First fired-but-unresolved measurement slot per (shot, core).
+
+    Returns ``(sc, state_sel, oh_slot, has_pending)``: the compacted
+    window-synthesis scalars (each ``[B, C, 1]`` — the singleton window
+    axis lets :func:`_synth_window_chunk` run unchanged), the chosen
+    slot's device-state bit, the slot one-hot over the measurement axis,
+    and the lanes that actually have a pending slot.  Slots resolve
+    exactly once: ``valid`` masks resolved slots out of the selection.
+    """
+    B, C, M = valid.shape
+    fired = jnp.arange(M)[None, None, :] < st['n_meas'][..., None]
+    pending = fired & ~valid                                     # [B,C,M]
+    has_pending = jnp.any(pending, axis=-1)                      # [B,C]
+    slot = jnp.argmax(pending, axis=-1).astype(jnp.int32)        # [B,C]
+    oh_slot = (slot[..., None]
+               == jnp.arange(M, dtype=jnp.int32)[None, None, :])  # [B,C,M]
+    take = lambda a: jnp.sum(jnp.where(oh_slot, a, 0), axis=-1)[..., None]
+    st_sel = {k: take(st[k]) for k in
+              ('meas_amp', 'meas_phase', 'meas_freq', 'meas_env',
+               'meas_gtime')}
+    st_sel['n_meas'] = jnp.ones((B, C), jnp.int32)
+    sc = _window_scalars(st_sel, tables)
+    return sc, take(st['meas_state']), oh_slot, has_pending
+
+
+def _scatter_slot_bit(bits, valid, new_bit, oh_slot, has_pending):
+    """Write the resolved bit (``[B, C]``) back into its slot and mark
+    it valid — only on lanes that had a pending slot."""
+    resolved = oh_slot & has_pending[..., None]                  # [B,C,M]
+    bits = jnp.where(resolved, new_bit[..., None], bits)
+    return bits, valid | resolved
+
+
 def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
-             W: int, chunk: int = None, interps=None):
-    """Demodulate every fired-but-unresolved readout window into a bit.
+             W: int, chunk: int = None, interps=None, prebuilt=None):
+    """Demodulate pending readout windows into bits — one slot per
+    (shot, core) per call.  ``prebuilt``: optional ``(toeplitz, basis)``
+    built once by the caller — pass it when calling from inside a loop
+    (XLA does not hoist the table gathers out of while bodies).
 
     The measurement contract being implemented numerically is the
     reference's readout word formats and hold timing
@@ -305,11 +362,20 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
     the bit produced here is what hardware presents on the fabric's
     ``meas`` inputs.
 
+    Each call resolves the FIRST fired-but-unresolved slot of every
+    (shot, core) lane: slots resolve exactly once (``valid`` masks them
+    out afterwards), so compacting the measurement axis away makes the
+    per-sample work O(B*C*W) per epoch and the TOTAL per-sample work
+    proportional to the number of windows actually fired — the
+    all-slots form re-synthesized every window every epoch, ``M`` times
+    more work for the common block-after-measure program shape.  ``key``
+    must differ per call (the caller folds in the epoch index): a slot
+    is resolved in exactly one epoch, so per-epoch keying keeps bits
+    deterministic per (shot, core, slot) for a given run key.
+
     The window streams through a ``lax.scan`` in chunks of ``chunk``
     samples (synthesis + channel response + ADC noise + matched-filter
-    accumulation per chunk), so peak memory is independent of W.  Noise
-    is keyed by (run key, chunk index), deterministic per measurement
-    slot regardless of which epoch resolves it.
+    accumulation per chunk), so peak memory is independent of W.
     """
     g0, g1, sigma = response                  # [C,2], [C,2], scalar
     B, C, M = bits.shape
@@ -317,50 +383,72 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
         interps = tuple(int(x) for x in np.asarray(tables[3]))
     chunk = _aligned_chunk(chunk, W, interps)
     n_chunks = -(-W // chunk)
-    fired = jnp.arange(M)[None, None, :] < st['n_meas'][..., None]
-    pending = fired & ~valid
-    sc = _window_scalars(st, tables)
+    sc, state_sel, oh_slot, has_pending = \
+        _compact_pending_slot(st, valid, tables)
     # honor the W truncation exactly (the last chunk may run past W, and
     # a model.window_samples shorter than the natural envelope window
     # must clip the integration the way the unchunked path's shape did)
     sc = dict(sc, n_samp=jnp.minimum(sc['n_samp'], W))
 
-    # state-dependent channel response
-    gs = jnp.where(st['meas_state'][..., None] == 1,
-                   g1[None, :, None, :], g0[None, :, None, :])   # [B,C,M,2]
-    gs_i, gs_q = gs[..., 0:1], gs[..., 1:2]
+    # state-dependent channel response for the chosen slot
+    gs = jnp.where(state_sel[..., None] == 1,
+                   g1[None, :, None, :], g0[None, :, None, :])   # [B,C,1,2]
+    gs_i, gs_q = gs[..., 0], gs[..., 1]
 
-    toeplitz = _toeplitz_tables(env_pads, chunk, interps)
-    # basis covers the padded span so the last chunk's slice stays in
-    # range (samples past W are masked by in_win anyway)
-    basis = _carrier_basis(tables[1], n_chunks * chunk)
+    if prebuilt is not None:
+        toeplitz, basis = prebuilt
+    else:
+        toeplitz = _toeplitz_tables(env_pads, chunk, interps)
+        # basis covers the padded span so the last chunk's slice stays
+        # in range (samples past W are masked by in_win anyway)
+        basis = _carrier_basis(tables[1], n_chunks * chunk)
 
     def chunk_body(carry, k):
         acc_i, acc_q, energy = carry
         y_i, y_q = _synth_window_chunk(sc, toeplitz, basis, k * chunk,
-                                       chunk, interps)
-        # I/Q noise as two [..., chunk] draws: a trailing axis of 2 would
-        # tile-pad 64x on TPU ((8,128) lanes) and blow HBM
-        shape = (B, C, M, chunk)
-        nz_i = sigma * jax.random.normal(
-            jax.random.fold_in(key, 2 * k), shape, jnp.float32)
-        nz_q = sigma * jax.random.normal(
-            jax.random.fold_in(key, 2 * k + 1), shape, jnp.float32)
-        r_i = gs_i * y_i - gs_q * y_q + nz_i
-        r_q = gs_i * y_q + gs_q * y_i + nz_q
+                                       chunk, interps)           # [B,C,1,w]
+        # one fused I+Q noise draw (leading axis of 2 — a TRAILING axis
+        # of 2 would tile-pad 64x on TPU (8,128) lanes and blow HBM)
+        nz = sigma * jax.random.normal(
+            jax.random.fold_in(key, k), (2, B, C, 1, chunk), jnp.float32)
+        r_i = gs_i[..., None] * y_i - gs_q[..., None] * y_q + nz[0]
+        r_q = gs_i[..., None] * y_q + gs_q[..., None] * y_i + nz[1]
         # matched filter: acc = sum conj(y) * r
-        acc_i = acc_i + jnp.sum(r_i * y_i + r_q * y_q, axis=-1)  # [B,C,M]
+        acc_i = acc_i + jnp.sum(r_i * y_i + r_q * y_q, axis=-1)  # [B,C,1]
         acc_q = acc_q + jnp.sum(r_q * y_i - r_i * y_q, axis=-1)
         energy = energy + jnp.sum(y_i * y_i + y_q * y_q, axis=-1)
         return (acc_i, acc_q, energy), None
 
-    zeros = jnp.zeros((B, C, M), jnp.float32)
+    zeros = jnp.zeros((B, C, 1), jnp.float32)
     (acc_i, acc_q, energy), _ = jax.lax.scan(
         chunk_body, (zeros, zeros, zeros),
         jnp.arange(n_chunks, dtype=jnp.int32))
-    new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)
-    bits = jnp.where(pending, new_bit, bits)
-    return bits, valid | fired
+    new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)[..., 0]
+    return _scatter_slot_bit(bits, valid, new_bit, oh_slot, has_pending)
+
+
+def _resolve_fused(st: dict, bits, valid, key, tables, fused_tables,
+                   response, W: int, Lp: int, ck: int):
+    """Slot-compacted resolve through the fused Pallas kernel
+    (:func:`..ops.resolve_pallas.resolve_windows_fused`): same
+    per-sample chain as :func:`_resolve` with every intermediate in
+    VMEM and in-kernel ADC noise.  Bit-identical to the XLA path at
+    sigma=0; same noise distribution (different generator) otherwise.
+    ``fused_tables`` come from ``build_fused_tables`` — built once per
+    run, NOT per epoch.
+    """
+    from ..ops.resolve_pallas import resolve_windows_fused
+    g0, g1, sigma = response
+    sc, state_sel, oh_slot, has_pending = \
+        _compact_pending_slot(st, valid, tables)
+    state_sel = state_sel[..., 0]                             # [B, C]
+    gs = jnp.where(state_sel[..., None] == 1,
+                   g1[None, :, :], g0[None, :, :])            # [B, C, 2]
+    acc_i, acc_q, energy = resolve_windows_fused(
+        sc, fused_tables, gs[..., 0], gs[..., 1], sigma, key, W, Lp,
+        ck=ck, interpret=jax.default_backend() != 'tpu')
+    new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)[..., 0]
+    return _scatter_slot_bit(bits, valid, new_bit, oh_slot, has_pending)
 
 
 def _discriminate_acc(acc_i, acc_q, energy, g0, g1):
@@ -457,14 +545,32 @@ def _run_physics_jit(soa, spc, interp, sync_part, qturns0, init_regs,
               jnp.asarray(spcs, jnp.int32), jnp.asarray(interps, jnp.int32))
     env_pads = _pad_env_planes(env_stack, _aligned_chunk(chunk, W, interps))
     response = (g0, g1, sigma)
+    if mode == 'fused':
+        # kernel constants built once, outside the epoch while_loop
+        from ..ops.resolve_pallas import build_fused_tables, fused_chunk
+        ck = fused_chunk(chunk, W)
+        fused_tables = build_fused_tables(
+            env_pads, _carrier_basis(freq_stack, W), W, interps, ck)
+        lp = env_pads[0].shape[1]
+    elif mode == 'persample':
+        # same hoist for the XLA path's (smaller) tables
+        chunk_a = _aligned_chunk(chunk, W, interps)
+        prebuilt = (_toeplitz_tables(env_pads, chunk_a, interps),
+                    _carrier_basis(freq_stack, -(-W // chunk_a) * chunk_a))
 
     def cond(carry):
         st, bits, valid, ep = carry
-        # stop on completion, epoch exhaustion, or a spent step budget
-        # (a shot that ran out of steps can never finish — don't burn
-        # further full-batch resolve passes on it)
-        return (~jnp.all(st['done'])) & (ep < max_epochs) \
-            & (st['_steps'] < cfg.max_steps)
+        # run while execution can still progress (not done, step budget
+        # left — a shot that ran out of steps can never finish, so don't
+        # burn further full-batch passes on it) OR fired windows remain
+        # unresolved (the slot-compacted resolver handles one slot per
+        # lane per epoch; trailing unread measurements still must end up
+        # in meas_bits), within the epoch bound either way
+        can_exec = (~jnp.all(st['done'])) & (st['_steps'] < cfg.max_steps)
+        fired = jnp.arange(cfg.max_meas)[None, None, :] \
+            < st['n_meas'][..., None]
+        unresolved = jnp.any(fired & ~valid)
+        return (can_exec | unresolved) & (ep < max_epochs)
 
     def body(carry):
         st, bits, valid, ep = carry
@@ -472,9 +578,13 @@ def _run_physics_jit(soa, spc, interp, sync_part, qturns0, init_regs,
         if mode == 'analytic':
             bits, valid = _resolve_analytic(st, bits, valid, key, tables,
                                             env_pads, response, W)
+        elif mode == 'fused':
+            bits, valid = _resolve_fused(st, bits, valid, jax.random.fold_in(
+                key, ep), tables, fused_tables, response, W, lp, ck)
         else:
-            bits, valid = _resolve(st, bits, valid, key, tables, env_pads,
-                                   response, W, chunk, interps)
+            bits, valid = _resolve(st, bits, valid, jax.random.fold_in(
+                key, ep), tables, env_pads, response, W, chunk, interps,
+                prebuilt)
         st = dict(st, paused=jnp.zeros_like(st['paused']))
         return st, bits, valid, ep + 1
 
@@ -557,7 +667,7 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     # epoch bound: each epoch resolves at least one measurement, and a
     # cross-core dependency chain can serialize them — C*M+1 covers the
     # worst case (the loop exits early once every shot is done)
-    if model.resolve_mode not in ('persample', 'analytic'):
+    if model.resolve_mode not in ('persample', 'fused', 'analytic'):
         raise ValueError(f'unknown resolve_mode {model.resolve_mode!r}')
     return _run_physics_jit(
         soa, spc, interp, sync_part, qturns0, init_regs, env_stack,

@@ -156,6 +156,10 @@ class Simulator:
         ``shot`` to select one shot.
         """
         mp: MachineProgram = out['_mp']
+        if 'rec_gtime' not in out:
+            raise ValueError(
+                'run has no pulse records (record_pulses=False was set); '
+                'rendering needs a run with record_pulses=True')
         if shot is None and np.asarray(out['n_pulses']).ndim == 2:
             raise ValueError(
                 'batched run: pass shot= to select which shot to render '

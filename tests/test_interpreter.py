@@ -468,3 +468,56 @@ def test_large_program_gather_fetch_matches_oracle():
             np.asarray(out[key])[0, 0, :n],
             [p[fld] for p in o['pulses'][0]], err_msg=fld)
     np.testing.assert_array_equal(np.asarray(out['qclk'])[0], o['qclk'])
+
+
+def test_record_pulses_off_same_results():
+    """record_pulses=False must not change any semantic output — only
+    drop the rec_* arrays (a memory/bandwidth knob for stats-only runs,
+    where the loop-carried record state cannot be dead-code-eliminated)."""
+    cmds = [
+        isa.pulse_cmd(freq_word=3, cfg_word=2, env_word=(2 << 12) | 0,
+                      cmd_time=10),
+        isa.idle(80),
+        isa.alu_cmd('jump_fproc', 'i', 1, 'eq', jump_cmd_ptr=4, func_id=0),
+        isa.jump_i(5),
+        isa.pulse_cmd(freq_word=9, cfg_word=0, env_word=(2 << 12) | 0,
+                      cmd_time=200),
+        isa.done_cmd(),
+    ]
+    prog = mp_of(cmds)
+    bits = np.array([[[0]], [[1]], [[1]], [[0]]])
+    on = simulate_batch(prog, bits)
+    off = simulate_batch(prog, bits, record_pulses=False)
+    assert not any(k.startswith('rec_') for k in off)
+    for k in ('n_pulses', 'err', 'qclk', 'done', 'regs', 'n_meas'):
+        np.testing.assert_array_equal(np.asarray(on[k]), np.asarray(off[k]))
+
+
+def test_record_pulses_off_physics():
+    """The physics-closed loop works without pulse records (its own
+    meas_* bookkeeping is independent of rec_*)."""
+    from distributed_processor_tpu.simulator import Simulator
+    from distributed_processor_tpu.models.experiments import active_reset
+    from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                       run_physics_batch)
+    sim = Simulator(n_qubits=2)
+    mp = sim.compile(active_reset(['Q0', 'Q1']))
+    init = np.array([[1, 0], [0, 1]], np.int32)
+    out = run_physics_batch(
+        mp, ReadoutPhysics(sigma=0.01), 0, 2, init_states=init,
+        max_steps=mp.n_instr * 4 + 64, max_pulses=32, max_meas=4,
+        record_pulses=False)
+    assert not bool(out['incomplete'])
+    np.testing.assert_array_equal(
+        np.asarray(out['meas_bits'])[:, :, 0], init)
+    np.testing.assert_array_equal(np.asarray(out['n_pulses']), 2 + 2 * init)
+    assert 'rec_gtime' not in out
+
+
+def test_waveforms_requires_records():
+    from distributed_processor_tpu.simulator import Simulator
+    from distributed_processor_tpu.models.experiments import active_reset
+    sim = Simulator(n_qubits=1)
+    out = sim.run(active_reset(['Q0']), record_pulses=False)
+    with pytest.raises(ValueError, match='record_pulses'):
+        sim.waveforms(out)

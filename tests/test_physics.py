@@ -193,32 +193,36 @@ def test_window_matches_synthesize_element(reset_mp):
                                win[:, 1], rtol=1e-4, atol=1e-5)
 
 
-def test_analytic_resolve_matches_persample_deterministic(reset_mp):
-    """resolve_mode='analytic' is the exact distributional shortcut: at
-    sigma=0 it produces bit-identical results to the per-sample path
-    (both reduce to sign of the clean projection)."""
+def test_resolve_modes_deterministic_identity(reset_mp):
+    """'analytic' (exact distributional shortcut) and 'fused' (Pallas
+    kernel, ops/resolve_pallas.py) must produce bit-identical results to
+    the per-sample XLA path at sigma=0 — all three reduce to the sign of
+    the clean matched-filter projection."""
     rng = np.random.default_rng(9)
     init = rng.integers(0, 2, (16, 2)).astype(np.int32)
     outs = {}
-    for mode in ('persample', 'analytic'):
+    for mode in ('persample', 'analytic', 'fused'):
         model = ReadoutPhysics(sigma=0.0, resolve_mode=mode)
         outs[mode] = _run(reset_mp, model, 3, init)
-    np.testing.assert_array_equal(np.asarray(outs['analytic']['meas_bits']),
-                                  np.asarray(outs['persample']['meas_bits']))
-    np.testing.assert_array_equal(np.asarray(outs['analytic']['n_pulses']),
-                                  np.asarray(outs['persample']['n_pulses']))
+    for mode in ('analytic', 'fused'):
+        np.testing.assert_array_equal(
+            np.asarray(outs[mode]['meas_bits']),
+            np.asarray(outs['persample']['meas_bits']))
+        np.testing.assert_array_equal(
+            np.asarray(outs[mode]['n_pulses']),
+            np.asarray(outs['persample']['n_pulses']))
     np.testing.assert_array_equal(
         np.asarray(outs['analytic']['meas_bits'])[:, :, 0], init)
 
 
-def test_analytic_resolve_error_rate_matches(reset_mp):
-    """At finite sigma the two modes draw different noise samples but
-    the same distribution: readout error rates agree statistically.
-    sigma is set for ~10% infidelity; 512 shots x 2 cores give a
+def test_resolve_modes_error_rate_matches(reset_mp):
+    """At finite sigma the modes draw different noise streams but the
+    same distribution: readout error rates agree statistically.
+    sigma is set for ~10-30% infidelity; 512 shots x 2 cores give a
     binomial CI of ~+/-1.3% (3 sigma ~4%)."""
     # calibrate sigma to the window: error rate = Q(|g1-g0|*sqrt(E)/(2*sigma))
     rates = {}
-    for mode in ('persample', 'analytic'):
+    for mode in ('persample', 'analytic', 'fused'):
         model = ReadoutPhysics(sigma=45.0, resolve_mode=mode)
         out = run_physics_batch(reset_mp, model, 17, 512,
                                 init_states=np.zeros((512, 2), np.int32),
@@ -227,6 +231,21 @@ def test_analytic_resolve_error_rate_matches(reset_mp):
         rates[mode] = float(bits.mean())      # |0> prepared: errors = 1s
     assert 0.005 < rates['analytic'] < 0.5    # noise actually flips bits
     assert abs(rates['analytic'] - rates['persample']) < 0.06, rates
+    assert abs(rates['fused'] - rates['persample']) < 0.06, rates
+
+
+def test_fused_resolve_active_reset_loop(reset_mp):
+    """The fused kernel drives the closed loop end-to-end: low-noise
+    active reset resolves every branch from its in-VMEM demod."""
+    model = ReadoutPhysics(sigma=0.01, resolve_mode='fused')
+    init = np.array([[1, 0], [0, 1], [1, 1], [0, 0]], np.int32)
+    out = _run(reset_mp, model, 0, init)
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
+    np.testing.assert_array_equal(
+        np.asarray(out['meas_bits'])[:, :, 0], init)
+    np.testing.assert_array_equal(np.asarray(out['n_pulses']), 2 + 2 * init)
+    np.testing.assert_array_equal(np.asarray(out['qturns']) % 4 // 2, 0)
 
 
 def test_thermal_init_statistics(reset_mp):
