@@ -6,6 +6,8 @@ from .rb import rb_program, rb_sequence, clifford_table
 from .readout import sample_meas_bits, apply_assignment_error, IQReadoutModel
 from .default_qchip import make_default_qchip, make_default_qchip_dict
 from .repetition import (repetition_round_machine_program, repetition_config,
+                         repetition_round_program,
+                         repetition_physics_kwargs,
                          majority_lut, corrected_counts)
 from .calibration import (fit_centroids, assignment_matrix,
                           readout_fidelity, calibrate_readout)

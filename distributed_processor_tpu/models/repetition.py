@@ -59,6 +59,44 @@ def repetition_config(n_data: int, **kw) -> InterpreterConfig:
     return InterpreterConfig(**defaults)
 
 
+def repetition_round_program(n_data: int = 3,
+                             slack_s: float = 3e-6) -> list[dict]:
+    """Gate-level (compiled-path) repetition round, for physics-closed
+    execution: every data qubit measures, branches on its own
+    majority-vote correction bit from the syndrome LUT (``func_id=1``),
+    and conditionally flips (two X90 = X).
+
+    ``slack_s``: delay at the head of the correction branch — the LUT
+    read blocks until every masked core's window demodulates (readout
+    window + demod hold), a wait the static scheduler cannot see; the
+    slack keeps the correction pulses' trigger times ahead of it.
+
+    Run with ``repetition_physics_kwargs(n_data)`` as the interpreter
+    configuration.
+    """
+    program = []
+    for i in range(n_data):
+        q = f'Q{i}'
+        program += [
+            {'name': 'read', 'qubit': [q]},
+            {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+             'func_id': 1, 'scope': [q],
+             'true': [{'name': 'delay', 't': slack_s, 'qubit': [q]},
+                      {'name': 'X90', 'qubit': [q]},
+                      {'name': 'X90', 'qubit': [q]}],
+             'false': []},
+        ]
+    return program
+
+
+def repetition_physics_kwargs(n_data: int) -> dict:
+    """Interpreter-config kwargs for the physics-closed round (pass to
+    ``run_physics_batch``): the LUT fabric with every data core masked
+    in and the majority table loaded."""
+    return dict(fabric='lut', lut_mask=(True,) * n_data,
+                lut_table=majority_lut(n_data), max_pulses=16, max_meas=2)
+
+
 def corrected_counts(out, n_data: int) -> np.ndarray:
     """Per-core correction count from a run's pulse records: cores that
     fired the 2-pulse flip after the readout."""

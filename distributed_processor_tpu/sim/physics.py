@@ -336,7 +336,6 @@ def _compact_pending_slot(st: dict, valid, tables):
     st_sel = {k: take(st[k]) for k in
               ('meas_amp', 'meas_phase', 'meas_freq', 'meas_env',
                'meas_gtime')}
-    st_sel['n_meas'] = jnp.ones((B, C), jnp.int32)
     sc = _window_scalars(st_sel, tables)
     return sc, take(st['meas_state']), oh_slot, has_pending
 

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Physics-closed repetition code: syndrome LUT correction vs ADC noise.
+
+A distance-3 repetition code round on the LUT measurement fabric
+(reference: hdl/fproc_lut.sv + meas_lut.sv): every data core measures,
+the demodulated bits form the syndrome address, and each core branches
+on its own majority-vote correction bit — readout, distribution, and
+correction all inside one jitted XLA computation, nothing injected.
+Reports the logical error rate (fraction of shots whose corrected
+codeword disagrees with the initial majority) as ADC noise rises.
+
+Runs anywhere (CPU mesh included):
+
+    JAX_PLATFORMS=cpu python examples/repetition_code_physics.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get('JAX_PLATFORMS'):
+    import jax
+    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+import numpy as np
+
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.models.repetition import (
+    repetition_round_program, repetition_physics_kwargs)
+from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                   run_physics_batch)
+
+N_DATA = 3
+SHOTS = 512
+
+
+def main():
+    sim = Simulator(n_qubits=N_DATA)
+    mp = sim.compile(repetition_round_program(N_DATA))
+    kw = dict(max_steps=mp.n_instr * 6 + 64, record_pulses=False,
+              **repetition_physics_kwargs(N_DATA))
+
+    print(f'distance-{N_DATA} repetition round, {SHOTS} shots, '
+          f'single-bit-flip initial states')
+    print(f'{"sigma":>8} {"readout_err":>12} {"logical_err":>12}')
+    rng = np.random.default_rng(0)
+    # one flipped data bit per shot: correctable by majority vote
+    init = np.zeros((SHOTS, N_DATA), np.int32)
+    init[np.arange(SHOTS), rng.integers(0, N_DATA, SHOTS)] = 1
+    for sigma in (0.01, 20.0, 40.0, 60.0, 80.0):
+        model = ReadoutPhysics(sigma=sigma)
+        out = run_physics_batch(mp, model, 7, SHOTS, init_states=init, **kw)
+        assert not bool(out['incomplete'])
+        bits = np.asarray(out['meas_bits'])[:, :, 0]
+        readout_err = float((bits != init).mean())
+        final = np.asarray(out['qturns']) % 4 // 2
+        logical_err = float((final != 0).any(axis=1).mean())
+        print(f'{sigma:8.2f} {readout_err:12.4f} {logical_err:12.4f}')
+
+
+if __name__ == '__main__':
+    main()
