@@ -51,10 +51,16 @@ def repetition_round_machine_program(n_data: int = 3,
     return machine_program_from_cmds(cores)
 
 
+def _lut_fabric_kwargs(n_data: int) -> dict:
+    """The LUT-fabric wiring every repetition path shares: all data
+    cores masked into the syndrome address, majority table loaded."""
+    return dict(fabric='lut', lut_mask=(True,) * n_data,
+                lut_table=majority_lut(n_data))
+
+
 def repetition_config(n_data: int, **kw) -> InterpreterConfig:
     defaults = dict(max_steps=64, max_pulses=8, max_meas=2, max_resets=1,
-                    fabric='lut', lut_mask=(True,) * n_data,
-                    lut_table=majority_lut(n_data))
+                    **_lut_fabric_kwargs(n_data))
     defaults.update(kw)
     return InterpreterConfig(**defaults)
 
@@ -90,11 +96,11 @@ def repetition_round_program(n_data: int = 3,
 
 
 def repetition_physics_kwargs(n_data: int) -> dict:
-    """Interpreter-config kwargs for the physics-closed round (pass to
-    ``run_physics_batch``): the LUT fabric with every data core masked
-    in and the majority table loaded."""
-    return dict(fabric='lut', lut_mask=(True,) * n_data,
-                lut_table=majority_lut(n_data), max_pulses=16, max_meas=2)
+    """Interpreter-config kwargs for the physics-closed compiled round
+    (pass to ``run_physics_batch``): the shared LUT wiring plus budgets
+    sized for the gate-level program (more pulses per core than the
+    hand-assembled machine round)."""
+    return dict(max_pulses=16, max_meas=2, **_lut_fabric_kwargs(n_data))
 
 
 def corrected_counts(out, n_data: int) -> np.ndarray:

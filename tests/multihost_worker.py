@@ -54,6 +54,16 @@ def main():
     gbits = global_shot_array(mesh, bits[offset:offset + local_shots],
                               bits.shape)
     stats = sweep_stats(mp, gbits, mesh, cfg=cfg)
+
+    # physics-closed execution across both controllers: every dp shard
+    # runs its own epoch loop (synthesis -> demod -> branch resolution)
+    # on local devices; statistics cross DCN only in the final psum
+    from distributed_processor_tpu.parallel import sharded_physics_stats
+    from distributed_processor_tpu.sim.physics import ReadoutPhysics
+    pstats = sharded_physics_stats(
+        mp, ReadoutPhysics(sigma=0.01, p1_init=1.0), 3, shots, mesh,
+        max_steps=mp.n_instr * 4 + 64, max_pulses=8, max_meas=2)
+
     print(json.dumps({
         'pid': PID,
         'info': info,
@@ -62,6 +72,9 @@ def main():
         'mean_pulses': np.asarray(stats['mean_pulses']).tolist(),
         'err_rate': float(stats['err_rate']),
         'mean_qclk': np.asarray(stats['mean_qclk']).tolist(),
+        'phys_mean_pulses': np.asarray(pstats['mean_pulses']).tolist(),
+        'phys_err_rate': float(pstats['err_rate']),
+        'phys_meas1_rate': np.asarray(pstats['meas1_rate']).tolist(),
     }))
 
 

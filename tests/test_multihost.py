@@ -68,20 +68,44 @@ def test_two_process_sweep_stats_matches_single():
     assert outs[0]['mean_qclk'] == outs[1]['mean_qclk']
     assert outs[0]['err_rate'] == outs[1]['err_rate'] == 0.0
 
+    # physics-closed stats agree across controllers too (epoch loops ran
+    # on each host's local devices; only the final psum crossed DCN)
+    assert outs[0]['phys_mean_pulses'] == outs[1]['phys_mean_pulses']
+    assert outs[0]['phys_meas1_rate'] == outs[1]['phys_meas1_rate']
+    assert outs[0]['phys_err_rate'] == outs[1]['phys_err_rate'] == 0.0
+    # p1_init=1, sigma=0.01: every shot measured 1 and took the reset
+    # branch (4 pulses) — the physics loop really closed on both hosts
+    np.testing.assert_allclose(outs[0]['phys_meas1_rate'], 1.0)
+    np.testing.assert_allclose(outs[0]['phys_mean_pulses'], 4.0)
+
     # ... equal to the single-process run of the same global batch
-    from distributed_processor_tpu.parallel import sweep_stats, make_mesh
+    from distributed_processor_tpu.parallel import (sweep_stats, make_mesh,
+                                                    sharded_physics_stats)
     from distributed_processor_tpu.pipeline import compile_to_machine
     from distributed_processor_tpu.models import (active_reset,
                                                   make_default_qchip)
     from distributed_processor_tpu.sim.interpreter import InterpreterConfig
+    from distributed_processor_tpu.sim.physics import ReadoutPhysics
     mp = compile_to_machine(active_reset(['Q0']), make_default_qchip(2),
                             n_qubits=1)
     cfg = InterpreterConfig(max_steps=mp.n_instr + 8, max_pulses=8,
                             max_meas=2, max_resets=1)
     rng = np.random.default_rng(7)            # worker's stream
     bits = rng.integers(0, 2, size=(16, mp.n_cores, cfg.max_meas))
-    stats = sweep_stats(mp, bits, make_mesh(n_dp=8), cfg=cfg)
+    mesh = make_mesh(n_dp=8)
+    stats = sweep_stats(mp, bits, mesh, cfg=cfg)
     np.testing.assert_allclose(np.asarray(stats['mean_pulses']),
                                outs[0]['mean_pulses'])
     np.testing.assert_allclose(np.asarray(stats['mean_qclk']),
                                outs[0]['mean_qclk'])
+    # same dp-axis extent (8) => identical per-shard fold_in keys, so
+    # the single-process physics stats match the 2-controller run
+    pstats = sharded_physics_stats(
+        mp, ReadoutPhysics(sigma=0.01, p1_init=1.0), 3, 16, mesh,
+        max_steps=mp.n_instr * 4 + 64, max_pulses=8, max_meas=2)
+    np.testing.assert_allclose(np.asarray(pstats['mean_pulses']),
+                               outs[0]['phys_mean_pulses'])
+    np.testing.assert_allclose(np.asarray(pstats['meas1_rate']),
+                               outs[0]['phys_meas1_rate'])
+    np.testing.assert_allclose(float(pstats['err_rate']),
+                               outs[0]['phys_err_rate'])

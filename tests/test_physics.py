@@ -289,3 +289,34 @@ def test_lut_fabric_physics_majority_correction():
     np.testing.assert_array_equal(
         np.asarray(out['n_pulses']),
         2 + 2 * (init != maj[:, None]).astype(np.int32))
+
+
+def test_qasm_source_to_physics_closed_loop():
+    """Full stack, nothing injected: OpenQASM 3 source with
+    measurement-conditioned branches -> compiler -> machine code ->
+    batched interpretation with the readout loop closed by the DSP
+    chain.  The ``if (c[i] == 1) x`` correction follows the emergent
+    bit, returning every qubit to ground."""
+    from distributed_processor_tpu.frontend import qasm_to_program
+    from distributed_processor_tpu.pipeline import compile_to_machine
+    from distributed_processor_tpu.models import make_default_qchip
+    src = '''
+        OPENQASM 3;
+        qubit[2] q;
+        bit[2] c;
+        c[0] = measure q[0];
+        c[1] = measure q[1];
+        if (c[0] == 1) { x q[0]; }
+        if (c[1] == 1) { x q[1]; }
+    '''
+    mp = compile_to_machine(qasm_to_program(src), make_default_qchip(2),
+                            n_qubits=2)
+    init = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.int32)
+    out = _run(mp, ReadoutPhysics(sigma=0.01), 2, init)
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
+    np.testing.assert_array_equal(np.asarray(out['meas_bits'])[:, :, 0],
+                                  init)
+    np.testing.assert_array_equal(np.asarray(out['n_pulses']),
+                                  2 + 2 * init)
+    np.testing.assert_array_equal(np.asarray(out['qturns']) % 4 // 2, 0)
