@@ -164,6 +164,12 @@ def cmd_trace(args):
     mp = sim.compile(_load_program(args.program, args.qasm))
     from .sim import simulate
     out = simulate(mp, cfg=sim.interpreter_config(mp, trace=True))
+    if args.vcd:
+        from .utils.vcd import write_vcd
+        n = write_vcd(args.vcd, out, core_labels=mp.core_inds)
+        print(f'wrote {args.vcd}: {n} value changes '
+              f'({mp.n_cores} cores, {int(out["steps"])} steps)')
+        return
     steps = int(out['steps'])
     for c in range(mp.n_cores):
         print(f'# core {mp.core_inds[c]}')
@@ -215,6 +221,10 @@ def main(argv=None):
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')
     p.add_argument('program')
+    p.add_argument('--vcd', metavar='FILE',
+                   help='write a VCD waveform (GTKWave-compatible) '
+                        'instead of printing — the analog of the '
+                        "reference's Verilator --trace output")
     p.set_defaults(fn=cmd_trace)
 
     args = ap.parse_args(argv)
