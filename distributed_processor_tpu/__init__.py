@@ -36,3 +36,6 @@ from .compiler import Compiler, CompiledProgram, CompilerFlags, get_passes, \
     load_compiled_program
 from .assembler import SingleCoreAssembler, GlobalAssembler
 from .decoder import decode_assembled_program, MachineProgram
+
+# experiment-curve fitting lives in .analysis (imported explicitly —
+# it pulls in jax, which the compile stack above does not need)

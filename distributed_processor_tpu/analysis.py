@@ -1,0 +1,154 @@
+"""Experiment-curve fitting for the models/experiments generators.
+
+The reference stack ends at compile + gateware; its users fit T1/T2/RB
+curves with external tooling.  This module closes that loop for the TPU
+build: the fits run as jitted Gauss-Newton refinements (``jnp``), so a
+sweep's statistics can stay on-device end-to-end.
+
+Decay constants are fitted in log space (``tau = exp(theta)``,
+``p = exp(theta)``): the parameterization is smooth and positive by
+construction, so an overshooting Gauss-Newton step cannot land in a
+clipped zero-gradient region and silently return garbage.
+
+All fitters take plain arrays and return plain floats — they are
+data-side math, usable on hardware data as much as on simulated sweeps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _gauss_newton(residual_fn, theta0, n_iter: int = 100):
+    """Levenberg-Marquardt (adaptively damped Gauss-Newton).
+
+    ``residual_fn(theta) -> [N]``; returns the refined parameter vector.
+    The damping factor shrinks 10x on improving steps and grows 10x on
+    rejected ones (rejected steps keep the previous iterate), which
+    makes the solver robust to poor initializations — a fixed small
+    damping lets one early overshoot diverge the whole fit.  Fixed
+    iteration count keeps it jittable.
+    """
+    jac_fn = jax.jacfwd(residual_fn)
+
+    def body(_, carry):
+        theta, lam = carry
+        r = residual_fn(theta)
+        J = jac_fn(theta)
+        A = J.T @ J + lam * jnp.eye(theta.shape[0])
+        step = jnp.linalg.solve(A, J.T @ r)
+        cand = theta - step
+        better = jnp.sum(residual_fn(cand) ** 2) < jnp.sum(r ** 2)
+        theta = jnp.where(better, cand, theta)
+        lam = jnp.clip(jnp.where(better, lam * 0.1, lam * 10.0),
+                       1e-12, 1e12)
+        return theta, lam
+
+    theta0 = jnp.asarray(theta0)
+    theta, _ = jax.lax.fori_loop(0, n_iter, body,
+                                 (theta0, jnp.float32(1e-3)))
+    return theta
+
+
+@jax.jit
+def _fit_exp(x, y):
+    # init: c from the tail, a from the head, tau from the log-slope of
+    # the first half (guarded against non-positive values)
+    c0 = y[-1]
+    a0 = y[0] - c0
+    half = max(x.shape[0] // 2, 2)
+    z = jnp.log(jnp.clip(jnp.abs(y[:half] - c0), 1e-9, None))
+    slope = (z[-1] - z[0]) / (x[half - 1] - x[0] + 1e-30)
+    tau0 = jnp.where(slope < 0, -1.0 / slope, (x[-1] - x[0]) / 2)
+
+    def resid(th):
+        a, log_tau, c = th
+        return a * jnp.exp(-x * jnp.exp(-log_tau)) + c - y
+
+    a, log_tau, c = _gauss_newton(
+        resid, jnp.stack([a0, jnp.log(jnp.clip(tau0, 1e-30, None)), c0]))
+    return jnp.stack([a, jnp.exp(log_tau), c])
+
+
+def fit_exp_decay(x, y):
+    """Fit ``y = a * exp(-x / tau) + c``.  Returns ``(a, tau, c)``."""
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    a, tau, c = np.asarray(_fit_exp(x, y), float)
+    return float(a), float(tau), float(c)
+
+
+def fit_t1(delays_s, p_excited):
+    """T1 from an excited-population decay curve (models/experiments
+    ``t1_program`` sweeps).  Returns ``(t1_s, fit_params)``."""
+    a, tau, c = fit_exp_decay(delays_s, p_excited)
+    return tau, (a, tau, c)
+
+
+@jax.jit
+def _fit_rb(m, y):
+    B0 = y[-1]
+    A0 = y[0] - B0
+    # p init from the ratio of successive decays
+    ratio = jnp.clip((y[1] - B0) / jnp.where(
+        jnp.abs(y[0] - B0) < 1e-9, 1e-9, y[0] - B0), 1e-6, 1.0)
+    p0 = ratio ** (1.0 / jnp.clip(m[1] - m[0], 1e-30, None))
+
+    def resid(th):
+        A, log_p, B = th
+        return A * jnp.exp(m * log_p) + B - y       # p**m, p = e^log_p
+
+    A, log_p, B = _gauss_newton(
+        resid, jnp.stack([A0, jnp.log(jnp.clip(p0, 1e-6, None)), B0]))
+    return jnp.stack([A, jnp.exp(log_p), B])
+
+
+def fit_rb(depths, survival):
+    """Randomized-benchmarking decay fit: ``survival = A * p**m + B``.
+
+    Returns ``(p, error_per_clifford, (A, p, B))`` with the standard
+    single-qubit (d=2) average error per Clifford ``r = (1-p)/2``.
+    """
+    A, p, B = np.asarray(_fit_rb(jnp.asarray(depths, jnp.float32),
+                                 jnp.asarray(survival, jnp.float32)), float)
+    p = float(np.clip(p, 0.0, 1.0))
+    return p, (1.0 - p) / 2.0, (float(A), p, float(B))
+
+
+@jax.jit
+def _fit_ramsey(t, y, theta0):
+    def resid(th):
+        a, log_tau, f, phi, c = th
+        return (a * jnp.exp(-t * jnp.exp(-log_tau))
+                * jnp.cos(2 * jnp.pi * f * t + phi) + c - y)
+    a, log_tau, f, phi, c = _gauss_newton(resid, theta0, n_iter=100)
+    return jnp.stack([a, jnp.exp(log_tau), f, phi, c])
+
+
+def fit_ramsey(delays_s, p_excited):
+    """Damped-cosine fit for Ramsey fringes:
+    ``p = a * exp(-t/tau) * cos(2*pi*f*t + phi) + c``.
+
+    Returns ``(f_hz, t2_star_s, params)``; the frequency initializer
+    takes the dominant nonzero FFT bin, so the sweep should cover at
+    least one oscillation period.
+    """
+    t = np.asarray(delays_s, np.float64)
+    y = np.asarray(p_excited, np.float64)
+    c0 = float(y.mean())
+    # dominant frequency from the (uniformly-sampled) FFT
+    dt = float(t[1] - t[0])
+    spec = np.abs(np.fft.rfft(y - c0))
+    freqs = np.fft.rfftfreq(len(y), dt)
+    f0 = float(freqs[1 + int(np.argmax(spec[1:]))])
+    a0 = float(2 * spec.max() / len(y))
+    tau0 = float(t[-1] - t[0]) / 2
+
+    theta0 = jnp.asarray([a0, np.log(tau0), f0, 0.0, c0], jnp.float32)
+    a, tau, f, phi, c = np.asarray(
+        _fit_ramsey(jnp.asarray(t, jnp.float32),
+                    jnp.asarray(y, jnp.float32), theta0), float)
+    return abs(float(f)), float(tau), (float(a), float(tau), float(f),
+                                       float(phi), float(c))

@@ -1,0 +1,57 @@
+"""Experiment-curve fitting (analysis.py): synthetic noisy data with
+known ground truth; fits must recover the parameters."""
+
+import numpy as np
+
+from distributed_processor_tpu.analysis import (fit_exp_decay, fit_t1,
+                                                fit_rb, fit_ramsey)
+
+
+def test_exp_decay_recovers_parameters():
+    rng = np.random.default_rng(0)
+    x = np.linspace(0, 100e-6, 40)
+    y = 0.9 * np.exp(-x / 25e-6) + 0.05 + rng.normal(0, 0.01, x.shape)
+    a, tau, c = fit_exp_decay(x, y)
+    assert abs(a - 0.9) < 0.05
+    assert abs(tau - 25e-6) < 2e-6
+    assert abs(c - 0.05) < 0.03
+
+
+def test_t1():
+    x = np.linspace(0, 200e-6, 30)
+    y = np.exp(-x / 42e-6)
+    t1, _ = fit_t1(x, y)
+    assert abs(t1 - 42e-6) < 1e-6
+
+
+def test_rb_decay():
+    rng = np.random.default_rng(1)
+    depths = np.array([1, 2, 4, 8, 16, 32, 64, 128])
+    p_true = 0.985
+    surv = 0.48 * p_true ** depths + 0.5 \
+        + rng.normal(0, 0.004, depths.shape)
+    p, epc, (A, pf, B) = fit_rb(depths, surv)
+    assert abs(p - p_true) < 0.004
+    assert abs(epc - (1 - p_true) / 2) < 0.002
+    assert abs(B - 0.5) < 0.05
+
+
+def test_ramsey_frequency_and_t2():
+    rng = np.random.default_rng(2)
+    t = np.linspace(0, 20e-6, 200)
+    f_true, t2_true = 350e3, 8e-6
+    y = 0.45 * np.exp(-t / t2_true) * np.cos(2 * np.pi * f_true * t) \
+        + 0.5 + rng.normal(0, 0.01, t.shape)
+    f, t2, _ = fit_ramsey(t, y)
+    assert abs(f - f_true) / f_true < 0.02
+    assert abs(t2 - t2_true) / t2_true < 0.25
+
+
+def test_rb_decay_unplateaued():
+    """Robustness: a sweep that stops before the survival plateau gives
+    a poor asymptote initialization; the adaptive (Levenberg) damping
+    must still converge instead of walking p to 0."""
+    depths = np.array([1, 2, 4, 8, 16, 32])
+    surv = 0.5 * 0.99 ** depths + 0.5
+    p, epc, _ = fit_rb(depths, surv)
+    assert abs(p - 0.99) < 0.003
