@@ -35,7 +35,8 @@ from .qchip import QChip
 from .compiler import Compiler, CompiledProgram, CompilerFlags, get_passes, \
     load_compiled_program
 from .assembler import SingleCoreAssembler, GlobalAssembler
-from .decoder import decode_assembled_program, MachineProgram
+from .decoder import (decode_assembled_program, MachineProgram,
+                      make_init_regs)
 
 # experiment-curve fitting lives in .analysis (imported explicitly —
 # it pulls in jax, which the compile stack above does not need)

@@ -104,6 +104,12 @@ class SingleCoreAssembler:
             raise ValueError(
                 f'jump label(s) {pending_labels} at end of program')
 
+    @property
+    def register_map(self) -> dict:
+        """Declared variables: ``{name: {'index': i, 'dtype': (...)}}``."""
+        return {n: dict(index=r['index'], dtype=tuple(r['dtype']))
+                for n, r in self._regs.items()}
+
     def declare_reg(self, name: str, dtype=('int',)):
         if name in self._regs:
             raise ValueError(f'register {name} already declared')
@@ -490,6 +496,18 @@ class GlobalAssembler:
             out = [dict(s, jump_label=combined[s['jump_label']])
                    if s.get('jump_label') in combined else s for s in out]
         return out
+
+    @property
+    def register_maps(self) -> dict:
+        """Declared variables per core:
+        ``{core_ind: {name: {'index', 'dtype'}}}`` — the handle a host
+        needs to preload register-parameterized programs (the reference
+        writes these registers over the FPGA bus at run time; here they
+        seed ``init_regs``).  Kept out of ``get_assembled_program`` so
+        its output stays format-identical to the reference's BRAM
+        buffers (pinned by the golden-parity tests)."""
+        return {core_ind: asm.register_map
+                for core_ind, asm in self.assemblers.items()}
 
     def get_assembled_program(self) -> dict:
         """Returns {core_ind: {'cmd_buf', 'env_buffers', 'freq_buffers'}}."""

@@ -43,6 +43,10 @@ class MachineProgram:
     p_dur: np.ndarray            # [n_cores, n_instr] pulse duration (clks)
     tables: list                 # CoreTables per core
     core_inds: list              # original core indices (sorted)
+    # declared program variables per core (positional order):
+    # {name: {'index': reg index, 'dtype': ('int',) | ('amp', e) | ...}}
+    # — the handle for preloading register-parameterized programs
+    reg_maps: list = None
 
     @property
     def n_cores(self) -> int:
@@ -215,13 +219,16 @@ def _pulse_duration_clks(env_word: int, cfg: TPUElementConfig) -> int:
 
 def decode_assembled_program(assembled: dict, channel_configs: dict = None,
                              elem_cfgs_by_core: dict = None,
-                             pad_to: int = None) -> MachineProgram:
+                             pad_to: int = None,
+                             reg_maps: dict = None) -> MachineProgram:
     """Decode a ``GlobalAssembler.get_assembled_program()`` result.
 
     Element configs are needed to derive pulse durations and decode the
     env/freq buffers; provide them either via ``channel_configs`` (the same
     dict handed to GlobalAssembler, TPUElementConfig is assumed) or as an
     explicit ``{core_ind: [ElementConfig, ...]}`` mapping.
+    ``reg_maps``: ``GlobalAssembler.register_maps`` — attach it so
+    :func:`make_init_regs` can target declared variables by name.
     """
     core_inds = sorted(assembled, key=lambda k: int(k))
     if elem_cfgs_by_core is None:
@@ -264,4 +271,75 @@ def decode_assembled_program(assembled: dict, channel_configs: dict = None,
             if elem < len(cfgs) and (soa.p_wen[c, i] >> 0) & 1:  # env written
                 p_dur[c, i] = _pulse_duration_clks(int(soa.p_env[c, i]), cfgs[elem])
     return MachineProgram(soa=soa, p_elem=p_elem, p_dur=p_dur,
-                          tables=tables, core_inds=[int(c) for c in core_inds])
+                          tables=tables,
+                          core_inds=[int(c) for c in core_inds],
+                          reg_maps=[dict((reg_maps or {}).get(c, {}))
+                                    for c in core_inds])
+
+
+def make_init_regs(mp: MachineProgram, assignments: dict,
+                   n_shots: int = None) -> np.ndarray:
+    """Register file preloading named program variables.
+
+    ``assignments``: ``{var_name: value}`` where a value is a scalar or
+    a ``[n_shots]`` array (sweep axis).  Physical values are converted
+    to words by the variable's declared dtype and the core's element
+    config: ``('amp', e)`` floats in [0, 1] -> 16-bit amp words,
+    ``('phase', e)`` radians -> 17-bit phase words, ``('int',)``
+    passthrough.  Each variable is written on every core that declared
+    it.  Returns ``[n_cores, N_REGS]`` int32, or
+    ``[n_shots, n_cores, N_REGS]`` when ``n_shots`` is given — feed to
+    ``simulate``/``simulate_batch``/``run_physics_batch`` ``init_regs``.
+
+    This is the simulator-side analog of the reference host writing
+    parameter registers over the FPGA bus before triggering a run.
+    """
+    from . import isa as _isa
+    if not mp.reg_maps or not any(mp.reg_maps):
+        raise ValueError(
+            'program declares no variables (reg_maps empty) — either it '
+            'declares none, or decode_assembled_program was called '
+            'without reg_maps=GlobalAssembler.register_maps '
+            '(pipeline.compile_to_machine threads it automatically)')
+    shape = ((n_shots, mp.n_cores, _isa.N_REGS) if n_shots is not None
+             else (mp.n_cores, _isa.N_REGS))
+    regs = np.zeros(shape, np.int32)
+
+    def to_word(val, dtype, cfgs):
+        kind = dtype[0]
+        if kind == 'int':
+            return np.asarray(val).astype(np.int64)
+        elem = int(dtype[1])
+        if elem >= len(cfgs):
+            raise ValueError(f'dtype {dtype}: core has no element {elem}')
+        conv = cfgs[elem].get_amp_word if kind == 'amp' \
+            else cfgs[elem].get_phase_word
+        v = np.asarray(val, float)
+        return np.vectorize(conv, otypes=[np.int64])(v)
+
+    for name, val in assignments.items():
+        val_arr = np.asarray(val)
+        if val_arr.ndim > 1 or (val_arr.ndim == 1 and n_shots is None):
+            raise ValueError(
+                f'{name!r}: array values need n_shots= (got shape '
+                f'{val_arr.shape}, n_shots={n_shots})')
+        if val_arr.ndim == 1 and n_shots is not None \
+                and val_arr.shape[0] != n_shots:
+            raise ValueError(
+                f'{name!r}: value length {val_arr.shape[0]} != '
+                f'n_shots {n_shots}')
+        hit = False
+        for c, rm in enumerate(mp.reg_maps):
+            if name not in rm:
+                continue
+            hit = True
+            word = to_word(val, tuple(rm[name]['dtype']),
+                           mp.tables[c].elem_cfgs)
+            word = (word.astype(np.int64) & 0xffffffff).astype(np.int64)
+            word = word.astype(np.uint32).view(np.int32)
+            regs[..., c, rm[name]['index']] = word
+        if not hit:
+            raise KeyError(f'variable {name!r} not declared by any core; '
+                           f'declared: '
+                           f'{sorted(set().union(*map(set, mp.reg_maps)))}')
+    return regs

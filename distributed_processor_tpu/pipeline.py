@@ -42,4 +42,5 @@ def compile_to_machine(program, qchip, channel_configs=None,
     prog = compile_program(program, qchip, fpga_config, compiler_flags)
     asm = GlobalAssembler(prog, channel_configs, element_cls)
     assembled = asm.get_assembled_program()
-    return decode_assembled_program(assembled, channel_configs, pad_to=pad_to)
+    return decode_assembled_program(assembled, channel_configs, pad_to=pad_to,
+                                    reg_maps=asm.register_maps)
