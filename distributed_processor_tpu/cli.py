@@ -146,8 +146,19 @@ def cmd_freqdump(args):
 
 def cmd_run(args):
     sim = _make_sim(args)
+    kw = {}
+    if args.physics:
+        if args.p1 is not None:
+            raise SystemExit(
+                '--p1 injects bits; --physics resolves them in-sim — '
+                'use --p1-init for the thermal initial state instead')
+        from .sim.physics import ReadoutPhysics
+        kw['physics'] = ReadoutPhysics(sigma=args.sigma,
+                                       p1_init=args.p1_init)
+    else:
+        kw['p1'] = args.p1
     out = sim.run(_load_program(args.program, args.qasm), shots=args.shots,
-                  p1=args.p1)
+                  **kw)
     n_pulses = np.asarray(out['n_pulses'])
     err = np.asarray(out['err'])
     result = {
@@ -156,6 +167,11 @@ def cmd_run(args):
         'error_shots': int(np.any(np.atleast_2d(err) != 0, -1).sum()),
         'steps': int(out['steps']),
     }
+    if args.physics:
+        bits = np.asarray(out['meas_bits'])
+        result['meas1_rate_per_core'] = \
+            np.atleast_3d(bits)[..., 0].mean(0).tolist()
+        result['epochs'] = int(out['epochs'])
     print(json.dumps(result, indent=2))
 
 
@@ -216,7 +232,15 @@ def main(argv=None):
     p.add_argument('program')
     p.add_argument('--shots', type=int, default=1)
     p.add_argument('--p1', type=float, default=None,
-                   help='Bernoulli P(measure 1) per qubit')
+                   help='Bernoulli P(measure 1) per qubit (injected bits)')
+    p.add_argument('--physics', action='store_true',
+                   help='close the measurement loop with the DSP chain '
+                        '(synthesis -> demod -> discriminate) instead of '
+                        'injecting bits')
+    p.add_argument('--sigma', type=float, default=0.05,
+                   help='physics: per-sample ADC noise std dev')
+    p.add_argument('--p1-init', type=float, default=0.1,
+                   help='physics: thermal excited-state probability')
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')

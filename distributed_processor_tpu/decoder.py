@@ -306,16 +306,24 @@ def make_init_regs(mp: MachineProgram, assignments: dict,
     regs = np.zeros(shape, np.int32)
 
     def to_word(val, dtype, cfgs):
+        # array-wise mirrors of ElementConfig.get_amp_word /
+        # get_phase_word (elements.py) — the scalar methods would cost a
+        # Python call per shot on million-shot sweep axes
         kind = dtype[0]
         if kind == 'int':
             return np.asarray(val).astype(np.int64)
         elem = int(dtype[1])
         if elem >= len(cfgs):
             raise ValueError(f'dtype {dtype}: core has no element {elem}')
-        conv = cfgs[elem].get_amp_word if kind == 'amp' \
-            else cfgs[elem].get_phase_word
+        from .elements import AMP_BITS, PHASE_BITS
         v = np.asarray(val, float)
-        return np.vectorize(conv, otypes=[np.int64])(v)
+        if kind == 'amp':
+            if np.any((v < 0) | (v > 1)):
+                raise ValueError(f'amplitudes must be in [0, 1]: {v}')
+            return np.round(v * ((1 << AMP_BITS) - 1)).astype(np.int64)
+        frac = (v / (2 * np.pi)) % 1.0
+        return np.round(frac * (1 << PHASE_BITS)).astype(np.int64) \
+            % (1 << PHASE_BITS)
 
     for name, val in assignments.items():
         val_arr = np.asarray(val)

@@ -51,6 +51,10 @@ class FPGAConfig:
     pulse_regwrite_clks: int = 3
     pulse_load_clks: int = 3   # min clks between pulses on the same core
     fproc_channels: dict = None
+    # how many 'Qn.meas' channels to auto-generate (the reference
+    # hard-codes N_CORES=8, hwconfig.py:112-115; here it follows the
+    # system size — Simulator passes its n_qubits)
+    n_cores: int = N_CORES
 
     def __post_init__(self):
         if self.fproc_channels is None:
@@ -61,7 +65,7 @@ class FPGAConfig:
                     id=(f'Q{i}.rdlo', 'core_ind'),
                     hold_after_chans=[f'Q{i}.rdlo'],
                     hold_nclks=FPROC_MEAS_CLKS)
-                for i in range(N_CORES)}
+                for i in range(self.n_cores)}
 
     @property
     def fpga_clk_freq(self) -> float:
@@ -73,7 +77,8 @@ class FPGAConfig:
                 'jump_cond_clks': self.jump_cond_clks,
                 'jump_fproc_clks': self.jump_fproc_clks,
                 'pulse_regwrite_clks': self.pulse_regwrite_clks,
-                'pulse_load_clks': self.pulse_load_clks}
+                'pulse_load_clks': self.pulse_load_clks,
+                'n_cores': self.n_cores}
 
 
 @dataclass

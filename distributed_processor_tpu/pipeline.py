@@ -39,6 +39,10 @@ def compile_to_machine(program, qchip, channel_configs=None,
     """Full pipeline: compile, assemble, and decode for the simulator."""
     if channel_configs is None:
         channel_configs = make_channel_configs(n_qubits)
+    if fpga_config is None:
+        # size the auto-generated 'Qn.meas' fproc channels to the system
+        # (the Simulator facade does the same)
+        fpga_config = FPGAConfig(n_cores=n_qubits)
     prog = compile_program(program, qchip, fpga_config, compiler_flags)
     asm = GlobalAssembler(prog, channel_configs, element_cls)
     assembled = asm.get_assembled_program()

@@ -40,7 +40,7 @@ class Simulator:
         self.n_qubits = n_qubits
         self.qchip = qchip or make_default_qchip(n_qubits)
         self.channel_configs = channel_configs or make_channel_configs(n_qubits)
-        self.fpga_config = fpga_config or FPGAConfig()
+        self.fpga_config = fpga_config or FPGAConfig(n_cores=n_qubits)
 
     # -- compilation -----------------------------------------------------
 

@@ -76,3 +76,34 @@ def test_multihost_single_process_helpers():
     assert arr.shape == (16, 3)
     with pytest.raises(ValueError):
         host_local_batch(mesh, 15)
+
+
+def test_wide_core_axis_32_qubits():
+    """Scale sanity on the core axis: a 32-qubit program with sync
+    barriers and physics-closed active reset compiles and executes with
+    every lane correct — most tests run 2 or 8 cores; this pins the
+    wide-MIMD shape (one lane per qubit core, reference: one proc per
+    qubit)."""
+    import numpy as np
+    from distributed_processor_tpu.simulator import Simulator
+    from distributed_processor_tpu.models.experiments import active_reset
+    from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                       run_physics_batch)
+    n = 32
+    qubits = [f'Q{i}' for i in range(n)]
+    sim = Simulator(n_qubits=n)
+    mp = sim.compile(active_reset(qubits))
+    assert mp.n_cores == n
+    rng = np.random.default_rng(0)
+    init = rng.integers(0, 2, (4, n)).astype(np.int32)
+    out = run_physics_batch(mp, ReadoutPhysics(sigma=0.01), 0, 4,
+                            init_states=init,
+                            max_steps=mp.n_instr * 4 + 64,
+                            max_pulses=8, max_meas=2)
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
+    np.testing.assert_array_equal(np.asarray(out['meas_bits'])[:, :, 0],
+                                  init)
+    np.testing.assert_array_equal(np.asarray(out['n_pulses']),
+                                  2 + 2 * init)
+    np.testing.assert_array_equal(np.asarray(out['qturns']) % 4 // 2, 0)

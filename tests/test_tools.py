@@ -222,3 +222,22 @@ def test_vcd_requires_trace_and_records(tmp_path):
     out = sim.run([{'name': 'X90', 'qubit': ['Q0']}])
     with pytest.raises(ValueError, match='trace'):
         write_vcd(str(tmp_path / 'x.vcd'), out)
+
+
+def test_cli_run_physics(tmp_path, capsys):
+    """`dproc-tpu run --physics` closes the loop from the command line."""
+    prog_path = tmp_path / 'prog.json'
+    prog_path.write_text(json.dumps(
+        [{'name': 'read', 'qubit': ['Q0']},
+         {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+          'func_id': 'Q0.meas', 'scope': ['Q0'],
+          'true': [{'name': 'X90', 'qubit': ['Q0']},
+                   {'name': 'X90', 'qubit': ['Q0']}],
+          'false': []}]))
+    cli_main(['--qubits', '1', 'run', str(prog_path), '--shots', '16',
+              '--physics', '--sigma', '0.01', '--p1-init', '1.0'])
+    out = json.loads(capsys.readouterr().out)
+    assert out['error_shots'] == 0
+    assert out['meas1_rate_per_core'] == [1.0]   # all start excited
+    assert out['mean_pulses_per_core'] == [4.0]  # reset branch everywhere
+    assert out['epochs'] >= 1
