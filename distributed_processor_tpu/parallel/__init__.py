@@ -1,4 +1,5 @@
 from .mesh import make_mesh, shot_sharding
+from .driver import run_physics_sweep
 from .sweep import (sharded_simulate, sweep_stats, sharded_demod,
                     sharded_physics_stats)
 from .param_sweep import (swept_pulse_machine_program, grid_init_regs,

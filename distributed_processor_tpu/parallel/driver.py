@@ -1,0 +1,108 @@
+"""Host-side sweep driver: compile once, stream batches, accumulate.
+
+The last layer of SURVEY §7 step 7: the reference re-runs programs from
+the host one shot at a time; here the host's only job is to stream
+batch keys into one jitted computation and fold the returned statistics
+— resumable via :class:`..utils.results.SweepAccumulator`, so a
+million-shot physics-closed sweep survives interruption.
+
+The per-batch computation reduces on-device (sums, not per-shot
+arrays), so host traffic per batch is a few KB regardless of batch
+size.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..sim.interpreter import InterpreterConfig
+from ..utils.results import SweepAccumulator
+from .sweep import physics_batch_stats
+
+
+def _sweep_fingerprint(mp, model, batch: int, key) -> dict:
+    """Identity of a sweep for checkpoint validation: resuming with a
+    different program, model, batch size, or key must fail loudly, not
+    silently mix incompatible accumulations."""
+    prog_crc = zlib.crc32(np.ascontiguousarray(mp.soa.kind).tobytes())
+    for f in ('imm', 'cmd_time', 'p_amp', 'p_env'):
+        prog_crc = zlib.crc32(
+            np.ascontiguousarray(getattr(mp.soa, f)).tobytes(), prog_crc)
+    return {
+        'batch': int(batch),
+        'key': np.asarray(jax.random.key_data(key)).tolist(),
+        'program_crc': int(prog_crc),
+        'model': repr(model),
+    }
+
+
+def run_physics_sweep(mp, model, total_shots: int, batch: int,
+                      key=0, cfg: InterpreterConfig = None,
+                      init_regs=None, checkpoint: str = None,
+                      checkpoint_every: int = 0, **cfg_kw) -> dict:
+    """Physics-closed sweep: ``total_shots`` in ``batch``-sized steps.
+
+    Each batch is one jitted epoch-loop execution (thermal sampling →
+    interpretation → window synthesis → demod → branch resolution);
+    per-batch sums fold into a :class:`SweepAccumulator`.  With
+    ``checkpoint`` set, the sweep resumes from the saved state: already
+    -accumulated batches are skipped (the per-batch key stream is
+    deterministic in the batch index, so a resumed sweep produces the
+    identical result), and a checkpoint written by a different sweep
+    (other program/model/batch/key) is rejected.
+
+    ``init_regs``: optional register file, shared by every batch
+    (``[n_cores, 16]``) — sweep axes inside a batch come from
+    register-parameterized programs (see ``decoder.make_init_regs``).
+
+    Returns ``{'shots', 'mean_pulses' [C], 'meas1_rate' [C],
+    'err_shots', 'incomplete_batches'}``.
+    """
+    from ..sim.physics import run_physics_batch
+    from dataclasses import replace
+    cfg = replace(cfg, **cfg_kw) if cfg else InterpreterConfig(**cfg_kw)
+    cfg = replace(cfg, record_pulses=False)       # stats only
+    if total_shots <= 0 or batch <= 0:
+        raise ValueError(f'need positive total_shots/batch, got '
+                         f'{total_shots}/{batch}')
+    if total_shots % batch:
+        raise ValueError(f'total_shots {total_shots} not divisible by '
+                         f'batch {batch}')
+    n_batches = total_shots // batch
+    if isinstance(key, int):
+        key = jax.random.PRNGKey(key)
+
+    @jax.jit
+    def step(k):
+        out = run_physics_batch(mp, model, k, batch,
+                                init_regs=init_regs, cfg=cfg)
+        return dict(physics_batch_stats(out),
+                    incomplete=out['incomplete'].astype(jnp.int32))
+
+    meta = _sweep_fingerprint(mp, model, batch, key)
+    acc = SweepAccumulator.resume(checkpoint, checkpoint_every, meta=meta) \
+        if checkpoint else SweepAccumulator(meta=meta)
+    if acc.n_batches > n_batches:
+        raise ValueError(
+            f'checkpoint already holds {acc.n_batches} batches '
+            f'({acc.n_batches * batch} shots) > requested {total_shots}')
+    for i in range(acc.n_batches, n_batches):
+        # key derived from the batch INDEX, not a split chain: resuming
+        # from batch i reproduces the same stream
+        stats = step(jax.random.fold_in(key, i))
+        acc.add({k: np.asarray(v) for k, v in stats.items()})
+    if checkpoint:
+        acc.save()
+
+    shots_done = acc.n_batches * batch
+    return {
+        'shots': shots_done,
+        'mean_pulses': acc.state['pulse_sum'] / shots_done,
+        'meas1_rate': acc.state['meas1_sum'] / shots_done,
+        'err_shots': int(acc.state['err_shots']),
+        'incomplete_batches': int(acc.state['incomplete']),
+    }

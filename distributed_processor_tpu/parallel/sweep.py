@@ -109,6 +109,16 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
                 mean_qclk=out['qclk_sum'] / n_shots)
 
 
+def physics_batch_stats(out: dict) -> dict:
+    """The per-batch reductions every physics-stats path shares:
+    per-core pulse sums, first-slot measured-1 sums, errored shots."""
+    return dict(
+        pulse_sum=jnp.sum(out['n_pulses'], axis=0),
+        meas1_sum=jnp.sum(out['meas_bits'][:, :, 0], axis=0),
+        err_shots=jnp.sum(jnp.any(out['err'] != 0, axis=1)),
+    )
+
+
 def sharded_physics_stats(mp, model, key, shots: int, mesh,
                           cfg=None, **kw):
     """Physics-closed execution sharded over the mesh dp axis: every
@@ -139,12 +149,8 @@ def sharded_physics_stats(mp, model, key, shots: int, mesh,
     def local():
         k_local = jax.random.fold_in(key, jax.lax.axis_index('dp'))
         out = run_physics_batch(mp, model, k_local, local_shots, cfg=cfg)
-        stats = dict(
-            pulse_sum=jnp.sum(out['n_pulses'], axis=0),
-            err_shots=jnp.sum(jnp.any(out['err'] != 0, axis=1)),
-            meas1_sum=jnp.sum(out['meas_bits'][:, :, 0], axis=0),
-        )
-        return jax.tree.map(lambda x: jax.lax.psum(x, 'dp'), stats)
+        return jax.tree.map(lambda x: jax.lax.psum(x, 'dp'),
+                            physics_batch_stats(out))
 
     fn = shard_map(local, mesh=mesh, in_specs=(), out_specs=P(),
                    check_vma=False)

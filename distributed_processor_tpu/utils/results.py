@@ -49,11 +49,15 @@ class SweepAccumulator:
     picks up the accumulated state + next batch index.
     """
 
-    def __init__(self, path: str = None, checkpoint_every: int = 0):
+    def __init__(self, path: str = None, checkpoint_every: int = 0,
+                 meta: dict = None):
         self.path = path
         self.checkpoint_every = checkpoint_every
         self.state: dict = {}
         self.n_batches = 0
+        # caller-defined identity (batch size, keys, program fingerprint
+        # ...) persisted with the checkpoint so a resume can validate it
+        self.meta = dict(meta or {})
 
     def add(self, batch_stats: dict) -> None:
         for k, v in batch_stats.items():
@@ -66,13 +70,21 @@ class SweepAccumulator:
 
     def save(self) -> None:
         save_results(self.path, self.state,
-                     meta={'n_batches': self.n_batches})
+                     meta={'n_batches': self.n_batches, **self.meta})
 
     @classmethod
-    def resume(cls, path: str, checkpoint_every: int = 0) -> 'SweepAccumulator':
-        acc = cls(path, checkpoint_every)
+    def resume(cls, path: str, checkpoint_every: int = 0,
+               meta: dict = None) -> 'SweepAccumulator':
+        """Load the checkpoint at ``path`` (fresh accumulator if absent).
+        With ``meta`` given, a checkpoint whose stored identity differs
+        raises instead of silently mixing incompatible accumulations."""
+        acc = cls(path, checkpoint_every, meta=meta)
         if os.path.exists(path):
-            arrays, meta = load_results(path)
+            arrays, stored = load_results(path)
             acc.state = dict(arrays)
-            acc.n_batches = int(meta.get('n_batches', 0))
+            acc.n_batches = int(stored.pop('n_batches', 0))
+            if meta is not None and stored != acc.meta:
+                raise ValueError(
+                    f'checkpoint {path} was written by a different sweep: '
+                    f'stored {stored} != requested {acc.meta}')
         return acc

@@ -138,3 +138,43 @@ def test_make_init_regs_errors():
         make_init_regs(mp, {'v': np.arange(4)})        # array, no n_shots
     with pytest.raises(ValueError, match='n_shots'):
         make_init_regs(mp, {'v': np.arange(4)}, n_shots=8)  # length mismatch
+
+
+def test_physics_sweep_driver_resumes(tmp_path):
+    """run_physics_sweep: batched physics-closed accumulation with a
+    checkpoint; an interrupted sweep resumed from disk produces the
+    identical statistics (the key stream is indexed by batch)."""
+    from distributed_processor_tpu.simulator import Simulator
+    from distributed_processor_tpu.models.experiments import active_reset
+    from distributed_processor_tpu.parallel import run_physics_sweep
+    from distributed_processor_tpu.sim.physics import ReadoutPhysics
+
+    sim = Simulator(n_qubits=2)
+    mp = sim.compile(active_reset(['Q0', 'Q1']))
+    model = ReadoutPhysics(sigma=0.01, p1_init=0.5)
+    kw = dict(max_steps=mp.n_instr * 4 + 64, max_pulses=8, max_meas=2)
+
+    full = run_physics_sweep(mp, model, 64, 16, key=5, **kw)
+    assert full['shots'] == 64
+    assert full['err_shots'] == 0 and full['incomplete_batches'] == 0
+    assert np.all((full['meas1_rate'] > 0.3) & (full['meas1_rate'] < 0.7))
+    np.testing.assert_allclose(full['mean_pulses'],
+                               2 + 2 * full['meas1_rate'])
+
+    # run 2 of 4 batches, "crash", resume the rest: identical result
+    ckpt = str(tmp_path / 'sweep.npz')
+    part = run_physics_sweep(mp, model, 32, 16, key=5, checkpoint=ckpt,
+                             checkpoint_every=1, **kw)
+    assert part['shots'] == 32
+    resumed = run_physics_sweep(mp, model, 64, 16, key=5, checkpoint=ckpt,
+                                checkpoint_every=1, **kw)
+    assert resumed['shots'] == 64
+    np.testing.assert_array_equal(resumed['meas1_rate'],
+                                  full['meas1_rate'])
+    np.testing.assert_array_equal(resumed['mean_pulses'],
+                                  full['mean_pulses'])
+    # a checkpoint from a different sweep identity is rejected
+    with pytest.raises(ValueError, match='different sweep'):
+        run_physics_sweep(mp, model, 64, 32, key=5, checkpoint=ckpt, **kw)
+    with pytest.raises(ValueError, match='positive'):
+        run_physics_sweep(mp, model, 0, 16, key=5, **kw)
