@@ -24,19 +24,31 @@ from ..utils.results import SweepAccumulator
 from .sweep import physics_batch_stats
 
 
-def _sweep_fingerprint(mp, model, batch: int, key) -> dict:
+def _sweep_fingerprint(mp, model, batch: int, key, cfg,
+                       init_regs) -> dict:
     """Identity of a sweep for checkpoint validation: resuming with a
-    different program, model, batch size, or key must fail loudly, not
-    silently mix incompatible accumulations."""
-    prog_crc = zlib.crc32(np.ascontiguousarray(mp.soa.kind).tobytes())
-    for f in ('imm', 'cmd_time', 'p_amp', 'p_env'):
-        prog_crc = zlib.crc32(
-            np.ascontiguousarray(getattr(mp.soa, f)).tobytes(), prog_crc)
+    different program, model, config, registers, batch size, or key
+    must fail loudly, not silently mix incompatible accumulations."""
+    import dataclasses
+    crc = 0
+    for f in dataclasses.fields(mp.soa):          # every operand plane
+        crc = zlib.crc32(
+            np.ascontiguousarray(getattr(mp.soa, f.name)).tobytes(), crc)
+    for t in mp.tables:                           # env/freq content
+        for env in t.envs:
+            crc = zlib.crc32(np.ascontiguousarray(env).tobytes(), crc)
+        for fr in t.freqs:
+            crc = zlib.crc32(
+                np.ascontiguousarray(fr['freq']).tobytes(), crc)
+    regs_crc = 0 if init_regs is None else zlib.crc32(
+        np.ascontiguousarray(np.asarray(init_regs)).tobytes())
     return {
         'batch': int(batch),
         'key': np.asarray(jax.random.key_data(key)).tolist(),
-        'program_crc': int(prog_crc),
+        'program_crc': int(crc),
         'model': repr(model),
+        'cfg': repr(cfg),
+        'init_regs_crc': int(regs_crc),
     }
 
 
@@ -83,7 +95,10 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
         return dict(physics_batch_stats(out),
                     incomplete=out['incomplete'].astype(jnp.int32))
 
-    meta = _sweep_fingerprint(mp, model, batch, key)
+    meta = _sweep_fingerprint(mp, model, batch, key, cfg, init_regs)
+    if checkpoint and checkpoint_every <= 0:
+        checkpoint_every = 1          # a requested checkpoint that never
+                                      # writes mid-run resumes nothing
     acc = SweepAccumulator.resume(checkpoint, checkpoint_every, meta=meta) \
         if checkpoint else SweepAccumulator(meta=meta)
     if acc.n_batches > n_batches:

@@ -320,3 +320,32 @@ def test_qasm_source_to_physics_closed_loop():
     np.testing.assert_array_equal(np.asarray(out['n_pulses']),
                                   2 + 2 * init)
     np.testing.assert_array_equal(np.asarray(out['qturns']) % 4 // 2, 0)
+
+
+def test_multi_round_reset_steady_state():
+    """Three feedback rounds under heavy readout noise: each round is a
+    separate resolve epoch (measure -> demod -> conditional flip), and
+    the excited population converges to the per-measurement readout
+    error e — the fixed point of symmetric-error active reset
+    (P(1) -> e*P(0) + e*P(1) = e: a wrong 0-readout leaves |1>, a wrong
+    1-readout flips |0> back up)."""
+    sim = Simulator(n_qubits=2)
+    mp3 = sim.compile(active_reset(['Q0', 'Q1'], n_rounds=3))
+    # sigma chosen for substantial (~20-30%) readout error
+    model = ReadoutPhysics(sigma=40.0)
+    shots = 512
+    init = np.ones((shots, 2), np.int32)       # all excited
+    out = run_physics_batch(mp3, model, 123, shots, init_states=init,
+                            max_steps=mp3.n_instr * 6 + 64,
+                            max_pulses=32, max_meas=4)
+    assert not bool(out['incomplete'])
+    assert int(np.asarray(out['epochs'])) >= 3   # one resolve per round
+    assert np.all(np.asarray(out['meas_bits_valid'])[:, :, :3])
+
+    # readout error from round 1: every shot starts |1>, so a 0 bit is
+    # an error
+    e = 1.0 - float(np.asarray(out['meas_bits'])[:, :, 0].mean())
+    assert 0.1 < e < 0.4, e                    # noise regime as intended
+    final_excited = float((np.asarray(out['qturns']) % 4 // 2).mean())
+    # steady state = e (binomial CI at 512x2 shots ~ +/-1.3%, 3sig ~4%)
+    assert abs(final_excited - e) < 0.05, (final_excited, e)
