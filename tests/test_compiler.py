@@ -391,3 +391,31 @@ def test_compiled_program_save_load(qchip, tmp_path):
     for grp in prog.program:
         assert_close_tree(loaded.program[grp], prog.program[grp])
     assert loaded.fpga_config.alu_instr_clks == prog.fpga_config.alu_instr_clks
+
+
+def test_zphase_join_mismatch_rejected():
+    """Reference-faithful conservatism (found by fuzzing): a virtual-z
+    accumulated on one qubit reaches a post-loop join both directly and
+    via the *other* qubit's loop-control chain, where it is stale (the
+    loop predates later Z90s).  The reference's ResolveVirtualZ rejects
+    exactly this shape (reference: python/distproc/ir/passes.py:457-491
+    — the predecessor-consistency check; its docstring prescribes
+    BindPhase for phases that must cross such joins)."""
+    program = [
+        {'name': 'virtual_z', 'qubit': 'Q1', 'phase': 0.3},
+        {'name': 'declare', 'var': 'i', 'dtype': 'int', 'scope': ['Q0']},
+        {'name': 'loop', 'cond_lhs': 2, 'cond_rhs': 'i', 'alu_cond': 'ge',
+         'scope': ['Q0'],
+         'body': [{'name': 'X90', 'qubit': ['Q0']},
+                  {'name': 'alu', 'op': 'add', 'lhs': 1, 'rhs': 'i',
+                   'out': 'i'}]},
+        {'name': 'Z90', 'qubit': ['Q1']},      # Q1 phase moves on
+        {'name': 'read', 'qubit': ['Q1']},
+        {'name': 'branch_fproc', 'alu_cond': 'eq', 'cond_lhs': 1,
+         'func_id': 'Q1.meas', 'scope': ['Q1'],
+         'true': [{'name': 'X90', 'qubit': ['Q1']}], 'false': []},
+        {'name': 'X90', 'qubit': ['Q0']},      # join sees stale Q1 phase
+    ]
+    sim_mod = pytest.importorskip('distributed_processor_tpu.simulator')
+    with pytest.raises(ValueError, match='z-phase mismatch'):
+        sim_mod.Simulator(n_qubits=2).compile(program)
