@@ -65,6 +65,10 @@ ERR_STICKY_RACE = 64     # sticky read raced a measurement's arrival (a
                          # bit landed within STICKY_RACE_MARGIN clks of
                          # the read — hardware's 2-cycle handshake makes
                          # the latched value timing-dependent there)
+ERR_CW_MEAS = 128        # physics mode: measurement pulse with a CW
+                         # (hold-until-next) envelope — no defined window
+                         # length, so the resolver cannot demodulate it
+                         # (docs/PHYSICS.md "Known model limits")
 
 # program-fetch strategy crossover: one-hot multiply-reduce up to this
 # many instructions, per-lane gather beyond (see _step fetch comment)
@@ -430,7 +434,12 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     # half-turn parity, floor convention.  Measurement pulses record
     # their synthesis parameters for the epoch resolver (sim/physics.py).
     phys_updates = {}
+    cw_meas_err = 0
     if cfg.physics:
+        # a CW readout window has no length for the resolver to
+        # demodulate — flag it loudly instead of yielding silent 0 bits
+        cw_meas_err = jnp.where(is_meas_pulse & (env_len == 0xfff),
+                                ERR_CW_MEAS, 0)
         qturns = st['qturns']
         if cfg.x90_amp > 0:
             x90 = jnp.int32(cfg.x90_amp)
@@ -504,7 +513,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                             time - alu_res, offset)
     offset_next = jnp.where(sync_adv, release, offset_next)
 
-    err = st['err'] | rec_of | meas_of \
+    err = st['err'] | rec_of | meas_of | cw_meas_err \
         | jnp.where(missed_trig | missed_idle, ERR_MISSED_TRIG, 0) \
         | jnp.where(is_fproc & adv & fid_bad, ERR_FPROC_ID, 0) \
         | jnp.where(is_fproc & adv & f_deadlock, ERR_FPROC_DEADLOCK, 0) \

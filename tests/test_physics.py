@@ -349,3 +349,28 @@ def test_multi_round_reset_steady_state():
     final_excited = float((np.asarray(out['qturns']) % 4 // 2).mean())
     # steady state = e (binomial CI at 512x2 shots ~ +/-1.3%, 3sig ~4%)
     assert abs(final_excited - e) < 0.05, (final_excited, e)
+
+
+def test_cw_measurement_pulse_flagged():
+    """A CW (hold-until-next) readout envelope has no window length for
+    the resolver — physics mode must set ERR_CW_MEAS instead of quietly
+    producing zero-energy bits (docs/PHYSICS.md "Known model limits")."""
+    from distributed_processor_tpu import isa
+    from distributed_processor_tpu.decoder import machine_program_from_cmds
+    from distributed_processor_tpu.sim import ERR_CW_MEAS
+    cmds = [
+        isa.pulse_cmd(freq_word=0, cfg_word=2,          # meas elem
+                      env_word=(0xfff << 12) | 0,       # CW sentinel
+                      amp_word=30000, cmd_time=10),
+        isa.done_cmd(),
+    ]
+    mp = machine_program_from_cmds([cmds])
+    out = run_physics_batch(mp, ReadoutPhysics(sigma=0.0), 0, 1,
+                            init_states=np.zeros((1, 1), np.int32),
+                            max_steps=32, max_pulses=4, max_meas=2)
+    assert int(np.asarray(out['err'])[0, 0]) & ERR_CW_MEAS
+    # injected-bits mode is unaffected (bits don't come from windows)
+    from distributed_processor_tpu.sim import simulate
+    out2 = simulate(mp, meas_bits=np.array([[1, 0]]), max_steps=32,
+                    max_pulses=4, max_meas=2)
+    assert int(np.asarray(out2['err'])[0]) & ERR_CW_MEAS == 0
