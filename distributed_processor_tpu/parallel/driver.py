@@ -25,7 +25,7 @@ from .sweep import physics_batch_stats
 
 
 def _sweep_fingerprint(mp, model, batch: int, key, cfg,
-                       init_regs) -> dict:
+                       init_regs, n_dp: int = 0) -> dict:
     """Identity of a sweep for checkpoint validation: resuming with a
     different program, model, config, registers, batch size, or key
     must fail loudly, not silently mix incompatible accumulations."""
@@ -49,13 +49,17 @@ def _sweep_fingerprint(mp, model, batch: int, key, cfg,
         'model': repr(model),
         'cfg': repr(cfg),
         'init_regs_crc': int(regs_crc),
+        # the dp extent changes the per-shard key folding, hence the
+        # noise stream — a mesh checkpoint is not a single-device one
+        'n_dp': int(n_dp),
     }
 
 
 def run_physics_sweep(mp, model, total_shots: int, batch: int,
                       key=0, cfg: InterpreterConfig = None,
                       init_regs=None, checkpoint: str = None,
-                      checkpoint_every: int = 0, **cfg_kw) -> dict:
+                      checkpoint_every: int = 0, mesh=None,
+                      **cfg_kw) -> dict:
     """Physics-closed sweep: ``total_shots`` in ``batch``-sized steps.
 
     Each batch is one jitted epoch-loop execution (thermal sampling →
@@ -66,6 +70,12 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     deterministic in the batch index, so a resumed sweep produces the
     identical result), and a checkpoint written by a different sweep
     (other program/model/batch/key) is rejected.
+
+    With ``mesh`` given, every batch shards over the mesh ``dp`` axis
+    (``batch`` divisible by the axis size): each shard runs its own
+    epoch loop on its local shots with a key folded by (batch, shard),
+    and only the psum-reduced sums reach the host — the full-scale
+    shape of the BASELINE 1M-shot multi-chip sweep.
 
     ``init_regs``: optional register file, shared by every batch
     (``[n_cores, 16]``) — sweep axes inside a batch come from
@@ -88,14 +98,38 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     if isinstance(key, int):
         key = jax.random.PRNGKey(key)
 
-    @jax.jit
-    def step(k):
-        out = run_physics_batch(mp, model, k, batch,
-                                init_regs=init_regs, cfg=cfg)
-        return dict(physics_batch_stats(out),
-                    incomplete=out['incomplete'].astype(jnp.int32))
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        from .sweep import shard_map      # version shim lives there
+        n_dp = mesh.shape['dp']
+        if batch % n_dp:
+            raise ValueError(f'batch {batch} not divisible by mesh '
+                             f'dp={n_dp}')
+        local_shots = batch // n_dp
 
-    meta = _sweep_fingerprint(mp, model, batch, key, cfg, init_regs)
+        def local(k):
+            k_local = jax.random.fold_in(k, jax.lax.axis_index('dp'))
+            out = run_physics_batch(mp, model, k_local, local_shots,
+                                    init_regs=init_regs, cfg=cfg)
+            stats = dict(physics_batch_stats(out),
+                         incomplete=out['incomplete'].astype(jnp.int32))
+            stats = jax.tree.map(lambda x: jax.lax.psum(x, 'dp'), stats)
+            # a batch is incomplete if ANY shard was — don't count shards
+            stats['incomplete'] = jnp.minimum(stats['incomplete'], 1)
+            return stats
+
+        step = jax.jit(shard_map(local, mesh=mesh, in_specs=P(),
+                                 out_specs=P(), check_vma=False))
+    else:
+        @jax.jit
+        def step(k):
+            out = run_physics_batch(mp, model, k, batch,
+                                    init_regs=init_regs, cfg=cfg)
+            return dict(physics_batch_stats(out),
+                        incomplete=out['incomplete'].astype(jnp.int32))
+
+    meta = _sweep_fingerprint(mp, model, batch, key, cfg, init_regs,
+                              mesh.shape['dp'] if mesh is not None else 0)
     if checkpoint and checkpoint_every <= 0:
         checkpoint_every = 1          # a requested checkpoint that never
                                       # writes mid-run resumes nothing
