@@ -3,5 +3,6 @@ from .interpreter import (InterpreterConfig, simulate, simulate_batch,
                           ERR_MEAS_OVERFLOW, ERR_FPROC_DEADLOCK,
                           ERR_SYNC_DONE, ERR_FPROC_ID, ERR_STICKY_RACE,
                           ERR_CW_MEAS)
+from .device import DeviceModel
 from .oracle import OracleCore, run_oracle
 from .physics import ReadoutPhysics, run_physics_batch

@@ -1,0 +1,112 @@
+"""Qubit device co-state models for physics-closed execution.
+
+The reference models no device physics at all — real qubits supply the
+measurement bits its gateware branches on (reference:
+cocotb/proc/test_proc.py:441-446 injects them; in deployment the readout
+chain produces them).  This module supplies the numeric stand-in the TPU
+build's closed loop evolves *in-sim*, per (shot, core) lane, inside the
+interpreter's ``lax.while_loop``:
+
+``'parity'``
+    The round-1/2 classical stand-in: each drive-element pulse adds
+    ``round(amp / x90_amp)`` quarter turns to an int32 counter; the
+    state bit is the half-turn parity.  Deterministic, cheap, exactly
+    reproducible by hand — the mode the randomized engine-vs-oracle
+    fuzz and the headline bench use.
+
+``'bloch'``
+    An SU(2) co-state: a Bloch vector ``r = (x, y, z)`` (float32,
+    ``|0> = +z``, ``P(1) = (1 - z)/2``) per (shot, core).  Physics:
+
+    * **Drive pulses rotate.**  A pulse on ``drive_elem`` applies the
+      right-handed rotation by ``theta = (pi/2) * amp / x90_amp`` about
+      the equatorial axis ``(cos phi, sin phi, 0)`` where ``phi`` is the
+      pulse's 17-bit *phase word* — so virtual-z (the compiler folds
+      z-rotations into downstream pulse phase words,
+      ir/passes.py ResolveVirtualZ) and amplitude sweeps (register- or
+      modi-parameterized amp words) are physically meaningful.  The
+      convention matches ``U = exp(-i theta/2 (cos phi X + sin phi Y))``,
+      the X90 of models/rb.py at ``phi = 0``; measurement statistics
+      from |0> are invariant under the global phase-sign choice, which
+      is what pins it against the Clifford table
+      (tests/test_device_bloch.py).
+    * **Time evolves between pulses.**  At each drive/readout pulse the
+      lane first applies free evolution over the elapsed global-clock
+      interval since its previous one: detuning precession about z by
+      ``2*pi * detuning_hz * clk_period_s`` per clock, transverse decay
+      ``exp(-dt/T2)`` on (x, y), longitudinal relaxation
+      ``z -> 1 + (z - 1) * exp(-dt/T1)`` toward |0>.  Scheduled delays
+      therefore dephase/decay the qubit with no extra bookkeeping — the
+      gap simply shows up in the next pulse's trigger time.
+    * **Depolarization per drive pulse.**  ``r -> (1 - depol) * r``
+      after each rotation — the ensemble-averaged depolarizing channel,
+      the injectable error rate randomized benchmarking recovers.
+    * **Measurement projects.**  A readout pulse samples
+      ``bit ~ Bernoulli((1 - z)/2)`` (one pre-drawn uniform per
+      (shot, core, slot), deterministic per run key) and collapses
+      ``r -> (0, 0, 1 - 2*bit)``.  The sampled bit is what the readout
+      channel (sim/physics.py) then discriminates through noise — so
+      projection statistics and assignment errors layer the way they do
+      on hardware.  The pre-projection ``P(1)`` is recorded per slot
+      (``meas_p1``) for noise-free expectation readout in tests and
+      fitting.
+
+    All parameters may be scalars or per-core sequences; they enter the
+    jitted step as traced arrays, so sweeping T1/T2/detuning never
+    recompiles.
+
+The model evolves *inside* the execution loop (sim/interpreter.py
+``_step`` physics block) because feedback makes it stateful: an active
+reset's conditional X180 must see the post-measurement collapsed state,
+and mid-circuit measurement outcomes condition later rotations.  A
+post-hoc pass over recorded pulses could not close that loop.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+DEVICE_KINDS = ('parity', 'bloch')
+
+
+@dataclass(frozen=True)
+class DeviceModel:
+    """Device-physics parameters for :class:`~.physics.ReadoutPhysics`.
+
+    ``detuning_hz``: qubit-minus-drive-frame frequency offset (Hz) —
+    the Ramsey fringe frequency.  ``t1_s`` / ``t2_s``: relaxation and
+    total transverse-coherence times (seconds; ``inf`` disables).
+    ``depol_per_pulse``: depolarizing contraction applied per drive
+    pulse.  ``clk_period_s``: FPGA clock period used to convert to
+    per-clock rates (reference: python/distproc/hwconfig.py:102, 2 ns).
+    Scalars broadcast over cores; sequences are per-core.
+    """
+    kind: str = 'bloch'
+    detuning_hz: float | tuple = 0.0
+    t1_s: float | tuple = math.inf
+    t2_s: float | tuple = math.inf
+    depol_per_pulse: float = 0.0
+    clk_period_s: float = 2e-9
+
+    def __post_init__(self):
+        if self.kind not in DEVICE_KINDS:
+            raise ValueError(f'unknown device kind {self.kind!r}; '
+                             f'one of {DEVICE_KINDS}')
+
+    def per_clock_rates(self, n_cores: int):
+        """Per-core per-clock rate arrays ``(det_cyc, inv_t1, inv_t2)``:
+        detuning in cycles/clock, decay in 1/clocks (0 = disabled)."""
+        def bc(v):
+            return np.broadcast_to(np.asarray(v, np.float64),
+                                   (n_cores,)).astype(np.float64)
+        det = bc(self.detuning_hz) * self.clk_period_s
+        with np.errstate(divide='ignore'):
+            inv_t1 = np.where(np.isinf(bc(self.t1_s)), 0.0,
+                              self.clk_period_s / bc(self.t1_s))
+            inv_t2 = np.where(np.isinf(bc(self.t2_s)), 0.0,
+                              self.clk_period_s / bc(self.t2_s))
+        return (det.astype(np.float32), inv_t1.astype(np.float32),
+                inv_t2.astype(np.float32))

@@ -48,7 +48,9 @@ import jax
 import jax.numpy as jnp
 
 from .. import isa
+from ..elements import PHASE_BITS
 from ..hwconfig import FPGAConfig
+from .device import DEVICE_KINDS
 from .oracle import (INIT_TIME, QCLK_RST_DELAY, MEAS_LATENCY,
                      STICKY_RACE_MARGIN)
 
@@ -120,6 +122,12 @@ class InterpreterConfig:
     # start *invalid* and are resolved by the DSP chain between epochs;
     # fproc reads whose bit is pending stall the lane until resolve.
     physics: bool = False
+    # which device co-state the physics loop evolves (sim/device.py):
+    # 'parity' — int32 quarter-turn counter, deterministic bit-flip toy;
+    # 'bloch' — SU(2) Bloch vector with phase-sensitive rotations,
+    # detuning/T1/T2 free evolution, and projective measurement.  Static
+    # because it determines carry shapes and the step body.
+    device: str = 'parity'
     drive_elem: int = 0           # element whose pulses rotate the qubit
     x90_amp: int = 0              # amp word of one quarter turn (0 = off)
     alu_instr_clks: int = 5
@@ -175,6 +183,9 @@ def _program_constants(mp, cfg: InterpreterConfig):
 
 def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
                 init_regs=None) -> dict:
+    if cfg.physics and cfg.device not in DEVICE_KINDS:
+        raise ValueError(f'unknown device kind {cfg.device!r}; '
+                         f'one of {DEVICE_KINDS}')
     B, C = batch, n_cores
     T, M, R = cfg.max_steps, cfg.max_meas, cfg.max_resets
     P = cfg.max_pulses
@@ -199,22 +210,26 @@ def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
         meas_avail=jnp.full((B, C, M), INT32_MAX, jnp.int32),
         **({'trace_pc': z(B, C, T), 'trace_time': z(B, C, T)}
            if cfg.trace else {}),
-        # physics mode: classical device co-state (quarter-turn counter)
-        # plus per-measurement pulse-parameter records for the epoch
-        # resolver (sim/physics.py) — the numeric stand-in for the
-        # out-of-repo readout hardware that produces the meas bits
-        # (reference: hdl/fproc_meas.sv meas inputs)
-        **({'qturns': z(B, C), 'meas_state': z(B, C, M),
+        # physics mode: device co-state (sim/device.py — quarter-turn
+        # counter or Bloch vector) plus per-measurement pulse-parameter
+        # records for the epoch resolver (sim/physics.py) — the numeric
+        # stand-in for the out-of-repo readout hardware that produces
+        # the meas bits (reference: hdl/fproc_meas.sv meas inputs)
+        **({'meas_state': z(B, C, M),
             'meas_amp': z(B, C, M), 'meas_phase': z(B, C, M),
             'meas_freq': z(B, C, M), 'meas_env': z(B, C, M),
             'meas_gtime': z(B, C, M),
-            'phys_wait': jnp.zeros((B, C), bool)}
+            'phys_wait': jnp.zeros((B, C), bool),
+            **({'qturns': z(B, C)} if cfg.device == 'parity' else
+               {'bloch': jnp.zeros((B, C, 3), jnp.float32),
+                'phys_t': jnp.full((B, C), INIT_TIME, jnp.int32),
+                'meas_p1': jnp.zeros((B, C, M), jnp.float32)})}
            if cfg.physics else {}),
     )
 
 
 def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
-          meas_valid, cfg: InterpreterConfig) -> dict:
+          meas_valid, cfg: InterpreterConfig, dev=None) -> dict:
     B, C = st['pc'].shape
     N = soa.shape[1]
     time, offset, regs = st['time'], st['offset'], st['regs']
@@ -427,12 +442,16 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         (trig + dur + cfg.meas_latency)[..., None], st['meas_avail'])
     n_meas = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
 
-    # ---- physics co-state: classical qubit rotation + meas records -----
-    # The device model is a classical stand-in (the reference has no
-    # physics at all — hardware supplies the bits): each drive-element
-    # pulse adds round(amp / x90_amp) quarter turns; the state bit is the
-    # half-turn parity, floor convention.  Measurement pulses record
-    # their synthesis parameters for the epoch resolver (sim/physics.py).
+    # ---- physics co-state: device model + meas records -----------------
+    # The device co-state stands in for the real qubits the reference's
+    # gateware drives (the reference models no physics — hardware
+    # supplies the bits).  Two models (sim/device.py): 'parity', a
+    # deterministic quarter-turn counter whose state bit is the
+    # half-turn parity (floor convention for odd residues); 'bloch', an
+    # SU(2) Bloch vector with phase-word rotation axes, detuning/T1/T2
+    # free evolution, per-pulse depolarization, and projective
+    # measurement sampling.  Measurement pulses record their synthesis
+    # parameters for the epoch resolver (sim/physics.py).
     phys_updates = {}
     cw_meas_err = 0
     if cfg.physics:
@@ -440,16 +459,73 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         # demodulate — flag it loudly instead of yielding silent 0 bits
         cw_meas_err = jnp.where(is_meas_pulse & (env_len == 0xfff),
                                 ERR_CW_MEAS, 0)
-        qturns = st['qturns']
-        if cfg.x90_amp > 0:
-            x90 = jnp.int32(cfg.x90_amp)
-            dq = (2 * pp[..., 3] + x90) // (2 * x90)
-            is_drive = fire & (elem == cfg.drive_elem)
-            qturns = qturns + jnp.where(is_drive, dq, 0)
-        state_bit = (qturns >> 1) & 1
         mwr = (oh_mslot == 1) & is_meas_pulse[..., None]
-        phys_updates = dict(
-            qturns=qturns,
+        if cfg.device == 'parity':
+            qturns = st['qturns']
+            if cfg.x90_amp > 0:
+                x90 = jnp.int32(cfg.x90_amp)
+                dq = (2 * pp[..., 3] + x90) // (2 * x90)
+                is_drive = fire & (elem == cfg.drive_elem)
+                qturns = qturns + jnp.where(is_drive, dq, 0)
+            state_bit = (qturns >> 1) & 1
+            phys_updates = dict(qturns=qturns)
+        else:  # 'bloch'
+            if dev is None:
+                raise ValueError(
+                    "device='bloch' needs device-model parameter arrays; "
+                    "run it via sim.physics.run_physics_batch (the "
+                    "injected-bits simulate/simulate_batch path has no "
+                    "device co-state to evolve)")
+            det_cyc, inv_t1, inv_t2, depol, meas_u = dev
+            r = st['bloch']
+            x, y, z = r[..., 0], r[..., 1], r[..., 2]
+            is_drive = fire & (elem == cfg.drive_elem)
+            touch = is_drive | is_meas_pulse
+            # free evolution over the gap since this lane's previous
+            # drive/readout pulse: detuning precession about z, T2 on
+            # the transverse components, T1 relaxation toward |0> (+z)
+            dt = (trig - st['phys_t']).astype(jnp.float32)
+            alpha = (2 * np.pi) * det_cyc[None, :] * dt
+            ca, sa = jnp.cos(alpha), jnp.sin(alpha)
+            e2 = jnp.exp(-dt * inv_t2[None, :])
+            e1 = jnp.exp(-dt * inv_t1[None, :])
+            xf = e2 * (x * ca - y * sa)
+            yf = e2 * (x * sa + y * ca)
+            zf = 1.0 + (z - 1.0) * e1
+            # drive rotation: Rodrigues about the equatorial axis
+            # n = (cos phi, sin phi, 0) by theta = (pi/2) * amp / x90
+            # (U = exp(-i theta/2 n.sigma), right-handed on the Bloch
+            # sphere — the models/rb.py X90 at phi = 0); then the
+            # per-pulse depolarizing contraction
+            phi = (2 * np.pi / (1 << PHASE_BITS)) \
+                * pp[..., 1].astype(jnp.float32)
+            theta = ((np.pi / 2) / cfg.x90_amp if cfg.x90_amp > 0 else 0.0) \
+                * pp[..., 3].astype(jnp.float32)
+            nx, ny = jnp.cos(phi), jnp.sin(phi)
+            cth, sth = jnp.cos(theta), jnp.sin(theta)
+            ndot = nx * xf + ny * yf
+            k1 = 1.0 - cth
+            keep = jnp.float32(1.0) - depol
+            rx = keep * (xf * cth + ny * zf * sth + nx * ndot * k1)
+            ry = keep * (yf * cth - nx * zf * sth + ny * ndot * k1)
+            rz = keep * (zf * cth + (nx * yf - ny * xf) * sth)
+            # projective measurement: sample the evolved (pre-readout)
+            # state with this slot's pre-drawn uniform, collapse to the
+            # outcome pole; record P(1) for expectation-value readout
+            p1 = jnp.clip((1.0 - zf) * 0.5, 0.0, 1.0)
+            u_sel = jnp.sum(meas_u * oh_mslot.astype(jnp.float32), axis=-1)
+            state_bit = (u_sel < p1).astype(jnp.int32) \
+                * is_meas_pulse.astype(jnp.int32)
+            zc = 1.0 - 2.0 * state_bit.astype(jnp.float32)
+            x1 = jnp.where(is_meas_pulse, 0.0, jnp.where(is_drive, rx, x))
+            y1 = jnp.where(is_meas_pulse, 0.0, jnp.where(is_drive, ry, y))
+            z1 = jnp.where(is_meas_pulse, zc, jnp.where(is_drive, rz, z))
+            phys_updates = dict(
+                bloch=jnp.stack([x1, y1, z1], axis=-1),
+                phys_t=jnp.where(touch, trig, st['phys_t']),
+                meas_p1=jnp.where(mwr, p1[..., None], st['meas_p1']),
+            )
+        phys_updates.update(
             meas_state=jnp.where(mwr, state_bit[..., None],
                                  st['meas_state']),
             meas_amp=jnp.where(mwr, pp[..., 3:4], st['meas_amp']),
@@ -545,12 +621,15 @@ def _split_records(rec) -> dict:
 
 
 def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
-               cfg: InterpreterConfig) -> dict:
+               cfg: InterpreterConfig, dev=None) -> dict:
     """Run the instruction while_loop until every shot is done (or, in
     physics mode, paused waiting for a measurement bit to be resolved).
 
     ``st0`` must carry ``_steps`` (total step budget, shared across
-    physics epochs) and, in physics mode, ``paused`` [B] bool.
+    physics epochs) and, in physics mode, ``paused`` [B] bool.  ``dev``:
+    device-model parameter arrays for ``device='bloch'``
+    (``(det_cyc[C], inv_t1[C], inv_t2[C], depol, meas_u[B,C,M])``) —
+    step-body closure constants, not loop-carried.
     """
     def cond(st):
         settled = jnp.all(st['done'], axis=-1)
@@ -562,7 +641,7 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
         steps = st.pop('_steps')
         paused = st.pop('paused') if cfg.physics else None
         st2 = _step(st, steps, soa, spc, interp, sync_part, meas_bits,
-                    meas_valid, cfg)
+                    meas_valid, cfg, dev)
         # quiescence detection per shot: no live core changed state
         same = jnp.all((st2['pc'] == st['pc']) & (st2['time'] == st['time'])
                        & (st2['done'] == st['done']), axis=-1)   # [B]

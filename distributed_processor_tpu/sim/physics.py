@@ -62,6 +62,7 @@ import jax.numpy as jnp
 from ..elements import ENV_CW_SENTINEL, IQ_SCALE
 from ..ops.waveform import (PHASE_BITS, AMP_SCALE, complex_to_iq,
                             carrier_phase)
+from .device import DeviceModel
 from .interpreter import (InterpreterConfig, _program_constants, _init_state,
                           _exec_loop, _finalize, _check_fabric)
 
@@ -82,7 +83,11 @@ class ReadoutPhysics:
     thermal excited-state probability at t=0.  ``x90_amp``: drive amp
     word equal to one quarter turn of the classical rotation model.
     ``window_samples``: static readout-window length (None = sized from
-    the program's envelope tables).
+    the program's envelope tables).  ``device``: the qubit co-state
+    model the loop evolves (sim/device.py) — the default 'parity'
+    counter is the deterministic bit-flip toy; ``DeviceModel('bloch')``
+    gives phase-sensitive SU(2) rotations with detuning/T1/T2, making
+    Ramsey/T2-echo/Rabi/RB sweeps physically meaningful end-to-end.
     """
     g0: complex = 1.0 + 0.0j
     g1: complex = -0.6 + 0.8j
@@ -92,6 +97,7 @@ class ReadoutPhysics:
     drive_elem: int = 0
     meas_elem: int = 2
     window_samples: int = None
+    device: DeviceModel = DeviceModel(kind='parity')
     # samples per resolve step: the matched filter streams over the
     # window in chunks of this size (lax.scan), so peak memory is
     # O(B*C*M*chunk) instead of O(B*C*M*W) — million-shot batches with
@@ -526,16 +532,24 @@ def _resolve_analytic(st: dict, bits, valid, key, tables, env_pads,
 @functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'W',
                                              'max_epochs', 'chunk',
                                              'spcs', 'interps', 'mode'))
-def _run_physics_jit(soa, spc, interp, sync_part, qturns0, init_regs,
+def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      env_stack, freq_stack, g0, g1, sigma,
-                     key, cfg: InterpreterConfig, n_cores: int, W: int,
+                     key, dev_params, meas_u,
+                     cfg: InterpreterConfig, n_cores: int, W: int,
                      max_epochs: int, chunk: int = None,
                      spcs: tuple = (), interps: tuple = (),
                      mode: str = 'persample') -> dict:
-    B = qturns0.shape[0]
+    B = init_states.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
-    st0['qturns'] = qturns0
+    if cfg.device == 'parity':
+        st0['qturns'] = 2 * init_states
+        dev = None
+    else:
+        zf = jnp.zeros((B, C), jnp.float32)
+        st0['bloch'] = jnp.stack(
+            [zf, zf, 1.0 - 2.0 * init_states.astype(jnp.float32)], axis=-1)
+        dev = dev_params + (meas_u,)
     st0['_steps'] = jnp.int32(0)
     st0['paused'] = jnp.zeros((B,), bool)
     bits0 = jnp.zeros((B, C, M), jnp.int32)
@@ -573,7 +587,8 @@ def _run_physics_jit(soa, spc, interp, sync_part, qturns0, init_regs,
 
     def body(carry):
         st, bits, valid, ep = carry
-        st = _exec_loop(st, soa, spc, interp, sync_part, bits, valid, cfg)
+        st = _exec_loop(st, soa, spc, interp, sync_part, bits, valid, cfg,
+                        dev)
         if mode == 'analytic':
             bits, valid = _resolve_analytic(st, bits, valid, key, tables,
                                             env_pads, response, W)
@@ -620,7 +635,15 @@ def physics_config(base: InterpreterConfig, model: ReadoutPhysics,
                 f'conflicting {name}: interpreter config has {bv}, '
                 f'ReadoutPhysics has {mv}; set it on the model')
         overrides[name] = mv
-    return replace(base, physics=True, **overrides, **kw)
+    if 'device' in kw:
+        raise ValueError('the device model is set via '
+                         'ReadoutPhysics.device, not the interpreter config')
+    if base.device != defaults.device and base.device != model.device.kind:
+        raise ValueError(
+            f'conflicting device: interpreter config has {base.device!r}, '
+            f'ReadoutPhysics.device has {model.device.kind!r}')
+    return replace(base, physics=True, device=model.device.kind,
+                   **overrides, **kw)
 
 
 def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
@@ -637,8 +660,12 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
 
     Returns the interpreter's final state plus ``meas_bits`` /
     ``meas_bits_valid`` (the resolved bits per measurement slot),
-    ``qturns``/``meas_state`` (classical device trajectory), and
-    ``epochs`` (resolve rounds taken).
+    ``meas_state`` (the device bit each readout sampled), and ``epochs``
+    (resolve rounds taken).  The device trajectory depends on
+    ``model.device.kind``: parity mode returns ``qturns`` (the final
+    quarter-turn counter); bloch mode returns ``bloch`` (final ``[B, C,
+    3]`` Bloch vectors), ``meas_p1`` (pre-projection P(1) per slot — the
+    noise-free expectation value), and ``phys_t`` (last evolution time).
     """
     cfg = physics_config(cfg, model, **kw)
     _check_fabric(cfg, mp.n_cores)
@@ -654,9 +681,21 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         p1 = jnp.broadcast_to(jnp.asarray(model.p1_init, jnp.float32), (C,))
         init_states = jax.random.bernoulli(
             key_init, p1[None, :], (shots, C)).astype(jnp.int32)
-    qturns0 = 2 * jnp.asarray(init_states, jnp.int32)
+    init_states = jnp.asarray(init_states, jnp.int32)
     if init_regs is not None:
         init_regs = jnp.asarray(init_regs, jnp.int32)
+    if model.device.kind == 'bloch':
+        # projective-measurement uniforms, one per (shot, core, slot) —
+        # drawn from a stream independent of the resolve noise (fold_in
+        # of the parent key) so existing parity-mode draws are unchanged
+        det, it1, it2 = model.device.per_clock_rates(C)
+        dev_params = (jnp.asarray(det), jnp.asarray(it1), jnp.asarray(it2),
+                      jnp.float32(model.device.depol_per_pulse))
+        meas_u = jax.random.uniform(
+            jax.random.fold_in(key, 0x424c4f43),
+            (shots, C, cfg.max_meas), jnp.float32)
+    else:
+        dev_params, meas_u = None, None
 
     def as_iq(g):
         g = np.broadcast_to(np.asarray(g, complex), (C,))
@@ -669,9 +708,9 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     if model.resolve_mode not in ('persample', 'fused', 'analytic'):
         raise ValueError(f'unknown resolve_mode {model.resolve_mode!r}')
     return _run_physics_jit(
-        soa, spc, interp, sync_part, qturns0, init_regs, env_stack,
+        soa, spc, interp, sync_part, init_states, init_regs, env_stack,
         freq_stack, as_iq(model.g0), as_iq(model.g1),
-        jnp.float32(model.sigma), key_noise, cfg, C, W,
+        jnp.float32(model.sigma), key_noise, dev_params, meas_u, cfg, C, W,
         C * cfg.max_meas + 1, model.resolve_chunk,
         tuple(int(x) for x in np.asarray(spc_m)),
         tuple(int(x) for x in np.asarray(interp_m)),
