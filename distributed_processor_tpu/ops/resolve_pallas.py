@@ -52,11 +52,11 @@ except ImportError:      # pragma: no cover - pallas ships with jax
 
 
 def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
-            fidx_ref, addr_ref, nsamp_ref, s0_ref,
+            fidx_ref, addr_ref, nsamp_ref, s0_ref, ring_ref,
             t_ref, bas_ref, nz_ref,
             acc_i_in, acc_q_in, energy_in,
             acc_i_ref, acc_q_ref, energy_ref,
-            *, tb: int, ck: int, n_f: int):
+            *, tb: int, ck: int, n_f: int, ring: bool):
     # ---- envelope: one-hot(addr) @ Toeplitz on the MXU -----------------
     r_rows = t_ref.shape[2]
     addr = addr_ref[0, 0, :]                                  # [TB] int32
@@ -96,10 +96,20 @@ def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
     y_q = in_win * amp * (e_i * sth + e_q * cth)
 
     # ---- channel response + streamed ADC noise + matched filter -------
+    # resonator ring-up w(s) = 1 - exp(-(s+1)/ring_tau) scales the
+    # signal path only (same contract as physics._resolve); ring_ref
+    # holds 1/ring_tau in SMEM.  `ring` is static: the flat model
+    # compiles the factor out, and when active, w is one [1, ck] row
+    # (s is constant along the shot axis) broadcast into the products
     gs_i = gsi_ref[0, 0, :][:, None]
     gs_q = gsq_ref[0, 0, :][:, None]
-    r_i = gs_i * y_i - gs_q * y_q + nz_ref[0, 0]
-    r_q = gs_i * y_q + gs_q * y_i + nz_ref[1, 0]
+    if ring:
+        s_row = s0_ref[0] + jax.lax.broadcasted_iota(jnp.int32, (1, ck), 1)
+        w = 1.0 - jnp.exp(-(s_row + 1).astype(jnp.float32) * ring_ref[0])
+    else:
+        w = jnp.float32(1.0)
+    r_i = w * (gs_i * y_i - gs_q * y_q) + nz_ref[0, 0]
+    r_q = w * (gs_i * y_q + gs_q * y_i) + nz_ref[1, 0]
     acc_i_ref[0, 0, :] = acc_i_in[0, 0, :] + jnp.sum(r_i * y_i + r_q * y_q,
                                                      axis=1)
     acc_q_ref[0, 0, :] = acc_q_in[0, 0, :] + jnp.sum(r_q * y_i - r_i * y_q,
@@ -109,9 +119,10 @@ def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=('tb', 'ck', 'w_pad', 'interpret'))
+    jax.jit, static_argnames=('tb', 'ck', 'w_pad', 'ring', 'interpret'))
 def _resolve_call(amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
-                  key, sigma, t_dac, basis, tb, ck, w_pad, interpret):
+                  key, sigma, inv_ring, t_dac, basis, tb, ck, w_pad,
+                  ring, interpret):
     C, _, B = amp.shape
     n_chunks = w_pad // ck
     R = t_dac.shape[2]
@@ -123,9 +134,10 @@ def _resolve_call(amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
         interpret = pltpu.InterpretParams()
     lane_spec = pl.BlockSpec((1, 1, tb), lambda c, t: (c, 0, t))
     call = pl.pallas_call(
-        functools.partial(_kernel, tb=tb, ck=ck, n_f=F),
+        functools.partial(_kernel, tb=tb, ck=ck, n_f=F, ring=ring),
         grid=(C, B // tb),
         in_specs=[lane_spec] * 8 + [
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 2, R, ck), lambda c, t: (c, 0, 0, 0)),
             pl.BlockSpec((1, 2, F, ck), lambda c, t: (c, 0, 0, 0)),
@@ -145,7 +157,8 @@ def _resolve_call(amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
             jax.random.fold_in(key, k), (2, C, B, ck), jnp.float32)
         acc_i, acc_q, energy = call(
             amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
-            s0.reshape((1,)), t_k, b_k, nz, acc_i, acc_q, energy)
+            s0.reshape((1,)), inv_ring.reshape((1,)), t_k, b_k, nz,
+            acc_i, acc_q, energy)
         return (acc_i, acc_q, energy), None
 
     zeros = jnp.zeros((C, 1, B), jnp.float32)
@@ -200,9 +213,9 @@ def build_fused_tables(env_pads, basis, W: int, interps, ck: int):
 
 
 def resolve_windows_fused(sc: dict, fused_tables, gs_i, gs_q,
-                          sigma, key, W: int, Lp: int,
+                          sigma, inv_ring, key, W: int, Lp: int,
                           *, tb: int = 512, ck: int = 256,
-                          interpret: bool = False):
+                          ring: bool = False, interpret: bool = False):
     """Matched-filter accumulators for one compacted window per (B, C).
 
     ``sc``: per-window scalars shaped ``[B, C, 1]`` (the compacted form
@@ -236,9 +249,10 @@ def resolve_windows_fused(sc: dict, fused_tables, gs_i, gs_q,
     gsq = jnp.pad(jnp.transpose(gs_q, (1, 0))[:, None, :],
                   ((0, 0), (0, 0), (0, b_pad - B)))
     sigma = jnp.asarray(sigma, jnp.float32)
+    inv_ring = jnp.asarray(inv_ring, jnp.float32)
 
     acc_i, acc_q, energy = _resolve_call(
         amp, cosa, sina, gsi, gsq, f_idx, addr, nsamp, key, sigma,
-        t_dac, bas, tb, ck, w_pad, interpret)
+        inv_ring, t_dac, bas, tb, ck, w_pad, ring, interpret)
     back = lambda a: jnp.transpose(a[:, 0, :B], (1, 0))[..., None]
     return back(acc_i), back(acc_q), back(energy)

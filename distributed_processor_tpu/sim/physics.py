@@ -98,6 +98,18 @@ class ReadoutPhysics:
     meas_elem: int = 2
     window_samples: int = None
     device: DeviceModel = DeviceModel(kind='parity')
+    # resonator ring-up time constant in DAC samples of the measurement
+    # element (0 = instantaneous response).  With ring_tau > 0 the
+    # state-dependent transmission builds up over the window as
+    # ``g_s * (1 - exp(-(s+1)/ring_tau))`` — the transient of a driven
+    # resonator with linewidth kappa = 2/(ring_tau * t_sample) — so
+    # early samples carry less discrimination information than their
+    # energy suggests.  This is the channel structure a flat-response
+    # matched-filter shortcut cannot collapse: 'persample' and 'fused'
+    # simulate it sample-by-sample; 'analytic' remains the EXACT
+    # distribution only for ring_tau == 0 and becomes a flat-response
+    # approximation otherwise (docs/PHYSICS.md "Readout channel").
+    ring_tau: float = 0.0
     # samples per resolve step: the matched filter streams over the
     # window in chunks of this size (lax.scan), so peak memory is
     # O(B*C*M*chunk) instead of O(B*C*M*W) — million-shot batches with
@@ -355,7 +367,8 @@ def _scatter_slot_bit(bits, valid, new_bit, oh_slot, has_pending):
 
 
 def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
-             W: int, chunk: int = None, interps=None, prebuilt=None):
+             W: int, chunk: int = None, interps=None, prebuilt=None,
+             ring: bool = False):
     """Demodulate pending readout windows into bits — one slot per
     (shot, core) per call.  ``prebuilt``: optional ``(toeplitz, basis)``
     built once by the caller — pass it when calling from inside a loop
@@ -382,7 +395,7 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
     samples (synthesis + channel response + ADC noise + matched-filter
     accumulation per chunk), so peak memory is independent of W.
     """
-    g0, g1, sigma = response                  # [C,2], [C,2], scalar
+    g0, g1, sigma, inv_ring = response        # [C,2], [C,2], scalars
     B, C, M = bits.shape
     if interps is None:
         interps = tuple(int(x) for x in np.asarray(tables[3]))
@@ -416,8 +429,19 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
         # of 2 would tile-pad 64x on TPU (8,128) lanes and blow HBM)
         nz = sigma * jax.random.normal(
             jax.random.fold_in(key, k), (2, B, C, 1, chunk), jnp.float32)
-        r_i = gs_i[..., None] * y_i - gs_q[..., None] * y_q + nz[0]
-        r_q = gs_i[..., None] * y_q + gs_q[..., None] * y_i + nz[1]
+        # resonator ring-up: the state-dependent transmission builds as
+        # w(s) = 1 - exp(-(s+1)/ring_tau) over the window (the template
+        # y and the ADC noise are NOT scaled — only the signal path).
+        # `ring` is static: the flat model compiles the factor out
+        # entirely, and when active, w is a [chunk] row broadcast
+        if ring:
+            s_rel = (k * chunk + jnp.arange(chunk, dtype=jnp.int32)
+                     + 1).astype(jnp.float32)
+            w = 1.0 - jnp.exp(-s_rel * inv_ring)
+        else:
+            w = jnp.float32(1.0)
+        r_i = w * (gs_i[..., None] * y_i - gs_q[..., None] * y_q) + nz[0]
+        r_q = w * (gs_i[..., None] * y_q + gs_q[..., None] * y_i) + nz[1]
         # matched filter: acc = sum conj(y) * r
         acc_i = acc_i + jnp.sum(r_i * y_i + r_q * y_q, axis=-1)  # [B,C,1]
         acc_q = acc_q + jnp.sum(r_q * y_i - r_i * y_q, axis=-1)
@@ -433,7 +457,7 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
 
 
 def _resolve_fused(st: dict, bits, valid, key, tables, fused_tables,
-                   response, W: int, Lp: int, ck: int):
+                   response, W: int, Lp: int, ck: int, ring: bool = False):
     """Slot-compacted resolve through the fused Pallas kernel
     (:func:`..ops.resolve_pallas.resolve_windows_fused`): same
     per-sample chain as :func:`_resolve` with every intermediate in
@@ -443,15 +467,16 @@ def _resolve_fused(st: dict, bits, valid, key, tables, fused_tables,
     run, NOT per epoch.
     """
     from ..ops.resolve_pallas import resolve_windows_fused
-    g0, g1, sigma = response
+    g0, g1, sigma, inv_ring = response
     sc, state_sel, oh_slot, has_pending = \
         _compact_pending_slot(st, valid, tables)
     state_sel = state_sel[..., 0]                             # [B, C]
     gs = jnp.where(state_sel[..., None] == 1,
                    g1[None, :, :], g0[None, :, :])            # [B, C, 2]
     acc_i, acc_q, energy = resolve_windows_fused(
-        sc, fused_tables, gs[..., 0], gs[..., 1], sigma, key, W, Lp,
-        ck=ck, interpret=jax.default_backend() != 'tpu')
+        sc, fused_tables, gs[..., 0], gs[..., 1], sigma, inv_ring, key,
+        W, Lp, ck=ck, ring=ring,
+        interpret=jax.default_backend() != 'tpu')
     new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)[..., 0]
     return _scatter_slot_bit(bits, valid, new_bit, oh_slot, has_pending)
 
@@ -484,10 +509,14 @@ def _resolve_analytic(st: dict, bits, valid, key, tables, env_pads,
     (shot, core, slot) given the run key.
 
     Use when the channel model is exactly state-scaled response plus
-    white noise (ReadoutPhysics today); per-sample mode is the general
-    path for structured models.
+    white noise; per-sample mode is the general path for structured
+    models.  With ``ring_tau > 0`` this shortcut is a *flat-response
+    approximation*: it ignores the resonator ring-up transient (the
+    ``inv_ring`` element of ``response``), so its assignment fidelity is
+    optimistic at short windows — tests/test_ringdown.py measures the
+    divergence, and :func:`run_physics_batch` warns on this combination.
     """
-    g0, g1, sigma = response
+    g0, g1, sigma, _inv_ring_unmodeled = response
     B, C, M = bits.shape
     fired = jnp.arange(M)[None, None, :] < st['n_meas'][..., None]
     pending = fired & ~valid
@@ -531,14 +560,15 @@ def _resolve_analytic(st: dict, bits, valid, key, tables, env_pads,
 
 @functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'W',
                                              'max_epochs', 'chunk',
-                                             'spcs', 'interps', 'mode'))
+                                             'spcs', 'interps', 'mode',
+                                             'ring'))
 def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
-                     env_stack, freq_stack, g0, g1, sigma,
+                     env_stack, freq_stack, g0, g1, sigma, inv_ring,
                      key, dev_params, meas_u,
                      cfg: InterpreterConfig, n_cores: int, W: int,
                      max_epochs: int, chunk: int = None,
                      spcs: tuple = (), interps: tuple = (),
-                     mode: str = 'persample') -> dict:
+                     mode: str = 'persample', ring: bool = False) -> dict:
     B = init_states.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
@@ -557,7 +587,7 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
     tables = (env_stack, freq_stack,
               jnp.asarray(spcs, jnp.int32), jnp.asarray(interps, jnp.int32))
     env_pads = _pad_env_planes(env_stack, _aligned_chunk(chunk, W, interps))
-    response = (g0, g1, sigma)
+    response = (g0, g1, sigma, inv_ring)
     if mode == 'fused':
         # kernel constants built once, outside the epoch while_loop
         from ..ops.resolve_pallas import build_fused_tables, fused_chunk
@@ -594,11 +624,11 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                                             env_pads, response, W)
         elif mode == 'fused':
             bits, valid = _resolve_fused(st, bits, valid, jax.random.fold_in(
-                key, ep), tables, fused_tables, response, W, lp, ck)
+                key, ep), tables, fused_tables, response, W, lp, ck, ring)
         else:
             bits, valid = _resolve(st, bits, valid, jax.random.fold_in(
                 key, ep), tables, env_pads, response, W, chunk, interps,
-                prebuilt)
+                prebuilt, ring)
         st = dict(st, paused=jnp.zeros_like(st['paused']))
         return st, bits, valid, ep + 1
 
@@ -707,11 +737,21 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     # worst case (the loop exits early once every shot is done)
     if model.resolve_mode not in ('persample', 'fused', 'analytic'):
         raise ValueError(f'unknown resolve_mode {model.resolve_mode!r}')
+    if model.ring_tau > 0 and model.resolve_mode == 'analytic':
+        import warnings
+        warnings.warn(
+            "resolve_mode='analytic' ignores the resonator ring-up "
+            '(ring_tau > 0): bits follow the flat-response model, which '
+            'is optimistic at short windows — use persample/fused for '
+            'the structured channel', stacklevel=2)
+    inv_ring = jnp.float32(0.0 if model.ring_tau <= 0
+                           else 1.0 / model.ring_tau)
     return _run_physics_jit(
         soa, spc, interp, sync_part, init_states, init_regs, env_stack,
         freq_stack, as_iq(model.g0), as_iq(model.g1),
-        jnp.float32(model.sigma), key_noise, dev_params, meas_u, cfg, C, W,
+        jnp.float32(model.sigma), inv_ring, key_noise, dev_params, meas_u,
+        cfg, C, W,
         C * cfg.max_meas + 1, model.resolve_chunk,
         tuple(int(x) for x in np.asarray(spc_m)),
         tuple(int(x) for x in np.asarray(interp_m)),
-        model.resolve_mode)
+        model.resolve_mode, model.ring_tau > 0)

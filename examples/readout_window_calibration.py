@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Readout-window calibration against the resonator ring-up transient.
+
+With `ReadoutPhysics.ring_tau > 0` the state-dependent transmission
+builds up as `1 - exp(-(s+1)/ring_tau)` over the window, so early
+samples carry less discrimination information than their energy
+suggests.  This example runs the physics-closed loop at a sweep of
+integration-window lengths and prints the assignment-fidelity curve —
+the measurement a lab runs to pick its readout window — in the
+per-sample mode (which simulates the transient) next to the analytic
+flat-response shortcut (which is optimistic at short windows: the gap
+IS the modeling power that justifies the per-sample path).
+
+    JAX_PLATFORMS=cpu python examples/readout_window_calibration.py
+"""
+
+import os
+import sys
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# honor JAX_PLATFORMS even where site config pre-selects a backend
+if os.environ.get('JAX_PLATFORMS'):
+    import jax
+    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+import numpy as np
+
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                   run_physics_batch)
+
+SHOTS = int(os.environ.get('SHOTS', 2048))
+RING_TAU = 256.0      # DAC samples; resonator linewidth proxy
+SIGMA = 4.0
+WINDOWS = (64, 128, 256, 512, 1024, 2048)
+
+
+def fidelity(mp, window, mode):
+    model = ReadoutPhysics(sigma=SIGMA, ring_tau=RING_TAU,
+                           window_samples=window, resolve_mode=mode)
+    init = (np.arange(SHOTS) % 2).astype(np.int32).reshape(SHOTS, 1)
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')   # analytic+ring warns by design
+        out = run_physics_batch(mp, model, 11, SHOTS, init_states=init,
+                                max_steps=200, max_pulses=16, max_meas=4)
+    bits = np.asarray(out['meas_bits'])[:, 0, 0]
+    return float(np.mean(bits == init[:, 0]))
+
+
+def main():
+    sim = Simulator(n_qubits=1)
+    mp = sim.compile([{'name': 'read', 'qubit': ['Q0']}])
+    print(f'ring_tau = {RING_TAU:.0f} samples, sigma = {SIGMA}, '
+          f'{SHOTS} shots')
+    print(f'{"window":>8} {"F (per-sample)":>15} {"F (flat analytic)":>18}')
+    best = None
+    for w in WINDOWS:
+        f_ps = fidelity(mp, w, 'persample')
+        f_an = fidelity(mp, w, 'analytic')
+        print(f'{w:>8} {f_ps:>15.4f} {f_an:>18.4f}')
+        if best is None or f_ps > best[1]:
+            best = (w, f_ps)
+    print(f'\nshortest window at peak per-sample fidelity: {best[0]} '
+          f'samples (F = {best[1]:.4f})')
+    print('the flat-response shortcut overestimates fidelity at short '
+          'windows — the transient is what the per-sample path models')
+
+
+if __name__ == '__main__':
+    main()
