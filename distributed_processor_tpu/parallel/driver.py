@@ -24,11 +24,36 @@ from ..utils.results import SweepAccumulator
 from .sweep import physics_batch_stats
 
 
+FINGERPRINT_VERSION = 2
+
+
+def _jsonable(v):
+    """Dataclass/complex/tuple values as stable JSON-able structures —
+    field-by-field, so the fingerprint survives cosmetic repr changes
+    (float formatting, dataclass field reordering) and mismatches can
+    be reported per field."""
+    import dataclasses
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return {f.name: _jsonable(getattr(v, f.name))
+                for f in dataclasses.fields(v)}
+    if isinstance(v, complex):
+        return [v.real, v.imag]
+    if isinstance(v, (np.ndarray, jax.Array)):
+        return _jsonable(np.asarray(v).tolist())   # complex dtypes recurse
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (np.integer, np.floating, np.bool_)):
+        return v.item()
+    return v
+
+
 def _sweep_fingerprint(mp, model, batch: int, key, cfg,
                        init_regs, n_dp: int = 0) -> dict:
     """Identity of a sweep for checkpoint validation: resuming with a
     different program, model, config, registers, batch size, or key
-    must fail loudly, not silently mix incompatible accumulations."""
+    must fail loudly, not silently mix incompatible accumulations.
+    Versioned (``fingerprint_version``), with the model/config stored
+    as structured field dicts rather than repr strings."""
     import dataclasses
     crc = 0
     for f in dataclasses.fields(mp.soa):          # every operand plane
@@ -43,11 +68,12 @@ def _sweep_fingerprint(mp, model, batch: int, key, cfg,
     regs_crc = 0 if init_regs is None else zlib.crc32(
         np.ascontiguousarray(np.asarray(init_regs)).tobytes())
     return {
+        'fingerprint_version': FINGERPRINT_VERSION,
         'batch': int(batch),
         'key': np.asarray(jax.random.key_data(key)).tolist(),
         'program_crc': int(crc),
-        'model': repr(model),
-        'cfg': repr(cfg),
+        'model': _jsonable(model),
+        'cfg': _jsonable(cfg),
         'init_regs_crc': int(regs_crc),
         # the dp extent changes the per-shard key folding, hence the
         # noise stream — a mesh checkpoint is not a single-device one
@@ -148,10 +174,21 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
         acc.save()
 
     shots_done = acc.n_batches * batch
+    incomplete = int(acc.state['incomplete'])
+    if incomplete:
+        # shots that hit the step budget contribute partial counts to
+        # the sums, so the means below are diluted — say so loudly
+        # rather than letting the counter go unnoticed
+        import warnings
+        warnings.warn(
+            f'{incomplete}/{acc.n_batches} batches contain shots that '
+            f'did not finish (step budget); mean_pulses/meas1_rate '
+            f'include their partial counts — raise max_steps or treat '
+            f'the means as lower bounds', stacklevel=2)
     return {
         'shots': shots_done,
         'mean_pulses': acc.state['pulse_sum'] / shots_done,
         'meas1_rate': acc.state['meas1_sum'] / shots_done,
         'err_shots': int(acc.state['err_shots']),
-        'incomplete_batches': int(acc.state['incomplete']),
+        'incomplete_batches': incomplete,
     }

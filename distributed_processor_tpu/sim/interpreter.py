@@ -208,7 +208,8 @@ def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
         n_resets=z(B, C), rst_time=z(B, C, R),
         n_meas=z(B, C),
         meas_avail=jnp.full((B, C, M), INT32_MAX, jnp.int32),
-        **({'trace_pc': z(B, C, T), 'trace_time': z(B, C, T)}
+        **({'trace_pc': z(B, C, T), 'trace_time': z(B, C, T),
+            'trace_off': z(B, C, T)}
            if cfg.trace else {}),
         # physics mode: device co-state (sim/device.py — quarter-turn
         # counter or Bloch vector) plus per-measurement pulse-parameter
@@ -604,6 +605,11 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             st['trace_pc'], st['pc'][:, :, None], (0, 0, step_i))
         tr['trace_time'] = jax.lax.dynamic_update_slice(
             st['trace_time'], time[:, :, None], (0, 0, step_i))
+        # per-step qclk origin: lets the VCD export render qclk exactly
+        # at every timestamp (sync/inc_qclk changes take effect at their
+        # step instead of ramping retroactively)
+        tr['trace_off'] = jax.lax.dynamic_update_slice(
+            st['trace_off'], offset[:, :, None], (0, 0, step_i))
 
     return dict(st, pc=pc_next, regs=regs, time=time_next, offset=offset_next,
                 done=st['done'] | is_done, err=err, pp=pp, n_pulses=n_pulses,

@@ -76,15 +76,59 @@ class SweepAccumulator:
     def resume(cls, path: str, checkpoint_every: int = 0,
                meta: dict = None) -> 'SweepAccumulator':
         """Load the checkpoint at ``path`` (fresh accumulator if absent).
+
         With ``meta`` given, a checkpoint whose stored identity differs
-        raises instead of silently mixing incompatible accumulations."""
+        raises — field by field, naming exactly what diverged — instead
+        of silently mixing incompatible accumulations.  A checkpoint
+        with *no* stored identity (written before fingerprinting, or by
+        an older fingerprint version) is treated as legacy: accepted
+        with a warning rather than rejected, since there is nothing to
+        compare against.
+        """
         acc = cls(path, checkpoint_every, meta=meta)
         if os.path.exists(path):
             arrays, stored = load_results(path)
             acc.state = dict(arrays)
             acc.n_batches = int(stored.pop('n_batches', 0))
-            if meta is not None and stored != acc.meta:
-                raise ValueError(
-                    f'checkpoint {path} was written by a different sweep: '
-                    f'stored {stored} != requested {acc.meta}')
+            if meta is not None:
+                import warnings
+                want_ver = acc.meta.get('fingerprint_version')
+                have_ver = stored.get('fingerprint_version')
+                if not stored:
+                    warnings.warn(
+                        f'checkpoint {path} carries no identity — '
+                        f'resuming without validation', stacklevel=2)
+                    diff = []
+                elif have_ver != want_ver:
+                    # version skew: still validate the overlap whose
+                    # representation is format-stable (same JSON type in
+                    # both versions — batch/key/crcs survive any version;
+                    # a field whose format changed, e.g. repr-string ->
+                    # dict, is skipped with a warning, not failed)
+                    shared = (set(stored) & set(acc.meta)) \
+                        - {'fingerprint_version'}
+                    comparable = {k for k in shared
+                                  if type(stored[k]) is type(acc.meta[k])}
+                    skipped = sorted((set(stored) ^ set(acc.meta)
+                                      | (shared - comparable))
+                                     - {'fingerprint_version'})
+                    warnings.warn(
+                        f'checkpoint {path} has fingerprint version '
+                        f'{have_ver} (current {want_ver}); fields '
+                        f'{skipped or "(none)"} not validated',
+                        stacklevel=2)
+                    diff = [k for k in sorted(comparable)
+                            if stored[k] != acc.meta[k]]
+                else:
+                    diff = sorted(set(stored) ^ set(acc.meta)) + \
+                        [k for k in sorted(set(stored) & set(acc.meta))
+                         if stored[k] != acc.meta[k]]
+                if diff:
+                    detail = {k: (stored.get(k, '<absent>'),
+                                  acc.meta.get(k, '<absent>'))
+                              for k in diff}
+                    raise ValueError(
+                        f'checkpoint {path} was written by a '
+                        f'different sweep; differing fields '
+                        f'(stored, requested): {detail}')
         return acc

@@ -12,7 +12,9 @@ works on simulated runs.
 Per core the dump carries:
 
 - ``pc[15:0]``   — program counter at each retired step
-- ``qclk[31:0]`` — the qclk value (time - offset) *as of* each step
+- ``qclk[31:0]`` — the qclk value (time - offset), exact at every step
+  via the per-step offset trace (``trace_off``); a legacy trace without
+  it dumps the final-offset approximation under the name ``qclk_approx``
 - ``done``       — end-of-program flag
 - per element (one sub-scope per element that fired, mirroring the
   reference's per-element ``pulse_iface``): ``cstrobe`` — one-cycle
@@ -74,6 +76,7 @@ def write_vcd(path: str, out: dict, clk_period_ns: float = 2.0,
     # one host conversion per array, not per extracted scalar
     trace_pc = sel(out['trace_pc'])
     trace_t = sel(out['trace_time'])
+    trace_off = sel(out['trace_off']) if 'trace_off' in out else None
     n_pulses = sel(out['n_pulses'])
     gtime = sel(out['rec_gtime'])
     elem_rec = sel(out['rec_elem'])
@@ -98,10 +101,15 @@ def write_vcd(path: str, out: dict, clk_period_ns: float = 2.0,
         k += 1
         return s
 
+    # with the per-step offset trace the dumped qclk is exact at every
+    # timestamp; a legacy trace (no trace_off) falls back to the final
+    # offset and is honestly named qclk_approx (sync/inc_qclk offset
+    # changes appear as retroactive ramps there)
+    qclk_name = 'qclk' if trace_off is not None else 'qclk_approx'
     header = []          # (label, [(name, width, ident)], {elem: [...]})
     for c, label in zip(cores, core_labels):
         v_pc, v_qclk, v_done = new_ident(), new_ident(), new_ident()
-        core_vars = [('pc', 16, v_pc), ('qclk', 32, v_qclk),
+        core_vars = [('pc', 16, v_pc), (qclk_name, 32, v_qclk),
                      ('done', 1, v_done)]
 
         # pc at each retired step (dedupe repeats after done)
@@ -113,10 +121,17 @@ def write_vcd(path: str, out: dict, clk_period_ns: float = 2.0,
                 continue
             prev = (t, pc)
             events.append((t * tick, 0, v_pc, 16, pc))
-        # qclk rendered with the FINAL offset (sync/inc_qclk offset
-        # changes show as retroactive ramps — documented approximation;
-        # the pc and pulse channels are exact)
-        if time_fin is not None:
+        if trace_off is not None:
+            # exact: qclk = time - offset with the offset AS OF the step
+            last = None
+            for s in range(steps):
+                t = int(trace_t[c, s])
+                q = t - int(trace_off[c, s])
+                if (t, q) == last:
+                    continue
+                last = (t, q)
+                events.append((t * tick, 1, v_qclk, 32, q))
+        elif time_fin is not None:
             off = int(time_fin[c]) - int(qclk_fin[c])
             seen = set()
             for s in range(steps):

@@ -210,3 +210,20 @@ def test_physics_sweep_driver_sharded(tmp_path):
     with pytest.raises(ValueError, match='different sweep'):
         run_physics_sweep(mp, model, 64, 32, key=5, checkpoint=ckpt,
                           mesh=mesh, **kw)
+
+
+def test_physics_sweep_warns_on_incomplete_batches(tmp_path):
+    """ADVICE r2: incomplete shots dilute the reported means — the
+    driver must warn rather than let the counter go unnoticed."""
+    from distributed_processor_tpu.simulator import Simulator
+    from distributed_processor_tpu.models.experiments import active_reset
+    from distributed_processor_tpu.parallel import run_physics_sweep
+    from distributed_processor_tpu.sim.physics import ReadoutPhysics
+
+    sim = Simulator(n_qubits=2)
+    mp = sim.compile(active_reset(['Q0', 'Q1']))
+    model = ReadoutPhysics(sigma=0.01, p1_init=0.5)
+    with pytest.warns(UserWarning, match='did not finish'):
+        out = run_physics_sweep(mp, model, 32, 16, key=5,
+                                max_steps=3, max_pulses=8, max_meas=2)
+    assert out['incomplete_batches'] == 2

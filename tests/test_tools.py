@@ -241,3 +241,95 @@ def test_cli_run_physics(tmp_path, capsys):
     assert out['meas1_rate_per_core'] == [1.0]   # all start excited
     assert out['mean_pulses_per_core'] == [4.0]  # reset branch everywhere
     assert out['epochs'] >= 1
+
+
+def test_vcd_qclk_exact_across_sync(tmp_path):
+    """ADVICE r2: qclk is dumped from the per-step offset trace, so a
+    sync's qclk reset takes effect AT its step instead of ramping
+    retroactively — early-timestamp qclk values equal the global time
+    (offset 0) even though the run ends with a nonzero offset."""
+    from distributed_processor_tpu.simulator import Simulator
+    from distributed_processor_tpu.sim import simulate
+    from distributed_processor_tpu.models.experiments import \
+        loop_shots_program
+    from distributed_processor_tpu.utils.vcd import write_vcd
+
+    sim = Simulator(n_qubits=1)
+    mp = sim.compile(loop_shots_program(
+        [{'name': 'X90', 'qubit': ['Q0']}], 2, ['Q0']))
+    out = simulate(mp, cfg=sim.interpreter_config(mp, trace=True))
+    final_off = int(np.asarray(out['time'])[0]) \
+        - int(np.asarray(out['qclk'])[0])
+    assert final_off > 0        # the loop's qclk rewind moved the origin
+    path = tmp_path / 't.vcd'
+    write_vcd(str(path), out)
+    text = path.read_text()
+    assert ' qclk ' in text or ' qclk\n' in text      # exact, not approx
+    assert 'qclk_approx' not in text
+    # collect (time_ps, qclk) events for core 0
+    ident = None
+    for line in text.splitlines():
+        if '$var' in line and ' qclk ' in line:
+            ident = line.split()[3]
+            break
+    events, cur = [], None
+    for line in text.splitlines():
+        if line.startswith('#'):
+            cur = int(line[1:])
+        elif ident and line.startswith('b') and line.endswith(' ' + ident):
+            events.append((cur, int(line.split()[0][1:], 2)))
+    pre_sync = [(t, q) for t, q in events if t is not None
+                and q == t // 2000 and t // 2000 < final_off]
+    assert pre_sync                    # early steps dump qclk == time
+    # and a legacy trace (no trace_off) is honestly renamed
+    legacy = {k: v for k, v in out.items() if k != 'trace_off'}
+    write_vcd(str(path), legacy)
+    assert 'qclk_approx' in path.read_text()
+
+
+def test_sweep_accumulator_legacy_and_field_diff(tmp_path):
+    """ADVICE r2: a checkpoint without identity resumes with a warning
+    (legacy), and a mismatched fingerprint names the differing fields
+    instead of dumping two repr strings."""
+    import warnings
+    path = str(tmp_path / 'c.npz')
+    legacy = SweepAccumulator(path, checkpoint_every=1)   # no meta stored
+    legacy.add({'ones': np.ones(2)})
+    with pytest.warns(UserWarning, match='no identity'):
+        SweepAccumulator.resume(path, meta={'fingerprint_version': 2,
+                                            'batch': 16})
+    meta = {'fingerprint_version': 2, 'batch': 16, 'key': [0, 5]}
+    acc = SweepAccumulator(path, checkpoint_every=1, meta=meta)
+    acc.add({'ones': np.ones(2)})
+    with pytest.raises(ValueError, match="'batch'"):
+        SweepAccumulator.resume(path, meta=dict(meta, batch=32))
+    # version skew alone: warn, but version-stable fields still compare
+    with pytest.warns(UserWarning, match='fingerprint version'):
+        SweepAccumulator.resume(path, meta=dict(meta,
+                                                fingerprint_version=3))
+    with pytest.warns(UserWarning, match='fingerprint version'):
+        with pytest.raises(ValueError, match="'batch'"):
+            SweepAccumulator.resume(
+                path, meta=dict(meta, fingerprint_version=3, batch=64))
+    # a format-changed field (str in old version, dict now) is skipped
+    # on version skew instead of spuriously failing
+    acc2 = SweepAccumulator(str(tmp_path / 'c2.npz'), checkpoint_every=1,
+                            meta=dict(meta, model='ReadoutPhysics(...)'))
+    acc2.add({'ones': np.ones(2)})
+    with pytest.warns(UserWarning, match="model"):
+        SweepAccumulator.resume(
+            str(tmp_path / 'c2.npz'),
+            meta=dict(meta, fingerprint_version=3, model={'sigma': 0.1}))
+
+
+def test_sweep_fingerprint_array_model_fields(tmp_path):
+    """ADVICE-fix follow-up: per-core array g0/g1 (a documented model
+    form) must fingerprint and checkpoint cleanly."""
+    import json as _json
+    from distributed_processor_tpu.parallel.driver import _jsonable
+    from distributed_processor_tpu.sim.physics import ReadoutPhysics
+    m = ReadoutPhysics(g0=np.array([1 + 0j, 0.5 + 0.5j]),
+                       g1=np.array([-0.6 + 0.8j, -1 + 0j]))
+    enc = _jsonable(m)
+    _json.dumps(enc)                   # round-trippable
+    assert enc['g0'] == [[1.0, 0.0], [0.5, 0.5]]
