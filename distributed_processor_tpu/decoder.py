@@ -135,9 +135,13 @@ class MachineProgram:
                             else None
                 if init is not None and step:
                     if alu_op == op_ge and step > 0 and lim >= init:
+                        # continue while lim >= ctr (ge = signed >=)
                         bound = (lim - init) // step + 1
-                    elif alu_op == op_le and step < 0 and lim <= init:
-                        bound = (init - lim) // (-step) + 1
+                    elif alu_op == op_le and step < 0 and lim < init:
+                        # continue while lim < ctr (le is STRICT signed
+                        # <, alu.v:25-27): ctr = init, init+step, ...
+                        # stops once ctr <= lim
+                        bound = (init - lim - 1) // (-step) + 1
             loops.append((t, j, bound))
         return loops
 

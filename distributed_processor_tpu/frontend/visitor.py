@@ -23,7 +23,7 @@ import numpy as np
 from . import qasm_parser as qp
 from .gate_map import GateMap, DefaultGateMap, QubitMap, DefaultQubitMap
 
-_CMP_TO_ALU = {'==': 'eq', '<=': 'le', '>=': 'ge'}
+_CMP_FLIP = {'==': '==', '<=': '>=', '>=': '<=', '<': '>', '>': '<'}
 
 
 class QASMTranslationError(ValueError):
@@ -167,20 +167,33 @@ class QASMTranslator:
         return pre
 
     def _if(self, s: qp.If) -> list[dict]:
-        if s.op not in _CMP_TO_ALU:
+        if s.op not in _CMP_FLIP:
             raise QASMTranslationError(
-                f'only ==/<=/>= conditions supported, got {s.op!r}')
-        cond = _CMP_TO_ALU[s.op]
+                f'only ==/<=/>=/</> conditions supported, got {s.op!r}')
+        op = s.op
         true = [i for st in s.true for i in self._stmt(st)]
         false = [i for st in s.false for i in self._stmt(st)]
         lhs, rhs = s.lhs, s.rhs
-        # normalise: measured-bit or variable on the right
+        # normalise: measured-bit or variable on the right, flipping the
+        # comparison direction with the operand swap
         if isinstance(lhs, qp.Ref) and not isinstance(rhs, qp.Ref):
-            lhs, rhs = rhs, lhs
+            lhs, rhs, op = rhs, lhs, _CMP_FLIP[op]
         if not isinstance(rhs, qp.Ref):
             raise QASMTranslationError('condition must involve a bit or var')
         pre, lhs_val = ([], lhs) if not isinstance(lhs, (qp.Ref, qp.BinOp)) \
             else self._expr(lhs)
+        # hardware triple is "lhs_val <alu_cond> rhs": le is STRICT
+        # signed < (alu.v:25-27), so <=/> fold into an integer constant
+        if op in ('==', '<', '>='):
+            cond = {'==': 'eq', '<': 'le', '>=': 'ge'}[op]
+        else:                                  # '<=' / '>'
+            if not isinstance(lhs_val, (int, float)) \
+                    or lhs_val != int(lhs_val):
+                raise QASMTranslationError(
+                    f'{op!r} with a non-constant left side needs the '
+                    f'strict form (hardware le/ge are </>=)')
+            lhs_val = int(lhs_val) - 1         # c <= x == c-1 < x;
+            cond = 'le' if op == '<=' else 'ge'  # c > x == c-1 >= x
         key = (rhs.name, rhs.index)
         if key in self.bit_sources:          # measurement branch
             q = self.bit_sources[key]
@@ -219,17 +232,19 @@ class QASMTranslator:
         if const != int(const):
             raise QASMTranslationError('loop bounds must be integers')
         const = int(const)
-        # condition is "const <alu_cond> var"
+        # condition is "const <alu_cond> var"; hardware le is STRICT
+        # signed < (reference: hdl/alu.v:25-27), ge is >=, so the
+        # non-native comparisons fold into the integer constant
         if op == '==':
             return const, 'eq', var
-        if op == '<=':
+        if op == '<':
             return const, 'le', var
+        if op == '<=':
+            return const - 1, 'le', var       # const <= x  ==  const-1 < x
         if op == '>=':
             return const, 'ge', var
-        if op == '<':
-            return const + 1, 'le', var
         if op == '>':
-            return const - 1, 'ge', var
+            return const - 1, 'ge', var       # const > x   ==  const-1 >= x
         raise QASMTranslationError(f'unsupported loop comparison {op!r}')
 
     def _for(self, s: qp.For) -> list[dict]:
@@ -277,9 +292,13 @@ class QASMTranslator:
                 self._var_alias[s.var] = outer
         body.append({'name': 'alu', 'op': 'add', 'lhs': step,
                      'rhs': var, 'out': var})
+        # QASM ranges are inclusive of `stop`: continue while
+        # stop >= var (ascending) / var >= stop == stop-1 < var
+        # (descending; hardware le is strict, alu.v:25-27)
         return declare + [
             {'name': 'set_var', 'var': var, 'value': start},
-            {'name': 'loop', 'cond_lhs': stop,
+            {'name': 'loop',
+             'cond_lhs': stop if step > 0 else stop - 1,
              'alu_cond': 'ge' if step > 0 else 'le',
              'cond_rhs': var, 'scope': self.all_qubits, 'body': body},
         ]

@@ -156,11 +156,18 @@ def _ohsel(table, oh):
 
 
 def _alu_vec(op, in0, in1):
-    """Vectorised 8-op ALU on int32 lanes (reference: hdl/alu.v:31-51)."""
+    """Vectorised 8-op ALU on int32 lanes (reference: hdl/alu.v:20-51).
+
+    ``le`` is STRICT signed less-than: the RTL computes it as the sign
+    of ``in0 - in1`` with overflow correction (alu.v:25-27
+    ``le = sub[31] ^ sub_oflow``), so equal operands give 0; ``ge`` is
+    its complement, in0 >= in1.  Pinned as data by the RTL-derived
+    vectors (tests/goldens/rtl_timing_vectors.json).
+    """
     return jnp.select(
         [op == 0, op == 1, op == 2, op == 3, op == 4, op == 5, op == 6],
         [in0, in0 + in1, in0 - in1,
-         (in0 == in1).astype(jnp.int32), (in0 <= in1).astype(jnp.int32),
+         (in0 == in1).astype(jnp.int32), (in0 < in1).astype(jnp.int32),
          (in0 >= in1).astype(jnp.int32), in1],
         jnp.zeros_like(in0))
 

@@ -91,9 +91,9 @@ def alu(op: int, in0: int, in1: int) -> int:
         return _i32(in0 - in1)
     if op == 0b011:      # eq
         return int(_i32(in0) == _i32(in1))
-    if op == 0b100:      # le (signed)
-        return int(_i32(in0) <= _i32(in1))
-    if op == 0b101:      # ge (signed)
+    if op == 0b100:      # le: STRICT signed < (alu.v:25-27 — the sign
+        return int(_i32(in0) < _i32(in1))     # of in0-in1, oflow-corrected)
+    if op == 0b101:      # ge (signed, in0 >= in1 — ~le, alu.v:28)
         return int(_i32(in0) >= _i32(in1))
     if op == 0b110:      # id1
         return _i32(in1)

@@ -168,7 +168,9 @@ def test_for_loop_step_and_empty_range():
         for int i in [10:-2:0] { sx q[0]; }
     ''')
     loop = next(i for i in prog if i['name'] == 'loop')
-    assert loop['cond_lhs'] == 0 and loop['alu_cond'] == 'le'
+    # descending inclusive range [10:-2:0]: continue while var > 0,
+    # i.e. -1 < var with the hardware's strict le (alu.v:25-27)
+    assert loop['cond_lhs'] == -1 and loop['alu_cond'] == 'le'
     import pytest
     with pytest.raises(Exception, match='step must be nonzero'):
         qasm_to_program('qubit[1] q; for uint i in [0:0:5] { sx q[0]; }')

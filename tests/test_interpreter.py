@@ -110,7 +110,7 @@ def test_conditional_loop():
     cmds = [
         isa.alu_cmd('reg_alu', 'i', 5, 'id0', write_reg_addr=0),      # 0: n=5
         isa.alu_cmd('reg_alu', 'i', -1, 'add', 0, write_reg_addr=0),  # 1: n-=1
-        isa.alu_cmd('jump_cond', 'i', 1, 'le', 0, jump_cmd_ptr=1),    # 2: 1<=n?
+        isa.alu_cmd('jump_cond', 'i', 0, 'le', 0, jump_cmd_ptr=1),    # 2: 0<n?
         isa.done_cmd(),                                               # 3
     ]
     out = simulate(mp_of(cmds))

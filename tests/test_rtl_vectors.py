@@ -1,0 +1,132 @@
+"""RTL-derived timing vectors: both engines vs. hand-transcribed data.
+
+Round-2 review, missing #1 (the shared-fate oracle risk): the JAX
+interpreter and the scalar oracle are reimplementations by the same
+author, so a shared misunderstanding would pass every engine-vs-oracle
+test.  ``tests/goldens/rtl_timing_vectors.json`` transcribes the
+reference cocotb testbench's *expected observables* (pulse strobe
+positions, ALU results per hdl/alu.v including signed-comparison
+boundary and overflow cases, branch targets, fproc availability times,
+qclk arithmetic, sync release, idle holds) as DATA with per-case
+provenance — this test runs BOTH engines against that data
+independently, so a divergence in either engine alone is caught.
+
+Transcribing the vectors caught a real one: both engines implemented
+``le`` as ``<=`` while alu.v:25-27 computes strict signed ``<``
+(``sub[31] ^ sub_oflow``) — fixed in round 3 and pinned here by the
+``alu_table`` boundary rows and the ``jump_cond_*_boundary`` cases.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.decoder import machine_program_from_cmds
+from distributed_processor_tpu.sim import simulate, run_oracle
+
+_PATH = os.path.join(os.path.dirname(__file__), 'goldens',
+                     'rtl_timing_vectors.json')
+with open(_PATH) as f:
+    _VECTORS = json.load(f)
+
+CSTROBE_DELAY = _VECTORS['cstrobe_delay']
+
+
+def _build(case):
+    cores = [[getattr(isa, ins['fn'])(**ins['kw']) for ins in core]
+             for core in case['cores']]
+    return machine_program_from_cmds(cores)
+
+
+def _check_pulses(exp, pulses_per_core, label):
+    """Shared pulse assertions from engine-neutral pulse dicts."""
+    for c, want_n in enumerate(exp.get('n_pulses', [])):
+        got = pulses_per_core[c]
+        assert len(got) == want_n, (label, c, len(got), want_n)
+    for field in ('qtime', 'gtime', 'freq', 'phase', 'amp', 'env'):
+        for c, wants in enumerate(exp.get(field, [])):
+            for p, want in enumerate(wants):
+                assert pulses_per_core[c][p][field] == want, \
+                    (label, field, c, p, pulses_per_core[c][p][field], want)
+    # the RTL observation: cstrobe appears at qclk == qtime + the
+    # documented 2-cycle strobe pipeline (cocotb test_proc.py:123)
+    for c, strobes in enumerate(exp.get('strobe_qclk', [])):
+        for p, strobe in enumerate(strobes):
+            assert pulses_per_core[c][p]['qtime'] == strobe - CSTROBE_DELAY, \
+                (label, 'strobe', c, p)
+
+
+def _check_scalars(exp, out, label):
+    for key in ('time', 'qclk'):
+        for c, want in enumerate(exp.get(key, [])):
+            assert int(np.asarray(out[key])[c]) == want, \
+                (label, key, c, int(np.asarray(out[key])[c]), want)
+    for c, want in enumerate(exp.get('done', [])):
+        assert bool(np.asarray(out['done'])[c]) == want, (label, 'done', c)
+    for c, want in enumerate(exp.get('err', [])):
+        got = out['err'][c]
+        got = len(got) if isinstance(got, list) else int(np.asarray(got))
+        assert got == want, (label, 'err', c, got, want)
+    for c, regs in enumerate(exp.get('regs', [])):
+        for idx, want in regs.items():
+            got = int(np.asarray(out['regs'])[c, int(idx)])
+            assert got == want, (label, 'reg', c, idx, got, want)
+
+
+@pytest.mark.parametrize('case', _VECTORS['cases'],
+                         ids=[c['name'] for c in _VECTORS['cases']])
+def test_jax_engine_matches_rtl_vectors(case):
+    mp = _build(case)
+    exp = case['expected']
+    kw = {}
+    if 'fabric' in case:
+        kw['fabric'] = case['fabric']
+    meas = np.asarray(case['meas_bits'], np.int32) \
+        if case.get('meas_bits') is not None else None
+    out = simulate(mp, meas_bits=meas, max_meas=4, **kw)
+    pulses = []
+    for c in range(mp.n_cores):
+        n = int(np.asarray(out['n_pulses'])[c])
+        pulses.append([
+            {f: int(np.asarray(out['rec_' + f])[c, p])
+             for f in ('qtime', 'gtime', 'freq', 'phase', 'amp', 'env')}
+            for p in range(n)])
+    _check_pulses(exp, pulses, 'jax:' + case['name'])
+    _check_scalars(exp, out, 'jax:' + case['name'])
+    for c, wants in enumerate(exp.get('meas_avail', [])):
+        got = [int(t) for t in np.asarray(out['meas_avail'])[c]
+               if t != np.iinfo(np.int32).max]
+        assert got == wants, ('jax', 'meas_avail', c, got, wants)
+    for c, want in enumerate(exp.get('n_resets', [])):
+        assert int(np.asarray(out['n_resets'])[c]) == want
+    for c, wants in enumerate(exp.get('rst_time', [])):
+        got = [int(t) for t in
+               np.asarray(out['rst_time'])[c][:len(wants)]]
+        assert got == wants, ('jax', 'rst_time', c)
+
+
+@pytest.mark.parametrize('case', _VECTORS['cases'],
+                         ids=[c['name'] for c in _VECTORS['cases']])
+def test_oracle_matches_rtl_vectors(case):
+    mp = _build(case)
+    exp = case['expected']
+    kw = {}
+    if 'fabric' in case:
+        kw['fabric'] = case['fabric']
+    meas = np.asarray(case['meas_bits']) \
+        if case.get('meas_bits') is not None else None
+    out = run_oracle(mp, meas_bits=meas, **kw)
+    pulses = [[{f: int(p[f]) for f in
+                ('qtime', 'gtime', 'freq', 'phase', 'amp', 'env')}
+               for p in core] for core in out['pulses']]
+    _check_pulses(exp, pulses, 'oracle:' + case['name'])
+    _check_scalars(exp, out, 'oracle:' + case['name'])
+    for c, wants in enumerate(exp.get('meas_avail', [])):
+        assert [int(t) for t in out['meas_avail'][c]] == wants
+    for c, want in enumerate(exp.get('n_resets', [])):
+        assert len(out['resets'][c]) == want
+    for c, wants in enumerate(exp.get('rst_time', [])):
+        assert [int(t) for t in out['resets'][c][:len(wants)]] == wants
