@@ -134,14 +134,26 @@ class MachineProgram:
                                 and int(soa.alu_op[core, i]) == op_id0) \
                             else None
                 if init is not None and step:
-                    if alu_op == op_ge and step > 0 and lim >= init:
-                        # continue while lim >= ctr (ge = signed >=)
-                        bound = (lim - init) // step + 1
-                    elif alu_op == op_le and step < 0 and lim < init:
+                    if alu_op == op_ge and step > 0:
+                        # continue while lim >= ctr (ge = signed >=);
+                        # a bound already past the limit still runs the
+                        # do-while body once before the back-edge test
+                        bound = (lim - init) // step + 1 \
+                            if lim >= init else 1
+                    elif alu_op == op_le and step < 0:
                         # continue while lim < ctr (le is STRICT signed
                         # <, alu.v:25-27): ctr = init, init+step, ...
                         # stops once ctr <= lim
-                        bound = (init - lim - 1) // (-step) + 1
+                        bound = (init - lim - 1) // (-step) + 1 \
+                            if lim < init else 1
+                    # the formulas assume the int32 counter never wraps:
+                    # if the final value leaves the register range, the
+                    # wrapped comparison re-enters the loop and the trip
+                    # count is NOT the closed form — fall back rather
+                    # than under-size the execution budget
+                    if bound is not None and not (
+                            -2**31 <= init + bound * step < 2**31):
+                        bound = None
             loops.append((t, j, bound))
         return loops
 

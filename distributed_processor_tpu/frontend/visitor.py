@@ -26,6 +26,20 @@ from .gate_map import GateMap, DefaultGateMap, QubitMap, DefaultQubitMap
 _CMP_FLIP = {'==': '==', '<=': '>=', '>=': '<=', '<': '>', '>': '<'}
 
 
+def _fold_nonstrict(op: str, const: int) -> int:
+    """Fold ``const <= x`` / ``const > x`` onto the hardware's STRICT
+    comparisons (alu.v:25-27: le is signed <, ge is >=):
+    ``const <= x == const-1 < x``; ``const > x == const-1 >= x``.
+    Rejects the INT32_MIN edge where the folded constant leaves the
+    32-bit range (the condition is then trivial — drop it instead)."""
+    if const == -2**31:
+        raise QASMTranslationError(
+            f'{op!r} against INT32_MIN folds out of the 32-bit range '
+            f'(the condition is trivially '
+            f'{"true" if op == "<=" else "false"} — drop it)')
+    return const - 1
+
+
 class QASMTranslationError(ValueError):
     pass
 
@@ -180,20 +194,40 @@ class QASMTranslator:
             lhs, rhs, op = rhs, lhs, _CMP_FLIP[op]
         if not isinstance(rhs, qp.Ref):
             raise QASMTranslationError('condition must involve a bit or var')
-        pre, lhs_val = ([], lhs) if not isinstance(lhs, (qp.Ref, qp.BinOp)) \
-            else self._expr(lhs)
+        # prefer constant folding (negative literals parse as BinOp(0-n))
+        # so <=/> can fold into the constant; fall back to a register
+        if isinstance(lhs, (qp.Ref, qp.BinOp)):
+            try:
+                pre, lhs_val = [], self._const_expr(lhs)
+            except QASMTranslationError:
+                pre, lhs_val = self._expr(lhs)
+        else:
+            pre, lhs_val = [], lhs
         # hardware triple is "lhs_val <alu_cond> rhs": le is STRICT
         # signed < (alu.v:25-27), so <=/> fold into an integer constant
         if op in ('==', '<', '>='):
             cond = {'==': 'eq', '<': 'le', '>=': 'ge'}[op]
-        else:                                  # '<=' / '>'
-            if not isinstance(lhs_val, (int, float)) \
-                    or lhs_val != int(lhs_val):
+        elif isinstance(lhs_val, (int, float)):
+            if lhs_val != int(lhs_val):
                 raise QASMTranslationError(
-                    f'{op!r} with a non-constant left side needs the '
-                    f'strict form (hardware le/ge are </>=)')
-            lhs_val = int(lhs_val) - 1         # c <= x == c-1 < x;
-            cond = 'le' if op == '<=' else 'ge'  # c > x == c-1 >= x
+                    f'{op!r} against non-integer constant {lhs_val!r}: '
+                    f'hardware comparisons are 32-bit integer')
+            lhs_val = _fold_nonstrict(op, int(lhs_val))
+            cond = 'le' if op == '<=' else 'ge'
+        elif self._varname(rhs.name) in self.int_vars:
+            # var-vs-var <=/>: swap operands with the flipped STRICT
+            # complement — "a <= y" == "y >= a", "a > y" == "y < a" —
+            # branch_var takes variables on both sides
+            return pre + [{'name': 'branch_var',
+                           'alu_cond': 'ge' if op == '<=' else 'le',
+                           'cond_lhs': self._varname(rhs.name),
+                           'cond_rhs': lhs_val,
+                           'scope': self.all_qubits,
+                           'true': true, 'false': false}]
+        else:
+            raise QASMTranslationError(
+                f'{op!r} against a measured bit needs a constant side '
+                f'(hardware le/ge are </>=)')
         key = (rhs.name, rhs.index)
         if key in self.bit_sources:          # measurement branch
             q = self.bit_sources[key]
@@ -215,14 +249,12 @@ class QASMTranslator:
         ``(cond_lhs const, alu_cond in eq/ge/le, cond_rhs var)``.
         Strict comparisons fold into the integer constant (``x < K`` ==
         ``K-1 >= x``)."""
-        flipped = {'<': '>', '<=': '>=', '>': '<', '>=': '<=',
-                   '==': '=='}
         if isinstance(lhs, qp.Ref) and self._varname(lhs.name) \
                 in self.int_vars:
             if isinstance(rhs, qp.Ref):
                 raise QASMTranslationError(
                     'loop conditions need one constant side')
-            lhs, rhs, op = rhs, lhs, flipped.get(op, op)
+            lhs, rhs, op = rhs, lhs, _CMP_FLIP.get(op, op)
         if not (isinstance(rhs, qp.Ref)
                 and self._varname(rhs.name) in self.int_vars):
             raise QASMTranslationError(
@@ -239,12 +271,11 @@ class QASMTranslator:
             return const, 'eq', var
         if op == '<':
             return const, 'le', var
-        if op == '<=':
-            return const - 1, 'le', var       # const <= x  ==  const-1 < x
         if op == '>=':
             return const, 'ge', var
-        if op == '>':
-            return const - 1, 'ge', var       # const > x   ==  const-1 >= x
+        if op in ('<=', '>'):
+            return _fold_nonstrict(op, const), \
+                ('le' if op == '<=' else 'ge'), var
         raise QASMTranslationError(f'unsupported loop comparison {op!r}')
 
     def _for(self, s: qp.For) -> list[dict]:
@@ -295,6 +326,10 @@ class QASMTranslator:
         # QASM ranges are inclusive of `stop`: continue while
         # stop >= var (ascending) / var >= stop == stop-1 < var
         # (descending; hardware le is strict, alu.v:25-27)
+        if step < 0 and stop == -2**31:
+            raise QASMTranslationError(
+                'descending range to INT32_MIN: the inclusive bound '
+                'folds out of the 32-bit range')
         return declare + [
             {'name': 'set_var', 'var': var, 'value': start},
             {'name': 'loop',

@@ -340,3 +340,50 @@ def test_nested_sibling_loops_share_registers():
     sim = Simulator(n_qubits=1)
     out = sim.run(sim.compile(prog), shots=1, max_meas=1)
     assert int(np.asarray(out['n_pulses'])[0]) == 36   # 18 inner x 2
+
+
+def test_if_negative_constant_folds():
+    """Negative literals parse as BinOp(0-n); the branch lowering must
+    constant-fold them rather than materializing a register and then
+    rejecting the <=/> fold (round-3 review finding)."""
+    prog = qasm_to_program('''
+        qubit[1] q;
+        int[32] x = 1;
+        if (x >= -5) { sx q[0]; }
+    ''')
+    br = next(i for i in prog if i['name'] == 'branch_var')
+    # normalized to "-5 <= x" then folded strict: -6 < x
+    assert br['cond_lhs'] == -6 and br['alu_cond'] == 'le'
+    from distributed_processor_tpu.simulator import Simulator
+    sim = Simulator(n_qubits=1)
+    out = sim.run(sim.compile(prog), shots=1, max_meas=1)
+    assert int(np.asarray(out['n_pulses'])[0]) == 1   # 1 >= -5: taken
+
+
+def test_if_var_vs_var_le():
+    """var-vs-var <= lowers by swapping operands with the flipped
+    strict complement: a <= y == y >= a."""
+    prog = qasm_to_program('''
+        qubit[1] q;
+        int[32] a = 2;
+        int[32] y = 2;
+        if (a <= y) { sx q[0]; }
+    ''')
+    br = next(i for i in prog if i['name'] == 'branch_var')
+    assert br['alu_cond'] == 'ge' and br['cond_lhs'] == 'y' \
+        and br['cond_rhs'] == 'a'
+    from distributed_processor_tpu.simulator import Simulator
+    sim = Simulator(n_qubits=1)
+    out = sim.run(sim.compile(prog), shots=1, max_meas=1)
+    assert int(np.asarray(out['n_pulses'])[0]) == 1   # 2 <= 2: taken
+
+
+def test_int32_min_folds_raise_clearly():
+    from distributed_processor_tpu.frontend.visitor import \
+        QASMTranslationError
+    with pytest.raises(QASMTranslationError, match='INT32_MIN'):
+        qasm_to_program('qubit[1] q; int[32] n = 0; '
+                        'while (n >= -2147483648) { sx q[0]; }')
+    with pytest.raises(QASMTranslationError, match='INT32_MIN'):
+        qasm_to_program('qubit[1] q; '
+                        'for int i in [5:-1:-2147483648] { sx q[0]; }')

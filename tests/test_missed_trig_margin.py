@@ -1,0 +1,127 @@
+"""Missed-trigger conservatism, quantified (round-2 review weak #5).
+
+``ERR_MISSED_TRIG`` fires when a pulse trigger time is already past the
+engine's issue clock, which accumulates the *scheduler's* per-
+instruction costs (the documented worst-case latencies,
+reference python/distproc/hwconfig.py:100-119).  The hardware FSM's
+actual dwell can be shorter (cocotb/proc/test_proc.py:8-19:
+ALU_INSTR_TIME=4 vs the scheduled 5), so a hand-scheduled program that
+under-schedules by up to the accumulated difference would run on real
+hardware but is flagged here — a false positive in the conservative
+direction only.  These tests pin the flag boundary to EXACTLY the
+documented cost accumulation in both engines (one clock earlier
+flags, the boundary itself does not), which makes the conservatism
+margin a computable quantity:
+
+    margin(program) = sum over issued instructions of
+                      (scheduled cost - RTL minimum dwell)
+
+documented per instruction class in docs/TIMING.md "Missed-trigger
+conservatism".  For pulse->pulse spacing the margin is zero (the
+3-clock minimum spacing is itself the hardware contract,
+hwconfig.py:106-107), so back-to-back pulse chains are flagged exactly
+when hardware would miss.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.decoder import machine_program_from_cmds
+from distributed_processor_tpu.sim import simulate, run_oracle
+from distributed_processor_tpu.sim import ERR_MISSED_TRIG
+from distributed_processor_tpu.sim.oracle import INIT_TIME
+
+ALU_CLKS = 5          # hwconfig alu_instr_clks (reference hwconfig.py:103)
+JUMP_CLKS = 5         # jump_cond_clks (hwconfig.py:104)
+PULSE_LOAD = 3        # pulse_load_clks / min spacing (hwconfig.py:106-107)
+COCOTB_ALU_DWELL = 4  # cocotb ALU_INSTR_TIME (test_proc.py:15): the RTL
+                      # FSM's observed per-ALU dwell — 1 clk under the
+                      # scheduled worst case
+
+
+def _engine_err(mp):
+    out = simulate(mp, max_meas=2)
+    return int(np.asarray(out['err'])[0])
+
+
+def _oracle_errs(mp):
+    return run_oracle(mp)['err'][0]
+
+
+def _alu_chain_program(n_alu: int, trig: int):
+    cmds = [isa.alu_cmd('reg_alu', 'i', 1, 'add', 0, write_reg_addr=0)
+            for _ in range(n_alu)]
+    cmds.append(isa.pulse_cmd(freq_word=1, phase_word=0, amp_word=1,
+                              env_word=(1 << 12), cfg_word=0,
+                              cmd_time=trig))
+    cmds.append(isa.done_cmd())
+    return machine_program_from_cmds([cmds])
+
+
+@pytest.mark.parametrize('n_alu', [1, 4, 8])
+def test_alu_chain_flag_boundary_exact(n_alu):
+    """The flag boundary is exactly INIT_TIME + n*alu_instr_clks: a
+    trigger AT the boundary issues cleanly, one clock earlier flags —
+    in both engines."""
+    boundary = INIT_TIME + n_alu * ALU_CLKS
+    ok = _alu_chain_program(n_alu, boundary)
+    assert _engine_err(ok) == 0
+    assert _oracle_errs(ok) == []
+    late = _alu_chain_program(n_alu, boundary - 1)
+    assert _engine_err(late) & ERR_MISSED_TRIG
+    assert 'missed_trig' in _oracle_errs(late)
+    # the conservatism margin for this program: hardware (per the cocotb
+    # dwell) would still meet any trigger down to INIT_TIME +
+    # n*COCOTB_ALU_DWELL, i.e. the engine over-flags by exactly
+    margin = n_alu * (ALU_CLKS - COCOTB_ALU_DWELL)
+    assert margin == n_alu                      # 1 clk per ALU instr
+    # triggers inside the margin ARE flagged (conservative direction)
+    if margin:
+        inside = _alu_chain_program(n_alu, boundary - margin)
+        assert _engine_err(inside) & ERR_MISSED_TRIG
+
+
+def test_pulse_spacing_margin_zero():
+    """Back-to-back triggers at the 3-clock minimum spacing pass; one
+    clock tighter flags.  The spacing is the hardware contract itself
+    (hwconfig.py:106-107), so here the flag has ZERO conservatism —
+    it fires exactly when hardware would miss."""
+    def prog(spacing):
+        t0 = INIT_TIME + 1
+        cmds = [isa.pulse_cmd(freq_word=1, phase_word=0, amp_word=1,
+                              env_word=(1 << 12), cfg_word=0, cmd_time=t0),
+                isa.pulse_cmd(freq_word=2, cmd_time=t0 + spacing),
+                isa.done_cmd()]
+        return machine_program_from_cmds([cmds])
+    assert _engine_err(prog(PULSE_LOAD)) == 0
+    assert _oracle_errs(prog(PULSE_LOAD)) == []
+    assert _engine_err(prog(PULSE_LOAD - 1)) & ERR_MISSED_TRIG
+    assert 'missed_trig' in _oracle_errs(prog(PULSE_LOAD - 1))
+
+
+def test_jump_boundary_exact():
+    """A trigger right after a jump_i at the documented jump cost
+    boundary (5 clks, = cocotb JUMP_INSTR_TIME — zero margin class)."""
+    def prog(trig):
+        cmds = [isa.jump_i(1),
+                isa.pulse_cmd(freq_word=1, phase_word=0, amp_word=1,
+                              env_word=(1 << 12), cfg_word=0,
+                              cmd_time=trig),
+                isa.done_cmd()]
+        return machine_program_from_cmds([cmds])
+    boundary = INIT_TIME + JUMP_CLKS
+    assert _engine_err(prog(boundary)) == 0
+    assert _engine_err(prog(boundary - 1)) & ERR_MISSED_TRIG
+    assert 'missed_trig' in _oracle_errs(prog(boundary - 1))
+
+
+def test_flagged_pulse_still_fires_slid():
+    """A flagged trigger is not dropped: it fires at the issue clock
+    (the slid time), loudly marked — matching the oracle."""
+    mp = _alu_chain_program(2, INIT_TIME + 2 * ALU_CLKS - 3)
+    out = simulate(mp, max_meas=2)
+    assert int(np.asarray(out['err'])[0]) & ERR_MISSED_TRIG
+    assert int(np.asarray(out['rec_gtime'])[0, 0]) == INIT_TIME + 2 * ALU_CLKS
+    o = run_oracle(mp)
+    assert o['pulses'][0][0]['gtime'] == INIT_TIME + 2 * ALU_CLKS

@@ -202,3 +202,31 @@ def test_truncation_warns_loudly():
         out = sim.run(mp, shots=2, max_steps=32, max_meas=1)
     assert bool(out['incomplete'])
     assert any('max_steps' in str(w.message) for w in caught)
+
+
+def test_loop_bounds_exact_at_single_trip():
+    """A down-counting do-while whose limit already covers the seed
+    still has a statically exact bound of 1 (the body runs once before
+    the back-edge test) — not a loop_fallback over-allocation."""
+    from distributed_processor_tpu import isa
+    from distributed_processor_tpu.decoder import machine_program_from_cmds
+    for op, init, lim, want in (('le', 5, 5, 1), ('le', 5, 9, 1),
+                                ('le', 5, 0, 5), ('ge', 5, 3, 1),
+                                ('ge', 0, 9, 10)):
+        step = -1 if op == 'le' else 1
+        mp = machine_program_from_cmds([[
+            isa.alu_cmd('reg_alu', 'i', init, 'id0', write_reg_addr=1),
+            isa.alu_cmd('reg_alu', 'i', step, 'add', 1, write_reg_addr=1),
+            isa.alu_cmd('jump_cond', 'i', lim, op, 1, jump_cmd_ptr=1),
+            isa.done_cmd(),
+        ]])
+        assert mp.loop_bounds(0) == [(1, 2, want)], (op, init, lim)
+    # int32 counter wrap breaks the closed form: fall back (None), never
+    # a confident under-sized bound (the wrapped comparison re-enters)
+    mp = machine_program_from_cmds([[
+        isa.alu_cmd('reg_alu', 'i', 2**31 - 1, 'id0', write_reg_addr=1),
+        isa.alu_cmd('reg_alu', 'i', 1, 'add', 1, write_reg_addr=1),
+        isa.alu_cmd('jump_cond', 'i', 0, 'ge', 1, jump_cmd_ptr=1),
+        isa.done_cmd(),
+    ]])
+    assert mp.loop_bounds(0) == [(1, 2, None)]
