@@ -29,22 +29,33 @@ publishes no numbers (it executes shots one at a time on FPGA hardware,
 host-sequenced).
 
 Env knobs: BENCH_SHOTS (total, default 1048576), BENCH_BATCH (per-device
-batch, default 131072 — the largest fitting HBM with the loop-carried
-record state), BENCH_DEPTH (RB depth, default 12), BENCH_SIGMA (ADC
-noise, default 0.05), BENCH_CHUNK (matched-filter resolve chunk in
-samples, default 256 — smaller trades speed for peak memory).
+batch, default 262144; 524288 also fits HBM with the stats-only carry —
+see docs/PERF.md for the budget), BENCH_DEPTH (RB depth, default 12),
+BENCH_SIGMA (ADC noise, default 0.05), BENCH_CHUNK (matched-filter
+resolve chunk in samples, default 256 — smaller trades speed for peak
+memory).
 
 The detail dict also reports `fused_pallas_shots_per_sec` (the same
-chain hand-fused into one Pallas kernel, ops/resolve_pallas.py) and
-`analytic_shots_per_sec` (the exact distributional shortcut —
-sim/physics.py _resolve_analytic: the matched filter is linear, so its
-output distribution is computed directly at O(1) per window).
+chain hand-fused into one Pallas kernel with in-kernel counter-based
+ADC noise, ops/resolve_pallas.py) and `analytic_shots_per_sec` (the
+exact distributional shortcut — sim/physics.py _resolve_analytic: the
+matched filter is linear, so its output distribution is computed
+directly at O(1) per window).
 The headline mode defaults to `auto`: the XLA and fused-Pallas
-formulations of the same per-sample chain are raced for one batch and
-the faster one runs the timed measurement (chosen mode recorded in the
-detail dict).  `BENCH_MODE=persample|fused|analytic` pins it.
+formulations of the same per-sample chain are raced for three batches
+and the faster one runs the timed measurement (chosen mode recorded in
+the detail dict).  `BENCH_MODE=persample|fused|analytic` pins it.
+
+Each mode's program is compiled EXACTLY once (shared between the race,
+the headline, and the secondaries), with resolve tables prepared in a
+separate small jit and passed as device arrays; a repo-local persistent
+XLA compilation cache (.jax_cache, BENCH_NO_CACHE=1 to disable) makes
+re-runs skip compilation entirely.  `jit_s` is the headline mode's
+actual first-call time and `compilation_cache` reports whether the
+cache was warm, so the number is never silently flattered.
 """
 
+import glob
 import json
 import os
 import sys
@@ -55,6 +66,19 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
+
+# persistent XLA compilation cache (repo-local): a re-run of the bench
+# (or any same-shape run) reuses compiled executables, so the one-time
+# jit cost is paid once per machine, not once per process.  BENCH_NO_CACHE=1
+# opts out; the cold/warm state is reported in the detail dict so jit_s
+# is never silently flattered.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          '.jax_cache')
+if not os.environ.get('BENCH_NO_CACHE'):
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    jax.config.update('jax_compilation_cache_dir', _CACHE_DIR)
+    jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+
 import jax.numpy as jnp
 
 from distributed_processor_tpu.pipeline import compile_to_machine
@@ -62,9 +86,16 @@ from distributed_processor_tpu.models import (
     active_reset, rb_program, make_default_qchip)
 from distributed_processor_tpu.sim.interpreter import InterpreterConfig
 from distributed_processor_tpu.sim.physics import (
-    ReadoutPhysics, run_physics_batch)
+    ReadoutPhysics, run_physics_batch, prepare_physics_tables)
 
 NORTH_STAR_SHOTS_PER_SEC = 1e6 / 60.0
+
+
+def _cache_state() -> str:
+    if os.environ.get('BENCH_NO_CACHE'):
+        return 'disabled'
+    pre = len(glob.glob(os.path.join(_CACHE_DIR, '*')))
+    return f'enabled ({"warm" if pre else "cold"}: {pre} entries)'
 
 
 def _fmt_sps(v):
@@ -142,31 +173,159 @@ def large_program_scaling(n_qubits: int, small_depth: int,
     return results
 
 
-def _race_modes(mp, cfg, batch: int, sigma: float, chunk: int) -> str:
+class _ModeStep:
+    """One compiled physics step per resolve mode, built EXACTLY once
+    and reused by the race, the headline measurement, and the
+    secondaries — a fresh ``jax.jit`` closure per phase recompiled the
+    whole program (jit-of-jit inlines), which is where round 2's
+    22-second headline jit_s went.  Resolve tables are prepared in
+    their own small jit (prepare_physics_tables) and passed as device
+    arrays, keeping their gather-heavy construction out of the stepped
+    module and off the per-batch path."""
+
+    def __init__(self, mp, cfg, batch, sigma, chunk, mode):
+        self.mode = mode
+        self.model = ReadoutPhysics(sigma=sigma, p1_init=0.15,
+                                    resolve_chunk=chunk,
+                                    resolve_mode=mode)
+        t0 = time.perf_counter()
+        self.tables = jax.block_until_ready(
+            prepare_physics_tables(mp, self.model))
+        self.tables_s = time.perf_counter() - t0
+        model = self.model
+
+        @jax.jit
+        def step(key, tables):
+            out = run_physics_batch(mp, model, key, batch, cfg=cfg,
+                                    tables=tables)
+            # reductions inside the jit: XLA dead-code-eliminates the
+            # big per-shot record outputs instead of materializing them
+            return (jnp.sum(out['n_pulses'], axis=0), jnp.sum(out['err']),
+                    jnp.sum(out['meas_bits'][:, :, 0], axis=0),
+                    out['steps'], out['epochs'], out['incomplete'])
+
+        self._step = step
+        self.jit_s = None          # set by the first warm-up
+
+    def __call__(self, key):
+        return self._step(key, self.tables)
+
+    def warm_up(self, key):
+        """First call (compiles); records jit_s; host-syncs."""
+        t0 = time.perf_counter()
+        res = jax.block_until_ready(self(key))
+        if self.jit_s is None:
+            self.jit_s = time.perf_counter() - t0
+        return res
+
+
+def _race_modes(steps: dict) -> str:
     """Median of 3 warmed, host-synced batches per per-sample
     formulation; returns the faster mode's name (a single sample can be
     skewed by transient device conditions)."""
     times = {}
-    for mode in ('persample', 'fused'):
-        model = ReadoutPhysics(sigma=sigma, p1_init=0.15,
-                               resolve_chunk=chunk, resolve_mode=mode)
-
-        @jax.jit
-        def step(key):
-            out = run_physics_batch(mp, model, key, batch, cfg=cfg)
-            return jnp.sum(out['err']), out['incomplete']
-
+    for mode, step in steps.items():
         key = jax.random.PRNGKey(9)
-        int(jax.block_until_ready(step(key))[0])       # warm + settle
+        int(step.warm_up(key)[1])                      # compile + settle
         ts = []
         for r in range(3):
             t0 = time.perf_counter()
             res = step(jax.random.fold_in(key, r + 1))
-            ok = int(res[0]) + int(res[1])             # host sync
+            ok = int(res[1]) + int(res[5])             # host sync
             ts.append(time.perf_counter() - t0)
             assert ok == 0, f'{mode} race batch errored'
         times[mode] = sorted(ts)[1]
     return min(times, key=times.get)
+
+
+# Google Cloud TPU v5e public per-chip peaks (the bench's roofline
+# denominators; docs/PERF.md derives every numerator)
+V5E_BF16_FLOPS = 197e12
+V5E_HBM_GBPS = 819.0
+V5E_HBM_GIB = 16.0
+
+
+def utilization_accounting(mp, cfg, model, batch: int,
+                           batch_s: float, epochs: int) -> dict:
+    """Hardware-utilization accounting for the headline number
+    (round-2 review missing #2): measured phase split (exec vs
+    resolve) plus analytically derived FLOP/byte volumes -> achieved
+    bandwidth and FLOP rate as fractions of the v5e peaks.  XLA's
+    static cost analysis is NOT used for the totals: it guesses
+    while-loop trip counts and cannot see inside the Pallas custom
+    call; docs/PERF.md derives each formula and states what each phase
+    is bound by.
+    """
+    from distributed_processor_tpu.sim.interpreter import (
+        _run_batch, _program_constants, _init_state, program_traits)
+    from distributed_processor_tpu.sim.physics import (physics_config,
+                                                       _physics_tables)
+    C = mp.n_cores
+    pcfg = physics_config(cfg, model)
+    soa, spc, interp, sync_part = _program_constants(mp, pcfg)
+    traits = program_traits(mp)
+
+    # measured exec phase: the same interpreter loop (physics-effective
+    # config, so the carry and co-state match the headline) with
+    # injected bits standing in for the resolver
+    @jax.jit
+    def ex(bits):
+        out = _run_batch(soa, spc, interp, sync_part, bits, pcfg, C,
+                         None, traits)
+        return out['n_pulses'].sum(), out['err'].sum(), out['steps']
+
+    bits = jnp.zeros((batch, C, cfg.max_meas), jnp.int32)
+    int(jax.block_until_ready(ex(bits))[1])
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = ex(bits)
+        steps = int(r[2])
+        ts.append(time.perf_counter() - t0)
+    t_exec = sorted(ts)[1]
+    t_resolve = max(batch_s - t_exec, 1e-9) / max(epochs, 1)
+
+    # loop-carried state bytes (exact, from the carry shapes): every
+    # while-loop iteration reads the carry and writes most of it back —
+    # the 2x read+write estimate below is the exec phase's HBM model
+    st = jax.eval_shape(lambda: _init_state(batch, C, pcfg))
+    carry = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                for v in jax.tree.leaves(st))
+    carry += 2 * batch * C * cfg.max_meas * 4       # bits + valid
+    exec_bytes = 2 * carry * steps
+    exec_gbps = exec_bytes / t_exec / 1e9
+
+    # resolve phase (per epoch), derived from the kernel structure: the
+    # envelope fetch is one_hot[lanes, R] @ T[R, W'] per plane — R*W'
+    # MACs per (shot, core) lane — plus O(W) elementwise carrier/noise
+    env_stack, freq_stack, _spc, interp_m, w_auto = \
+        _physics_tables(mp, model.meas_elem)
+    W = int(model.window_samples or w_auto)
+    Lp = env_stack.shape[1] + 64                     # padded planes (est)
+    R = -(-Lp // 128) * 128
+    Wp = -(-W // 256) * 256
+    synth_flops = batch * C * R * Wp * 2 * 2        # 2 planes, 2 flop/MAC
+    elem_flops = batch * C * Wp * 24                # carrier+filter+noise
+    res_flops = synth_flops + elem_flops
+    res_bytes = (batch * C * 4 * (11 + 6)           # lane args + acc r/w
+                 + (Wp // 256) * (C * 2 * R * 256 * 4))   # table slices
+    return {
+        'exec_s': round(t_exec, 3),
+        'resolve_s_per_epoch': round(t_resolve, 3),
+        'interp_steps': steps,
+        'carry_bytes_per_shot': int(carry / batch),
+        'exec_hbm_gbps': round(exec_gbps, 1),
+        'exec_hbm_frac': round(exec_gbps / V5E_HBM_GBPS, 3),
+        'resolve_tflops': round(res_flops / 1e12, 3),
+        'resolve_tflops_per_s': round(res_flops / t_resolve / 1e12, 1),
+        'resolve_flops_frac_bf16_peak':
+            round(res_flops / t_resolve / V5E_BF16_FLOPS, 3),
+        'resolve_hbm_gbps': round(res_bytes / t_resolve / 1e9, 1),
+        'note': 'exec is int32 control flow (VPU/latency-bound, no MXU '
+                'work by construction); resolve rides the MXU via the '
+                'one-hot envelope fetch at f32-HIGHEST — see '
+                'docs/PERF.md for derivations and the roofline position',
+    }
 
 
 def _preflight(timeout_s: float = 180.0):
@@ -208,13 +367,14 @@ def main():
     n_qubits = int(os.environ.get('BENCH_QUBITS', 8))
     depth = int(os.environ.get('BENCH_DEPTH', 12))
     total_shots = int(os.environ.get('BENCH_SHOTS', 1048576))
-    batch = int(os.environ.get('BENCH_BATCH', 131072))
+    batch = int(os.environ.get('BENCH_BATCH', 262144))
     sigma = float(os.environ.get('BENCH_SIGMA', 0.05))
     chunk = int(os.environ.get('BENCH_CHUNK', 256))
     batch = min(batch, total_shots)
     n_batches = max(total_shots // batch, 1)
     total_shots = batch * n_batches
 
+    cache_state = _cache_state()
     pallas_compiled = pallas_compiled_parity()
 
     t0 = time.perf_counter()
@@ -237,83 +397,89 @@ def main():
         print('BENCH_MODE=fused needs a TPU; falling back to persample',
               file=sys.stderr)
         headline_mode = 'persample'
+    C = mp.n_cores
+    on_tpu = jax.devices()[0].platform == 'tpu'
+
+    # one compiled step per mode, shared by race + headline + secondaries
+    steps: dict = {}
+
+    def mode_step(mode) -> _ModeStep:
+        if mode not in steps:
+            steps[mode] = _ModeStep(mp, cfg, batch, sigma, chunk, mode)
+        return steps[mode]
+
     if headline_mode == 'auto':
         # the XLA and fused-Pallas formulations of the same per-sample
         # chain trade places with device conditions (see docs/PHYSICS.md);
-        # race one steady-state batch of each and take the faster.
-        # Guarded: a race failure must not cost the bench its one JSON
-        # output line — fall back to the XLA path
+        # race three steady-state batches of each (same compiled steps
+        # the measurement reuses) and take the faster.  Guarded: a race
+        # failure must not cost the bench its one JSON output line
         headline_mode = 'persample'
-        if jax.devices()[0].platform == 'tpu':
+        if on_tpu:
             try:
-                headline_mode = _race_modes(mp, cfg, batch, sigma, chunk)
+                headline_mode = _race_modes(
+                    {m: mode_step(m) for m in ('persample', 'fused')})
             except Exception as e:      # pragma: no cover - defensive
                 print(f'mode race failed ({e!r:.120}); using persample',
                       file=sys.stderr)
             print(f'auto headline mode: {headline_mode}', file=sys.stderr)
-    model = ReadoutPhysics(sigma=sigma, p1_init=0.15, resolve_chunk=chunk,
-                           resolve_mode=headline_mode)
-    C = mp.n_cores
 
-    def make_step(m):
-        @jax.jit
-        def step(key):
-            out = run_physics_batch(mp, m, key, batch, cfg=cfg)
-            # reductions inside the jit: XLA dead-code-eliminates the
-            # big per-shot record outputs instead of materializing them
-            return (jnp.sum(out['n_pulses'], axis=0), jnp.sum(out['err']),
-                    jnp.sum(out['meas_bits'][:, :, 0], axis=0),
-                    out['steps'], out['epochs'], out['incomplete'])
-        return step
-
-    step = make_step(model)
+    step = mode_step(headline_mode)
+    model = step.model
 
     key = jax.random.PRNGKey(0)
-    # warm-up / compile
-    t0 = time.perf_counter()
-    res = jax.block_until_ready(step(key))
-    t_jit = time.perf_counter() - t0
+    # warm-up (compiles unless the race already did; jit_s records the
+    # mode's actual first-call compile time either way)
+    res = step.warm_up(key)
+    t_jit = step.jit_s
     err_total = int(res[1])
     assert not bool(res[5]), 'warm-up batch did not complete in max_steps'
     # timed batches are checked too (err/incomplete accumulated below)
 
     t0 = time.perf_counter()
     incomplete = 0
+    prev = None
     for i in range(n_batches):
         key, sub = jax.random.split(key)
-        # block per batch: queueing several in-flight steps multiplies
-        # peak HBM (each holds the full loop-carried state) and stalls
-        # the allocator, measured ~3x slower than synchronous
-        res = jax.block_until_ready(step(sub))
-        err_total += int(res[1])
-        incomplete += int(res[5])
+        # 1-deep pipelining: dispatch batch i+1 before extracting batch
+        # i's scalars, so the tunneled host round-trip (~0.5 s on axon)
+        # overlaps device compute — measured 2.8x sustained throughput
+        # vs blocking per batch.  (Round 1 measured the opposite with
+        # the full pulse-record state carried per batch; the slim
+        # stats-only carry makes two in-flight batches cheap.)  Deeper
+        # queues add nothing: the device is already saturated.
+        cur = step(sub)
+        if prev is not None:
+            err_total += int(prev[1])
+            incomplete += int(prev[5])
+        prev = cur
+    res = jax.block_until_ready(prev)
+    err_total += int(res[1])
+    incomplete += int(res[5])
     elapsed = time.perf_counter() - t0
     assert not incomplete, \
         f'{incomplete} batches did not complete within max_steps'
 
-    # secondaries, two steady-state batches each (min): the fused Pallas
-    # kernel (the same chain in one VMEM pass, ops/resolve_pallas.py)
-    # and the exact-distribution analytic shortcut (matched filter
-    # collapsed to g_s*E + sigma*sqrt(E)*xi — _resolve_analytic)
-    from dataclasses import replace as _replace
+    # secondaries, two steady-state batches each (min): the other
+    # per-sample formulation and the exact-distribution analytic
+    # shortcut (matched filter collapsed to g_s*E + sigma*sqrt(E)*xi —
+    # _resolve_analytic).  Race-compiled steps are reused.
     secondary_sps = {'persample': None, 'fused': None, 'analytic': None}
     # skip fused off-TPU (TPU interpret mode — hours at bench batch) and
     # whichever mode the headline already measured
     sec_modes = [m for m in ('persample', 'fused', 'analytic')
-                 if m != headline_mode
-                 and not (m == 'fused'
-                          and jax.devices()[0].platform != 'tpu')]
+                 if m != headline_mode and not (m == 'fused' and not on_tpu)]
     for sec_mode in sec_modes:
         # guarded: a secondary failure must not discard the minutes of
         # headline measurement already taken (same rationale as the
         # large_program_scaling guard below)
         try:
-            sstep = make_step(_replace(model, resolve_mode=sec_mode))
+            sstep = mode_step(sec_mode)
             key2 = jax.random.PRNGKey(1)
             # force a host round-trip on the warm-up: block_until_ready
             # alone has been observed to return before the device settles
             # on the tunneled backend, corrupting the first timed window
-            int(jax.block_until_ready(sstep(key2))[1])
+            int(sstep.warm_up(key2)[1])
             times = []
             for _ in range(2):
                 key2, sub = jax.random.split(key2)
@@ -329,6 +495,11 @@ def main():
 
     # guarded: a failure here must not discard the minutes of headline
     # measurement already taken
+    try:
+        utilization = utilization_accounting(
+            mp, cfg, model, batch, elapsed / n_batches, int(res[4]))
+    except Exception as e:      # pragma: no cover - defensive
+        utilization = {'error': f'{type(e).__name__}: {e}'[:200]}
     try:
         scaling = large_program_scaling(n_qubits, small_depth=depth)
     except Exception as e:      # pragma: no cover - defensive
@@ -350,12 +521,17 @@ def main():
             'meas1_frac': round(bit1_frac, 4),
             'resolve_mode': model.resolve_mode,
             'compile_s': round(t_compile, 3), 'jit_s': round(t_jit, 3),
+            'tables_s': round(step.tables_s, 3),
+            'mode_jit_s': {m: (round(s.jit_s, 3) if s.jit_s else None)
+                           for m, s in steps.items()},
+            'compilation_cache': cache_state,
             'run_s': round(elapsed, 3), 'err_shots': err_total,
             'persample_xla_shots_per_sec':
                 _fmt_sps(secondary_sps['persample']),
             'fused_pallas_shots_per_sec': _fmt_sps(secondary_sps['fused']),
             'analytic_shots_per_sec': _fmt_sps(secondary_sps['analytic']),
             'scaling': scaling,
+            'utilization': utilization,
             'pallas_compiled': pallas_compiled,
             'platform': jax.devices()[0].platform,
             'device': str(jax.devices()[0]),

@@ -20,15 +20,26 @@ phase-coherent form ``e^{i A} * basis(f, s)`` with the per-window scalar
 DAC-resolution sliding-window (Toeplitz) table (per-lane gathers do not
 vectorise on TPU — the design rule everywhere in this repo).
 
-ADC noise is drawn OUTSIDE the kernel (``jax.random``, threefry) one
-chunk at a time inside the chunk ``lax.scan`` and streamed in: the
-stream is identical on every backend — TPU and the CPU interpret mode
-produce the same bits — and peak memory stays ``O(B*C*ck)``.  (The
-in-kernel ``pltpu.prng_random_bits`` alternative is not portable: the
-TPU interpret mode stubs it out to zeros, which would silently disable
-noise in off-TPU tests.)  The draw layout differs from the XLA
-per-sample path's, so the two paths agree bit-exactly at sigma=0 and
-statistically at finite sigma (tests/test_physics.py pins both).
+ADC noise has two generators, selected by ``native_rng``:
+
+* **In-kernel (default on real TPU)**: ``pltpu.prng_random_bits``
+  seeded per (key, grid cell, chunk) feeds a Box-Muller transform in
+  VMEM — the noise never exists in HBM.  The streamed alternative
+  generates ``2*B*C*ck`` float32 normals per chunk with XLA threefry
+  and round-trips them through HBM: at bench shapes that is ~2 GB per
+  chunk of pure bandwidth plus the threefry compute, which measured as
+  the bulk of the per-sample resolve cost (round-3 profiling; removing
+  it took the fused resolve from ~0.4 s to ~0.1 s per batch).
+* **Streamed (``native_rng=False``, and always under interpret)**:
+  drawn outside the kernel with ``jax.random`` one chunk at a time
+  inside the chunk ``lax.scan``.  This is the portable path: the TPU
+  interpret mode stubs ``prng_random_bits`` to zeros, which would
+  silently disable noise in off-TPU tests.
+
+Both generators produce the same N(0, sigma^2) IQ noise distribution
+(different streams); sigma=0 is bit-identical across all paths, and a
+TPU-marked statistical-parity test pins the native generator against
+the streamed one (tests/test_tpu_kernels.py).
 
 The reference implements this chain in dedicated FPGA hardware (rdlo
 pulse -> external demod -> meas bits, word formats
@@ -53,10 +64,14 @@ except ImportError:      # pragma: no cover - pallas ships with jax
 
 def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
             fidx_ref, addr_ref, nsamp_ref, s0_ref, ring_ref,
-            t_ref, bas_ref, nz_ref,
-            acc_i_in, acc_q_in, energy_in,
-            acc_i_ref, acc_q_ref, energy_ref,
-            *, tb: int, ck: int, n_f: int, ring: bool):
+            sig_ref, seed_ref, t_ref, bas_ref, *rest,
+            tb: int, ck: int, n_f: int, ring: bool, native_rng: bool):
+    if native_rng:
+        (acc_i_in, acc_q_in, energy_in,
+         acc_i_ref, acc_q_ref, energy_ref) = rest
+    else:
+        (nz_ref, acc_i_in, acc_q_in, energy_in,
+         acc_i_ref, acc_q_ref, energy_ref) = rest
     # ---- envelope: one-hot(addr) @ Toeplitz on the MXU -----------------
     r_rows = t_ref.shape[2]
     addr = addr_ref[0, 0, :]                                  # [TB] int32
@@ -108,8 +123,37 @@ def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
         w = 1.0 - jnp.exp(-(s_row + 1).astype(jnp.float32) * ring_ref[0])
     else:
         w = jnp.float32(1.0)
-    r_i = w * (gs_i * y_i - gs_q * y_q) + nz_ref[0, 0]
-    r_q = w * (gs_i * y_q + gs_q * y_i) + nz_ref[1, 0]
+    if native_rng:
+        # in-VMEM ADC noise: counter-based bits seeded per (run key,
+        # grid cell, chunk) -> Box-Muller pair.  The noise never
+        # touches HBM — the streamed path's ~2 GB/chunk of threefry
+        # normals was the bulk of the resolve cost at bench shapes.
+        # Mosaic accepts at most 2 seed words: mix the grid cell and
+        # chunk offset into the key words (murmur3 finalizer constants;
+        # int32 wrap is fine — this is statistical decorrelation, the
+        # per-(cell, chunk) streams just must not coincide)
+        s0v = s0_ref[0]
+        seed0 = seed_ref[0] + pl.program_id(0) * jnp.int32(-1640531527) \
+            + s0v * jnp.int32(-2048144789)
+        seed1 = seed_ref[1] + pl.program_id(1) * jnp.int32(-1028477387) \
+            + s0v
+        pltpu.prng_seed(seed0, seed1)
+        bits = pltpu.prng_random_bits((2, tb, ck))
+        # 24-bit mantissa uniforms: u1 in (0,1] (log-safe), u2 in [0,1).
+        # bits are SIGNED int32 — a plain >> would sign-extend and hand
+        # log() negative arguments; shift logically
+        top24 = jax.lax.shift_right_logical(bits, 8)
+        u1 = (top24[0] + 1).astype(jnp.float32) * (2.0 ** -24)
+        u2 = top24[1].astype(jnp.float32) * (2.0 ** -24)
+        r_bm = jnp.sqrt(-2.0 * jnp.log(u1))
+        ang = (2.0 * np.pi) * u2
+        sigma = sig_ref[0]
+        nz_i = sigma * r_bm * jnp.cos(ang)
+        nz_q = sigma * r_bm * jnp.sin(ang)
+    else:
+        nz_i, nz_q = nz_ref[0, 0], nz_ref[1, 0]
+    r_i = w * (gs_i * y_i - gs_q * y_q) + nz_i
+    r_q = w * (gs_i * y_q + gs_q * y_i) + nz_q
     acc_i_ref[0, 0, :] = acc_i_in[0, 0, :] + jnp.sum(r_i * y_i + r_q * y_q,
                                                      axis=1)
     acc_q_ref[0, 0, :] = acc_q_in[0, 0, :] + jnp.sum(r_q * y_i - r_i * y_q,
@@ -119,10 +163,11 @@ def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=('tb', 'ck', 'w_pad', 'ring', 'interpret'))
+    jax.jit, static_argnames=('tb', 'ck', 'w_pad', 'ring', 'native_rng',
+                              'interpret'))
 def _resolve_call(amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
                   key, sigma, inv_ring, t_dac, basis, tb, ck, w_pad,
-                  ring, interpret):
+                  ring, native_rng, interpret):
     C, _, B = amp.shape
     n_chunks = w_pad // ck
     R = t_dac.shape[2]
@@ -133,31 +178,37 @@ def _resolve_call(amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
         # some mosaic primitives); the kernel itself is backend-pure
         interpret = pltpu.InterpretParams()
     lane_spec = pl.BlockSpec((1, 1, tb), lambda c, t: (c, 0, t))
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     call = pl.pallas_call(
-        functools.partial(_kernel, tb=tb, ck=ck, n_f=F, ring=ring),
+        functools.partial(_kernel, tb=tb, ck=ck, n_f=F, ring=ring,
+                          native_rng=native_rng),
         grid=(C, B // tb),
-        in_specs=[lane_spec] * 8 + [
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
+        in_specs=[lane_spec] * 8 + [smem] * 4 + [
             pl.BlockSpec((1, 2, R, ck), lambda c, t: (c, 0, 0, 0)),
             pl.BlockSpec((1, 2, F, ck), lambda c, t: (c, 0, 0, 0)),
-            pl.BlockSpec((2, 1, tb, ck), lambda c, t: (0, c, t, 0)),
-        ] + [lane_spec] * 3,
+        ] + ([] if native_rng else
+             [pl.BlockSpec((2, 1, tb, ck), lambda c, t: (0, c, t, 0))])
+        + [lane_spec] * 3,
         out_specs=[lane_spec] * 3,
         out_shape=[jax.ShapeDtypeStruct((C, 1, B), jnp.float32)] * 3,
         interpret=interpret,
     )
+    # seed material for the in-kernel generator: the (epoch-folded) key's
+    # raw words — grid position and chunk offset are folded in-kernel
+    seed = jax.lax.bitcast_convert_type(
+        jax.random.key_data(key).reshape(-1)[:2], jnp.int32)
 
     def chunk_body(carry, k):
         acc_i, acc_q, energy = carry
         s0 = k * ck
         t_k = jax.lax.dynamic_slice(t_dac, (0, 0, 0, s0), (C, 2, R, ck))
         b_k = jax.lax.dynamic_slice(basis, (0, 0, 0, s0), (C, 2, F, ck))
-        nz = sigma * jax.random.normal(
-            jax.random.fold_in(key, k), (2, C, B, ck), jnp.float32)
+        nz = [] if native_rng else [sigma * jax.random.normal(
+            jax.random.fold_in(key, k), (2, C, B, ck), jnp.float32)]
         acc_i, acc_q, energy = call(
             amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
-            s0.reshape((1,)), inv_ring.reshape((1,)), t_k, b_k, nz,
+            s0.reshape((1,)), inv_ring.reshape((1,)),
+            sigma.reshape((1,)), seed, t_k, b_k, *nz,
             acc_i, acc_q, energy)
         return (acc_i, acc_q, energy), None
 
@@ -214,8 +265,9 @@ def build_fused_tables(env_pads, basis, W: int, interps, ck: int):
 
 def resolve_windows_fused(sc: dict, fused_tables, gs_i, gs_q,
                           sigma, inv_ring, key, W: int, Lp: int,
-                          *, tb: int = 512, ck: int = 256,
-                          ring: bool = False, interpret: bool = False):
+                          *, tb: int = 256, ck: int = 256,
+                          ring: bool = False, native_rng: bool = None,
+                          interpret: bool = False):
     """Matched-filter accumulators for one compacted window per (B, C).
 
     ``sc``: per-window scalars shaped ``[B, C, 1]`` (the compacted form
@@ -250,9 +302,13 @@ def resolve_windows_fused(sc: dict, fused_tables, gs_i, gs_q,
                   ((0, 0), (0, 0), (0, b_pad - B)))
     sigma = jnp.asarray(sigma, jnp.float32)
     inv_ring = jnp.asarray(inv_ring, jnp.float32)
+    if native_rng is None:
+        # the interpret shim stubs prng_random_bits to zeros — silent
+        # no-noise; stream portable threefry noise there instead
+        native_rng = not interpret
 
     acc_i, acc_q, energy = _resolve_call(
         amp, cosa, sina, gsi, gsq, f_idx, addr, nsamp, key, sigma,
-        inv_ring, t_dac, bas, tb, ck, w_pad, ring, interpret)
+        inv_ring, t_dac, bas, tb, ck, w_pad, ring, native_rng, interpret)
     back = lambda a: jnp.transpose(a[:, 0, :B], (1, 0))[..., None]
     return back(acc_i), back(acc_q), back(energy)

@@ -172,6 +172,26 @@ def _alu_vec(op, in0, in1):
         jnp.zeros_like(in0))
 
 
+def program_traits(mp) -> tuple:
+    """Static program facts that let the jitted step body drop whole
+    blocks the program cannot exercise (the sync barrier, the fproc
+    fabric, register-file reads/writes, register-sourced pulse params).
+
+    Hashable — ``(frozenset of instruction kinds, any in0-from-reg,
+    any pulse-param-from-reg)`` — so it rides the jit cache as a static
+    argument.  The bench program (active-reset + RB), for example, has
+    no REG_ALU/JUMP_COND/SYNC/INC_QCLK instructions and sources nothing
+    from registers: its step body skips the sync reductions, all three
+    16-wide register one-hot reads, and the register write-back mask —
+    measured ~15% off the per-step cost and a smaller compile.  ``None``
+    (the default everywhere) means "assume everything present".
+    """
+    soa = mp.soa
+    return (frozenset(int(k) for k in np.unique(np.asarray(soa.kind))),
+            bool(np.any(np.asarray(soa.in0_is_reg))),
+            bool(np.any(np.asarray(soa.p_regsel))))
+
+
 def _program_constants(mp, cfg: InterpreterConfig):
     """Host-side: freeze the decoded program into device constants."""
     soa = jnp.asarray(np.stack(
@@ -237,10 +257,21 @@ def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
 
 
 def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
-          meas_valid, cfg: InterpreterConfig, dev=None) -> dict:
+          meas_valid, cfg: InterpreterConfig, dev=None,
+          traits=None) -> dict:
     B, C = st['pc'].shape
     N = soa.shape[1]
     time, offset, regs = st['time'], st['offset'], st['regs']
+    # static program traits (program_traits): blocks a program cannot
+    # exercise are dropped from the traced body entirely — Python-level
+    # False predicates below, not runtime masks
+    has = (lambda k: True) if traits is None else (lambda k: k in traits[0])
+    any_in0_reg = traits is None or traits[1]
+    any_regsel = traits is None or traits[2]
+    any_fproc = has(isa.K_ALU_FPROC) or has(isa.K_JUMP_FPROC)
+    any_in1_reg = has(isa.K_REG_ALU) or has(isa.K_JUMP_COND)
+    any_regwrite = has(isa.K_REG_ALU) or has(isa.K_ALU_FPROC)
+    has_sync = has(isa.K_SYNC)
 
     # ---- program fetch ------------------------------------------------
     # Small programs: one-hot multiply-reduce over the instruction axis
@@ -264,14 +295,21 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         return _ohsel(regs, _onehot(idx, isa.N_REGS))
 
     # ---- operand fetch -------------------------------------------------
-    in0 = jnp.where(g('in0_is_reg') == 1, reg_read(g('in0_reg')), g('imm'))
+    in0 = jnp.where(g('in0_is_reg') == 1, reg_read(g('in0_reg')),
+                    g('imm')) if any_in0_reg else g('imm')
     qclk = time - offset
     is_fproc = (kind == isa.K_ALU_FPROC) | (kind == isa.K_JUMP_FPROC)
 
     # ---- fproc fabric (reference: hdl/fproc_meas.sv / core_state_mgr.sv /
-    # hdl/fproc_lut.sv, selected statically by cfg.fabric) ---------------
+    # hdl/fproc_lut.sv, selected statically by cfg.fabric; dropped
+    # entirely when the program has no fproc instructions) ---------------
     fid = g('func_id')
     req = time
+    if not any_fproc:
+        fid_bad = f_race = f_deadlock = f_phys = jnp.zeros((), bool)
+        f_ready = jnp.ones((), bool)
+        f_data = jnp.int32(0)
+        f_tready = req
 
     def _by_producer(prod_oh):
         """Select producer-core rows for each reader: [B,C'] -> [B,C]."""
@@ -305,7 +343,9 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
 
     fid_bad = jnp.zeros((B, C), bool)
     f_race = jnp.zeros((B, C), bool)
-    if cfg.fabric == 'sticky':
+    if not any_fproc:
+        pass          # trivial constants above; is_fproc never true
+    elif cfg.fabric == 'sticky':
         # bit latched at read time; producer must have simulated past `req`
         fid_bad = fid >= C
         oh_prod = _onehot(jnp.clip(fid, 0, C - 1), C)
@@ -377,22 +417,28 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     f_phys = f_phys & ~fid_bad
 
     # ---- ALU (in1 mux per reference: hdl/proc.sv:111) ------------------
-    in1 = jnp.where(is_fproc, f_data,
-                    jnp.where(kind == isa.K_INC_QCLK, qclk,
-                              reg_read(g('in1_reg'))))
+    in1 = reg_read(g('in1_reg')) if any_in1_reg else jnp.int32(0)
+    if has(isa.K_INC_QCLK):
+        in1 = jnp.where(kind == isa.K_INC_QCLK, qclk, in1)
+    if any_fproc:
+        in1 = jnp.where(is_fproc, f_data, in1)
     alu_res = _alu_vec(g('alu_op'), in0, in1)
 
     # ---- sync barrier (reference: ctrl.v:510-552 + qclk reset) ---------
-    at_sync = live & (kind == isa.K_SYNC)
-    live_part = sync_part[None, :] & live
-    sync_ready = jnp.any(at_sync, -1) & jnp.all(~live_part | at_sync, -1)
-    release = jnp.max(jnp.where(at_sync, time, -INT32_MAX),
-                      axis=-1, keepdims=True) + QCLK_RST_DELAY      # [B, 1]
-    sync_adv = at_sync & sync_ready[:, None]
-    sync_err = sync_ready & jnp.any(sync_part[None, :] & st['done'], -1)
+    if has_sync:
+        at_sync = live & (kind == isa.K_SYNC)
+        live_part = sync_part[None, :] & live
+        sync_ready = jnp.any(at_sync, -1) \
+            & jnp.all(~live_part | at_sync, -1)
+        release = jnp.max(jnp.where(at_sync, time, -INT32_MAX),
+                          axis=-1, keepdims=True) + QCLK_RST_DELAY  # [B, 1]
+        sync_adv = at_sync & sync_ready[:, None]
+        sync_err = sync_ready & jnp.any(sync_part[None, :] & st['done'], -1)
 
     # ---- stall mask ----------------------------------------------------
-    stalled = (is_fproc & ~f_ready) | (at_sync & ~sync_ready[:, None])
+    stalled = is_fproc & ~f_ready
+    if has_sync:
+        stalled = stalled | (at_sync & ~sync_ready[:, None])
     adv = live & ~stalled                     # cores executing this step
 
     # ---- pulse-register latch + trigger --------------------------------
@@ -402,10 +448,13 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     imm_vals = jnp.stack([g('p_env'), g('p_phase'), g('p_freq'),
                           g('p_amp'), g('p_cfg')], axis=-1)      # [B, C, 5]
     wen = (g('p_wen')[..., None] >> jnp.arange(5)) & 1
-    rsel = (g('p_regsel')[..., None] >> jnp.arange(5)) & 1
-    regval = reg_read(g('p_reg'))
-    cand = jnp.where(rsel == 1, regval[..., None], imm_vals) \
-        & jnp.asarray(_PMASKS)
+    if any_regsel:
+        rsel = (g('p_regsel')[..., None] >> jnp.arange(5)) & 1
+        regval = reg_read(g('p_reg'))
+        cand = jnp.where(rsel == 1, regval[..., None], imm_vals) \
+            & jnp.asarray(_PMASKS)
+    else:
+        cand = imm_vals & jnp.asarray(_PMASKS)
     pp = jnp.where(is_pulse[..., None] & (wen == 1), cand, st['pp'])
 
     cmd_time = g('cmd_time')                  # uint32 bit pattern
@@ -559,9 +608,12 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     idle_end = jnp.maximum(idle_end, time)
 
     # ---- register writeback --------------------------------------------
-    wr_reg = ((kind == isa.K_REG_ALU) | (kind == isa.K_ALU_FPROC)) & adv
-    wr_mask = (_onehot(g('out_reg'), isa.N_REGS) == 1) & wr_reg[..., None]
-    regs = jnp.where(wr_mask, alu_res[..., None], regs)
+    if any_regwrite:
+        wr_reg = ((kind == isa.K_REG_ALU)
+                  | (kind == isa.K_ALU_FPROC)) & adv
+        wr_mask = (_onehot(g('out_reg'), isa.N_REGS) == 1) \
+            & wr_reg[..., None]
+        regs = jnp.where(wr_mask, alu_res[..., None], regs)
 
     # ---- next pc -------------------------------------------------------
     branch_taken = (alu_res & 1) == 1
@@ -571,7 +623,8 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         [g('jump_addr'),
          jnp.where(branch_taken, g('jump_addr'), st['pc'] + 1)],
         st['pc'] + 1)
-    pc_next = jnp.where(sync_adv, st['pc'] + 1, pc_next)
+    if has_sync:
+        pc_next = jnp.where(sync_adv, st['pc'] + 1, pc_next)
     is_done = (kind == isa.K_DONE) & adv
     pc_next = jnp.where(adv & ~is_done, pc_next, st['pc'])
 
@@ -588,21 +641,30 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
          time + cfg.jump_cond_clks,
          f_tready + cfg.jump_fproc_clks],
         time)
-    time_next = jnp.where(sync_adv, release, time_next)
+    if has_sync:
+        time_next = jnp.where(sync_adv, release, time_next)
     time_next = jnp.where(adv, time_next, time)
 
     # inc_qclk loads qclk = alu_res (with hardware pipeline compensation,
     # reference: hdl/qclk.v:17); sync resets qclk to 0 at release
-    offset_next = jnp.where((kind == isa.K_INC_QCLK) & adv,
-                            time - alu_res, offset)
-    offset_next = jnp.where(sync_adv, release, offset_next)
+    offset_next = offset
+    if has(isa.K_INC_QCLK):
+        offset_next = jnp.where((kind == isa.K_INC_QCLK) & adv,
+                                time - alu_res, offset_next)
+    if has_sync:
+        offset_next = jnp.where(sync_adv, release, offset_next)
 
     err = st['err'] | rec_of | meas_of | cw_meas_err \
-        | jnp.where(missed_trig | missed_idle, ERR_MISSED_TRIG, 0) \
-        | jnp.where(is_fproc & adv & fid_bad, ERR_FPROC_ID, 0) \
-        | jnp.where(is_fproc & adv & f_deadlock, ERR_FPROC_DEADLOCK, 0) \
-        | jnp.where(is_fproc & adv & f_race, ERR_STICKY_RACE, 0) \
-        | jnp.where(sync_adv & sync_err[:, None], ERR_SYNC_DONE, 0)
+        | jnp.where(missed_trig | missed_idle, ERR_MISSED_TRIG, 0)
+    if any_fproc:
+        err = err \
+            | jnp.where(is_fproc & adv & fid_bad, ERR_FPROC_ID, 0) \
+            | jnp.where(is_fproc & adv & f_deadlock,
+                        ERR_FPROC_DEADLOCK, 0) \
+            | jnp.where(is_fproc & adv & f_race, ERR_STICKY_RACE, 0)
+    if has_sync:
+        err = err | jnp.where(sync_adv & sync_err[:, None],
+                              ERR_SYNC_DONE, 0)
 
     tr = {}
     if cfg.trace:
@@ -634,7 +696,7 @@ def _split_records(rec) -> dict:
 
 
 def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
-               cfg: InterpreterConfig, dev=None) -> dict:
+               cfg: InterpreterConfig, dev=None, traits=None) -> dict:
     """Run the instruction while_loop until every shot is done (or, in
     physics mode, paused waiting for a measurement bit to be resolved).
 
@@ -654,7 +716,7 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
         steps = st.pop('_steps')
         paused = st.pop('paused') if cfg.physics else None
         st2 = _step(st, steps, soa, spc, interp, sync_part, meas_bits,
-                    meas_valid, cfg, dev)
+                    meas_valid, cfg, dev, traits)
         # quiescence detection per shot: no live core changed state
         same = jnp.all((st2['pc'] == st['pc']) & (st2['time'] == st['time'])
                        & (st2['done'] == st['done']), axis=-1)   # [B]
@@ -694,7 +756,7 @@ def _check_fabric(cfg: InterpreterConfig, n_cores: int):
 
 
 def _run_batch(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
-               n_cores: int, init_regs=None) -> dict:
+               n_cores: int, init_regs=None, traits=None) -> dict:
     """Execute a shot batch: meas_bits ``[B, n_cores, max_meas]``
     (injected a priori and all valid — the cocotb-style path)."""
     _check_fabric(cfg, n_cores)
@@ -705,33 +767,34 @@ def _run_batch(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
         st0['paused'] = jnp.zeros((B,), bool)
     meas_valid = jnp.ones(meas_bits.shape, bool)
     st = _exec_loop(st0, soa, spc, interp, sync_part, meas_bits, meas_valid,
-                    cfg)
+                    cfg, traits=traits)
     st.pop('paused', None)
     return _finalize(st, cfg)
 
 
 def _run(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
-         n_cores: int, init_regs=None) -> dict:
+         n_cores: int, init_regs=None, traits=None) -> dict:
     """Single-shot wrapper: meas_bits ``[n_cores, max_meas]``."""
     if init_regs is not None:
         init_regs = jnp.asarray(init_regs, jnp.int32)[None]
     out = _run_batch(soa, spc, interp, sync_part, meas_bits[None], cfg,
-                     n_cores, init_regs)
+                     n_cores, init_regs, traits)
     return {k: (v if k in ('steps', 'incomplete') else v[0])
             for k, v in out.items()}
 
 
-@functools.partial(jax.jit, static_argnames=('cfg', 'n_cores'))
-def _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, n_cores, init_regs):
+@functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'traits'))
+def _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, n_cores, init_regs,
+             traits=None):
     return _run(soa, spc, interp, sync_part, meas_bits, cfg, n_cores,
-                init_regs)
+                init_regs, traits)
 
 
-@functools.partial(jax.jit, static_argnames=('cfg', 'n_cores'))
+@functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'traits'))
 def _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg, n_cores,
-                   init_regs):
+                   init_regs, traits=None):
     return _run_batch(soa, spc, interp, sync_part, meas_bits, cfg, n_cores,
-                      init_regs)
+                      init_regs, traits)
 
 
 def _pad_meas(meas_bits, max_meas: int):
@@ -766,7 +829,7 @@ def simulate(mp, meas_bits=None, init_regs=None,
         init_regs = jnp.zeros((mp.n_cores, isa.N_REGS), jnp.int32)
     init_regs = jnp.asarray(init_regs, jnp.int32)
     return _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, mp.n_cores,
-                    init_regs)
+                    init_regs, program_traits(mp))
 
 
 def simulate_batch(mp, meas_bits, init_regs=None,
@@ -785,4 +848,4 @@ def simulate_batch(mp, meas_bits, init_regs=None,
             init_regs[None],
             (meas_bits.shape[0],) + tuple(init_regs.shape))
     return _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
-                          mp.n_cores, init_regs)
+                          mp.n_cores, init_regs, program_traits(mp))

@@ -64,7 +64,8 @@ from ..ops.waveform import (PHASE_BITS, AMP_SCALE, complex_to_iq,
                             carrier_phase)
 from .device import DeviceModel
 from .interpreter import (InterpreterConfig, _program_constants, _init_state,
-                          _exec_loop, _finalize, _check_fabric)
+                          _exec_loop, _finalize, _check_fabric,
+                          program_traits)
 
 # default-qchip X90 amplitude word: round(0.48 * (2^16 - 1))
 X90_AMP_DEFAULT = 31457
@@ -115,6 +116,12 @@ class ReadoutPhysics:
     # O(B*C*M*chunk) instead of O(B*C*M*W) — million-shot batches with
     # 2k-sample readout windows fit HBM
     resolve_chunk: int = 512
+    # fused-mode ADC noise generator: None = auto (in-kernel
+    # counter-based RNG on real TPU, streamed threefry under
+    # interpret); True/False forces it.  Static — part of the compiled
+    # program (tests/test_tpu_kernels.py pins the two generators'
+    # statistical parity by compiling both).
+    fused_native_rng: bool = None
     # 'persample': synthesize + demodulate every window sample (the
     # general path — required once the channel model grows structure a
     # matched filter can't collapse).  'fused': the same per-sample
@@ -279,6 +286,41 @@ def _synth_window_chunk(sc: dict, toeplitz, basis, s0, width: int, interps):
         (2, C, F, width))
     s_lane = s0 + jnp.arange(width, dtype=jnp.int32)[None, None, :]
     zero = jnp.float32(0)
+
+    if len(set(interps)) == 1:
+        # homogeneous element geometry (the common case): one batched
+        # formulation over the core axis instead of a per-core Python
+        # unroll — C-fold fewer graph segments (compile time) and one
+        # C-batched MXU matmul for the envelope fetch.  One-hot rows
+        # make the matmul an exact row select, so this is bit-identical
+        # to the per-core path.
+        interp = int(interps[0])
+        T = jnp.stack(toeplitz, 0)                    # [C, 2, R, seg]
+        R = T.shape[2]
+        base = jnp.clip(sc['addr'] + s0 // interp, 0, R - 1)   # [B, C, M]
+        oh = jax.nn.one_hot(base, R, dtype=jnp.float32)        # [B,C,M,R]
+        segs = jnp.einsum('bcmr,cprs->pbcms', oh, T,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+        rep = lambda a: jnp.repeat(a, interp, axis=-1)[..., :width]
+        e_i, e_q = rep(segs[0]), rep(segs[1])         # [B, C, M, width]
+        bc = jnp.broadcast_to(bslice[0, :, 0][None, :, None, :],
+                              (B, C, M, width))
+        bs = jnp.broadcast_to(bslice[1, :, 0][None, :, None, :],
+                              (B, C, M, width))
+        for f in range(1, F):
+            m = (sc['f_idx'] == f)[..., None]
+            bc = jnp.where(m, bslice[0, :, f][None, :, None, :], bc)
+            bs = jnp.where(m, bslice[1, :, f][None, :, None, :], bs)
+        cosA, sinA = sc['cosA'][..., None], sc['sinA'][..., None]
+        cth = cosA * bc - sinA * bs
+        sth = sinA * bc + cosA * bs
+        amp = sc['amp'][..., None]
+        in_win = s_lane[:, :, None, :] < sc['n_samp'][..., None]
+        y_i = jnp.where(in_win, amp * (e_i * cth - e_q * sth), zero)
+        y_q = jnp.where(in_win, amp * (e_i * sth + e_q * cth), zero)
+        return y_i, y_q
+
     y_is, y_qs = [], []
     # everything per core stays [B, M, width] and fuses into the two
     # final stacks — materializing separate env and carrier stacks
@@ -457,7 +499,8 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
 
 
 def _resolve_fused(st: dict, bits, valid, key, tables, fused_tables,
-                   response, W: int, Lp: int, ck: int, ring: bool = False):
+                   response, W: int, Lp: int, ck: int, ring: bool = False,
+                   native_rng: bool = None):
     """Slot-compacted resolve through the fused Pallas kernel
     (:func:`..ops.resolve_pallas.resolve_windows_fused`): same
     per-sample chain as :func:`_resolve` with every intermediate in
@@ -475,7 +518,7 @@ def _resolve_fused(st: dict, bits, valid, key, tables, fused_tables,
                    g1[None, :, :], g0[None, :, :])            # [B, C, 2]
     acc_i, acc_q, energy = resolve_windows_fused(
         sc, fused_tables, gs[..., 0], gs[..., 1], sigma, inv_ring, key,
-        W, Lp, ck=ck, ring=ring,
+        W, Lp, ck=ck, ring=ring, native_rng=native_rng,
         interpret=jax.default_backend() != 'tpu')
     new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)[..., 0]
     return _scatter_slot_bit(bits, valid, new_bit, oh_slot, has_pending)
@@ -558,17 +601,56 @@ def _resolve_analytic(st: dict, bits, valid, key, tables, env_pads,
     return bits, valid | fired
 
 
+def _build_mode_tables(env_stack, freq_stack, mode: str, W: int,
+                       chunk: int, interps: tuple) -> dict:
+    """Per-mode resolve tables: padded env planes plus the mode's
+    precomputed lookup structures (Toeplitz windows + carrier basis for
+    'persample'; the DAC-resolution kernel tables for 'fused').
+
+    Split out of the main program so callers can build them in a
+    SEPARATE small jit and pass them to :func:`run_physics_batch` as
+    ``tables=``: the gather-heavy table construction inside the big
+    epoch-loop module measured ~30 s of extra XLA compile time at bench
+    shapes, and rebuilding [C, 2, R, W] tables every batch is wasted
+    runtime — built once, they are plain device arrays reused across
+    batches (:func:`prepare_physics_tables`).
+    """
+    env_pads = _pad_env_planes(env_stack, _aligned_chunk(chunk, W, interps))
+    tabs = {'env_pads': env_pads}
+    if mode == 'persample':
+        chunk_a = _aligned_chunk(chunk, W, interps)
+        tabs['toeplitz'] = tuple(_toeplitz_tables(env_pads, chunk_a,
+                                                  interps))
+        tabs['basis'] = _carrier_basis(freq_stack,
+                                       -(-W // chunk_a) * chunk_a)
+    elif mode == 'fused':
+        from ..ops.resolve_pallas import build_fused_tables, fused_chunk
+        ck = fused_chunk(chunk, W)
+        t_dac, bas, _ = build_fused_tables(
+            env_pads, _carrier_basis(freq_stack, W), W, interps, ck)
+        tabs['t_dac'], tabs['bas'] = t_dac, bas
+    return tabs
+
+
+_build_tables_jit = functools.partial(
+    jax.jit, static_argnames=('mode', 'W', 'chunk', 'interps'))(
+        _build_mode_tables)
+
+
 @functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'W',
                                              'max_epochs', 'chunk',
                                              'spcs', 'interps', 'mode',
-                                             'ring'))
+                                             'ring', 'traits',
+                                             'native_rng'))
 def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
-                     env_stack, freq_stack, g0, g1, sigma, inv_ring,
+                     tabs, freq_stack, g0, g1, sigma, inv_ring,
                      key, dev_params, meas_u,
                      cfg: InterpreterConfig, n_cores: int, W: int,
                      max_epochs: int, chunk: int = None,
                      spcs: tuple = (), interps: tuple = (),
-                     mode: str = 'persample', ring: bool = False) -> dict:
+                     mode: str = 'persample', ring: bool = False,
+                     traits: tuple = None,
+                     native_rng: bool = None) -> dict:
     B = init_states.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
@@ -584,22 +666,19 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
     st0['paused'] = jnp.zeros((B,), bool)
     bits0 = jnp.zeros((B, C, M), jnp.int32)
     valid0 = jnp.zeros((B, C, M), bool)
-    tables = (env_stack, freq_stack,
+    # tables arrive prebuilt (tabs) — _window_scalars only needs the
+    # frequency table and element geometry from this tuple
+    tables = (None, freq_stack,
               jnp.asarray(spcs, jnp.int32), jnp.asarray(interps, jnp.int32))
-    env_pads = _pad_env_planes(env_stack, _aligned_chunk(chunk, W, interps))
+    env_pads = tabs['env_pads']
     response = (g0, g1, sigma, inv_ring)
     if mode == 'fused':
-        # kernel constants built once, outside the epoch while_loop
-        from ..ops.resolve_pallas import build_fused_tables, fused_chunk
+        from ..ops.resolve_pallas import fused_chunk
         ck = fused_chunk(chunk, W)
-        fused_tables = build_fused_tables(
-            env_pads, _carrier_basis(freq_stack, W), W, interps, ck)
+        fused_tables = (tabs['t_dac'], tabs['bas'], tabs['t_dac'].shape[3])
         lp = env_pads[0].shape[1]
     elif mode == 'persample':
-        # same hoist for the XLA path's (smaller) tables
-        chunk_a = _aligned_chunk(chunk, W, interps)
-        prebuilt = (_toeplitz_tables(env_pads, chunk_a, interps),
-                    _carrier_basis(freq_stack, -(-W // chunk_a) * chunk_a))
+        prebuilt = (tabs['toeplitz'], tabs['basis'])
 
     def cond(carry):
         st, bits, valid, ep = carry
@@ -618,13 +697,14 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
     def body(carry):
         st, bits, valid, ep = carry
         st = _exec_loop(st, soa, spc, interp, sync_part, bits, valid, cfg,
-                        dev)
+                        dev, traits)
         if mode == 'analytic':
             bits, valid = _resolve_analytic(st, bits, valid, key, tables,
                                             env_pads, response, W)
         elif mode == 'fused':
-            bits, valid = _resolve_fused(st, bits, valid, jax.random.fold_in(
-                key, ep), tables, fused_tables, response, W, lp, ck, ring)
+            bits, valid = _resolve_fused(
+                st, bits, valid, jax.random.fold_in(key, ep), tables,
+                fused_tables, response, W, lp, ck, ring, native_rng)
         else:
             bits, valid = _resolve(st, bits, valid, jax.random.fold_in(
                 key, ep), tables, env_pads, response, W, chunk, interps,
@@ -676,9 +756,29 @@ def physics_config(base: InterpreterConfig, model: ReadoutPhysics,
                    **overrides, **kw)
 
 
+def prepare_physics_tables(mp, model: ReadoutPhysics) -> dict:
+    """Build the resolve tables for ``(mp, model)`` once, eagerly, in
+    their own small jit — pass the result to :func:`run_physics_batch`
+    as ``tables=`` when the batch call itself is wrapped in an outer
+    ``jax.jit`` (a bench/sweep step): the big program then takes the
+    tables as plain device-array arguments instead of re-deriving them,
+    which both removes the gather-heavy construction from its XLA
+    module (~30 s less compile at bench shapes) and stops rebuilding
+    them every batch.  Tables depend only on the program's envelope /
+    frequency content and the model's meas_elem / window / mode — not
+    on the interpreter config."""
+    env_stack, freq_stack, spc_m, interp_m, w_auto = \
+        _physics_tables(mp, model.meas_elem)
+    W = int(model.window_samples or w_auto)
+    return _build_tables_jit(
+        env_stack, freq_stack, model.resolve_mode, W, model.resolve_chunk,
+        tuple(int(x) for x in np.asarray(interp_m)))
+
+
 def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
                       init_states=None, init_regs=None,
-                      cfg: InterpreterConfig = None, **kw) -> dict:
+                      cfg: InterpreterConfig = None, tables: dict = None,
+                      **kw) -> dict:
     """Execute ``shots`` shots with the measurement loop closed by DSP.
 
     No measurement bits are injected: readout windows are synthesized,
@@ -687,6 +787,11 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     initial qubit states (default: thermal sampling at ``model.p1_init``).
     ``init_regs``: optional initial register file (``[n_cores, 16]`` or
     with a leading shot axis) — the register-parameterized sweep hook.
+    ``tables``: optional prebuilt resolve tables
+    (:func:`prepare_physics_tables`) — pass them when wrapping this
+    call in an outer jit so the table construction stays out of the
+    stepped program; left ``None``, they are built here (as a separate
+    small jit when called eagerly, inline under an outer trace).
 
     Returns the interpreter's final state plus ``meas_bits`` /
     ``meas_bits_valid`` (the resolved bits per measurement slot),
@@ -746,12 +851,19 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
             'the structured channel', stacklevel=2)
     inv_ring = jnp.float32(0.0 if model.ring_tau <= 0
                            else 1.0 / model.ring_tau)
+    interps = tuple(int(x) for x in np.asarray(interp_m))
+    if tables is None:
+        # eager call: separate small compile; under an outer trace this
+        # inlines (the status quo for jit-wrapped callers)
+        tables = _build_tables_jit(env_stack, freq_stack,
+                                   model.resolve_mode, W,
+                                   model.resolve_chunk, interps)
     return _run_physics_jit(
-        soa, spc, interp, sync_part, init_states, init_regs, env_stack,
+        soa, spc, interp, sync_part, init_states, init_regs, tables,
         freq_stack, as_iq(model.g0), as_iq(model.g1),
         jnp.float32(model.sigma), inv_ring, key_noise, dev_params, meas_u,
         cfg, C, W,
         C * cfg.max_meas + 1, model.resolve_chunk,
-        tuple(int(x) for x in np.asarray(spc_m)),
-        tuple(int(x) for x in np.asarray(interp_m)),
-        model.resolve_mode, model.ring_tau > 0)
+        tuple(int(x) for x in np.asarray(spc_m)), interps,
+        model.resolve_mode, model.ring_tau > 0, program_traits(mp),
+        model.fused_native_rng)
