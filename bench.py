@@ -183,11 +183,13 @@ class _ModeStep:
     arrays, keeping their gather-heavy construction out of the stepped
     module and off the per-batch path."""
 
-    def __init__(self, mp, cfg, batch, sigma, chunk, mode):
+    def __init__(self, mp, cfg, batch, sigma, chunk, mode,
+                 device=None):
         self.mode = mode
+        kw = {} if device is None else {'device': device}
         self.model = ReadoutPhysics(sigma=sigma, p1_init=0.15,
                                     resolve_chunk=chunk,
-                                    resolve_mode=mode)
+                                    resolve_mode=mode, **kw)
         t0 = time.perf_counter()
         self.tables = jax.block_until_ready(
             prepare_physics_tables(mp, self.model))
@@ -256,12 +258,18 @@ def utilization_accounting(mp, cfg, model, batch: int,
     call; docs/PERF.md derives each formula and states what each phase
     is bound by.
     """
+    from dataclasses import replace
+    from distributed_processor_tpu.sim.device import DeviceModel
     from distributed_processor_tpu.sim.interpreter import (
         _run_batch, _program_constants, _init_state, program_traits)
     from distributed_processor_tpu.sim.physics import (physics_config,
                                                        _physics_tables)
     C = mp.n_cores
-    pcfg = physics_config(cfg, model)
+    # the exec probe injects bits (no resolver), which has no device
+    # co-state to evolve — measure the phase with the parity counter
+    # regardless of the headline's device model
+    pcfg = physics_config(cfg, replace(model,
+                                       device=DeviceModel('parity')))
     soa, spc, interp, sync_part = _program_constants(mp, pcfg)
     traits = program_traits(mp)
 
@@ -399,14 +407,33 @@ def main():
         headline_mode = 'persample'
     C = mp.n_cores
     on_tpu = jax.devices()[0].platform == 'tpu'
+    # BENCH_DEVICE=bloch runs the headline on the SU(2) device co-state
+    # (phase-sensitive rotations, detuning/T1/T2, projective
+    # measurement — sim/device.py) instead of the parity counter;
+    # measured ~3% slower at bench shapes (the bloch_shots_per_sec
+    # secondary reports it either way)
+    bench_device = os.environ.get('BENCH_DEVICE', 'parity')
+
+    def _device_model(kind):
+        from distributed_processor_tpu.sim.device import DeviceModel
+        if kind == 'bloch':
+            return DeviceModel('bloch', t1_s=80e-6, t2_s=40e-6,
+                               depol_per_pulse=0.002)
+        if kind != 'parity':
+            raise SystemExit(
+                f'BENCH_DEVICE={kind!r}: unknown device model '
+                f"(one of 'parity', 'bloch')")
+        return DeviceModel('parity')
 
     # one compiled step per mode, shared by race + headline + secondaries
     steps: dict = {}
 
-    def mode_step(mode) -> _ModeStep:
-        if mode not in steps:
-            steps[mode] = _ModeStep(mp, cfg, batch, sigma, chunk, mode)
-        return steps[mode]
+    def mode_step(mode, device=bench_device) -> _ModeStep:
+        key = (mode, device)
+        if key not in steps:
+            steps[key] = _ModeStep(mp, cfg, batch, sigma, chunk, mode,
+                                   _device_model(device))
+        return steps[key]
 
     if headline_mode == 'auto':
         # the XLA and fused-Pallas formulations of the same per-sample
@@ -493,6 +520,25 @@ def main():
         except Exception as e:      # pragma: no cover - defensive
             secondary_sps[sec_mode] = f'{type(e).__name__}: {e}'[:120]
 
+    # the SU(2) device co-state at full scale (headline resolve mode,
+    # detuning/T1/T2/depol parameters set): how much the physical qubit
+    # model costs over the parity counter — guarded like the others
+    other_device = 'parity' if bench_device == 'bloch' else 'bloch'
+    try:
+        bstep = mode_step(headline_mode, other_device)
+        keyb = jax.random.PRNGKey(2)
+        int(bstep.warm_up(keyb)[1])
+        times = []
+        for _ in range(2):
+            keyb, sub = jax.random.split(keyb)
+            t0 = time.perf_counter()
+            bres = jax.block_until_ready(bstep(sub))
+            assert not int(bres[5]), f'{other_device} batch incomplete'
+            times.append(time.perf_counter() - t0)
+        other_device_sps = batch / min(times)
+    except Exception as e:      # pragma: no cover - defensive
+        other_device_sps = f'{type(e).__name__}: {e}'[:120]
+
     # guarded: a failure here must not discard the minutes of headline
     # measurement already taken
     try:
@@ -520,10 +566,14 @@ def main():
             'epochs': int(res[4]), 'sigma': sigma,
             'meas1_frac': round(bit1_frac, 4),
             'resolve_mode': model.resolve_mode,
+            'device_model': bench_device,
+            f'{other_device}_device_shots_per_sec':
+                _fmt_sps(other_device_sps),
             'compile_s': round(t_compile, 3), 'jit_s': round(t_jit, 3),
             'tables_s': round(step.tables_s, 3),
-            'mode_jit_s': {m: (round(s.jit_s, 3) if s.jit_s else None)
-                           for m, s in steps.items()},
+            'mode_jit_s': {(m if d == 'parity' else f'{m}/{d}'):
+                           (round(s.jit_s, 3) if s.jit_s else None)
+                           for (m, d), s in steps.items()},
             'compilation_cache': cache_state,
             'run_s': round(elapsed, 3), 'err_shots': err_total,
             'persample_xla_shots_per_sec':

@@ -306,6 +306,11 @@ def resolve_windows_fused(sc: dict, fused_tables, gs_i, gs_q,
         # the interpret shim stubs prng_random_bits to zeros — silent
         # no-noise; stream portable threefry noise there instead
         native_rng = not interpret
+    elif native_rng and interpret:
+        raise ValueError(
+            'native_rng=True under interpret mode would silently disable '
+            'ADC noise (the interpret shim stubs prng_random_bits to '
+            'zeros) — use the streamed generator off-TPU')
 
     acc_i, acc_q, energy = _resolve_call(
         amp, cosa, sina, gsi, gsq, f_idx, addr, nsamp, key, sigma,
