@@ -304,15 +304,24 @@ def utilization_accounting(mp, cfg, model, batch: int,
     exec_gbps = exec_bytes / t_exec / 1e9
 
     # resolve phase (per epoch), derived from the kernel structure: the
-    # envelope fetch is one_hot[lanes, R] @ T[R, W'] per plane — R*W'
-    # MACs per (shot, core) lane — plus O(W) elementwise carrier/noise
+    # envelope fetch is a static-address row select when the program's
+    # envelope words are statically known (physics._static_meas_env_addrs
+    # — R_eff rows of elementwise selects), else a one_hot[lanes, R] @
+    # T[R, W'] MXU matmul; plus O(W) elementwise carrier/noise/filter
+    from distributed_processor_tpu.sim.physics import \
+        _static_meas_env_addrs
     env_stack, freq_stack, _spc, interp_m, w_auto = \
         _physics_tables(mp, model.meas_elem)
     W = int(model.window_samples or w_auto)
-    Lp = env_stack.shape[1] + 64                     # padded planes (est)
-    R = -(-Lp // 128) * 128
     Wp = -(-W // 256) * 256
-    synth_flops = batch * C * R * Wp * 2 * 2        # 2 planes, 2 flop/MAC
+    rows = _static_meas_env_addrs(mp)
+    if rows is not None and model.resolve_mode == 'fused':
+        R = -(-max(len(rows), 8) // 8) * 8          # compact row table
+        synth_flops = batch * C * Wp * 2 * max(len(rows) - 1, 1)
+    else:
+        Lp = env_stack.shape[1] + 64                 # padded planes (est)
+        R = -(-Lp // 128) * 128
+        synth_flops = batch * C * R * Wp * 2 * 2    # 2 planes, 2 flop/MAC
     elem_flops = batch * C * Wp * 24                # carrier+filter+noise
     res_flops = synth_flops + elem_flops
     res_bytes = (batch * C * 4 * (11 + 6)           # lane args + acc r/w
@@ -330,9 +339,14 @@ def utilization_accounting(mp, cfg, model, batch: int,
             round(res_flops / t_resolve / V5E_BF16_FLOPS, 3),
         'resolve_hbm_gbps': round(res_bytes / t_resolve / 1e9, 1),
         'note': 'exec is int32 control flow (VPU/latency-bound, no MXU '
-                'work by construction); resolve rides the MXU via the '
-                'one-hot envelope fetch at f32-HIGHEST — see '
-                'docs/PERF.md for derivations and the roofline position',
+                'work by construction); '
+                + (f'resolve fetches envelopes via a {len(rows)}-way '
+                   f'static-address row select (zero MXU work)'
+                   if rows is not None and model.resolve_mode == 'fused'
+                   else 'resolve rides the MXU via the one-hot envelope '
+                        'fetch at f32-HIGHEST')
+                + ' — see docs/PERF.md for derivations and the roofline '
+                  'position',
     }
 
 

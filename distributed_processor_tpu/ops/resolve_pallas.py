@@ -65,29 +65,44 @@ except ImportError:      # pragma: no cover - pallas ships with jax
 def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
             fidx_ref, addr_ref, nsamp_ref, s0_ref, ring_ref,
             sig_ref, seed_ref, t_ref, bas_ref, *rest,
-            tb: int, ck: int, n_f: int, ring: bool, native_rng: bool):
+            tb: int, ck: int, n_f: int, ring: bool, native_rng: bool,
+            rows: tuple):
     if native_rng:
         (acc_i_in, acc_q_in, energy_in,
          acc_i_ref, acc_q_ref, energy_ref) = rest
     else:
         (nz_ref, acc_i_in, acc_q_in, energy_in,
          acc_i_ref, acc_q_ref, energy_ref) = rest
-    # ---- envelope: one-hot(addr) @ Toeplitz on the MXU -----------------
-    r_rows = t_ref.shape[2]
     addr = addr_ref[0, 0, :]                                  # [TB] int32
-    oh = (addr[:, None]
-          == jax.lax.broadcasted_iota(jnp.int32, (tb, r_rows), 1)
-          ).astype(jnp.float32)
-    # HIGHEST: bf16 operand rounding would quantize env samples past the
-    # synthesize_element parity tolerance (the one-hot side is exact)
-    e_i = jax.lax.dot_general(
-        oh, t_ref[0, 0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST)                  # [TB, CK]
-    e_q = jax.lax.dot_general(
-        oh, t_ref[0, 1], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST)
+    if rows is not None:
+        # ---- envelope: static-address row select ----------------------
+        # the program's envelope latch can only hold these addresses
+        # (physics._static_meas_env_addrs, a sound over-approximation),
+        # so the fetch is a len(rows)-way equality select — for a
+        # single-envelope program, one broadcast row, zero MXU work
+        e_i = jnp.broadcast_to(t_ref[0, 0, 0][None, :], (tb, ck))
+        e_q = jnp.broadcast_to(t_ref[0, 1, 0][None, :], (tb, ck))
+        for ridx in range(1, len(rows)):
+            selr = (addr == rows[ridx])[:, None]
+            e_i = jnp.where(selr, t_ref[0, 0, ridx][None, :], e_i)
+            e_q = jnp.where(selr, t_ref[0, 1, ridx][None, :], e_q)
+    else:
+        # ---- envelope: one-hot(addr) @ Toeplitz on the MXU -------------
+        r_rows = t_ref.shape[2]
+        oh = (addr[:, None]
+              == jax.lax.broadcasted_iota(jnp.int32, (tb, r_rows), 1)
+              ).astype(jnp.float32)
+        # HIGHEST: bf16 operand rounding would quantize env samples past
+        # the synthesize_element parity tolerance (the one-hot side is
+        # exact)
+        e_i = jax.lax.dot_general(
+            oh, t_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)              # [TB, CK]
+        e_q = jax.lax.dot_general(
+            oh, t_ref[0, 1], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
 
     # ---- carrier: basis row select (F is tiny), scalar rotation --------
     f_idx = fidx_ref[0, 0, :]                                 # [TB]
@@ -164,10 +179,10 @@ def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
 
 @functools.partial(
     jax.jit, static_argnames=('tb', 'ck', 'w_pad', 'ring', 'native_rng',
-                              'interpret'))
+                              'rows', 'interpret'))
 def _resolve_call(amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
                   key, sigma, inv_ring, t_dac, basis, tb, ck, w_pad,
-                  ring, native_rng, interpret):
+                  ring, native_rng, rows, interpret):
     C, _, B = amp.shape
     n_chunks = w_pad // ck
     R = t_dac.shape[2]
@@ -181,7 +196,7 @@ def _resolve_call(amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     call = pl.pallas_call(
         functools.partial(_kernel, tb=tb, ck=ck, n_f=F, ring=ring,
-                          native_rng=native_rng),
+                          native_rng=native_rng, rows=rows),
         grid=(C, B // tb),
         in_specs=[lane_spec] * 8 + [smem] * 4 + [
             pl.BlockSpec((1, 2, R, ck), lambda c, t: (c, 0, 0, 0)),
@@ -229,7 +244,8 @@ def fused_chunk(chunk, W: int) -> int:
     return _round_up(min(chunk or W, W), 128)
 
 
-def build_fused_tables(env_pads, basis, W: int, interps, ck: int):
+def build_fused_tables(env_pads, basis, W: int, interps, ck: int,
+                       rows: tuple = None):
     """Kernel constants for :func:`resolve_windows_fused` — build ONCE
     per run, outside the epoch while_loop (XLA does not hoist the
     gathers out of while bodies; rebuilding per epoch would re-pay the
@@ -240,16 +256,29 @@ def build_fused_tables(env_pads, basis, W: int, interps, ck: int):
     ``T[c, p, r, j] = env_p[c, r + j//interp]`` (hold-last-sample
     overrun via the clamped env index), the stacked carrier basis
     ``[C, 2, F, Wp]``, and the chunk-aligned window length.
+
+    ``rows``: optional static envelope-address list
+    (physics._static_meas_env_addrs) — the table then carries ONLY
+    those start rows (``T[c, p, i, j] = env_p[c, rows[i] + j//interp]``,
+    padded to the 8-sublane tile by repeating the last row) and the
+    kernel selects by address equality instead of a [lanes, R] one-hot
+    matmul.
     """
     env_i_pad, env_q_pad = env_pads
     C, Lp = env_i_pad.shape
     w_pad = _round_up(W, ck)
-    r_rows = _round_up(Lp, 128)
+    if rows is not None:
+        r_rows = _round_up(max(len(rows), 8), 8)
+        starts = np.asarray(list(rows) + [rows[-1]]
+                            * (r_rows - len(rows)))[:, None]
+    else:
+        r_rows = _round_up(Lp, 128)
+        starts = np.arange(r_rows)[:, None]
     ts = []
     for c in range(C):
         interp = int(interps[c])
         j_env = np.arange(w_pad) // interp
-        win = np.minimum(np.arange(r_rows)[:, None] + j_env[None, :], Lp - 1)
+        win = np.minimum(starts + j_env[None, :], Lp - 1)
         win_j = jnp.asarray(win)
         ts.append(jnp.stack([env_i_pad[c][win_j], env_q_pad[c][win_j]], 0))
     t_dac = jnp.stack(ts, 0)                        # [C, 2, R, Wp]
@@ -267,7 +296,7 @@ def resolve_windows_fused(sc: dict, fused_tables, gs_i, gs_q,
                           sigma, inv_ring, key, W: int, Lp: int,
                           *, tb: int = 256, ck: int = 256,
                           ring: bool = False, native_rng: bool = None,
-                          interpret: bool = False):
+                          rows: tuple = None, interpret: bool = False):
     """Matched-filter accumulators for one compacted window per (B, C).
 
     ``sc``: per-window scalars shaped ``[B, C, 1]`` (the compacted form
@@ -294,7 +323,10 @@ def resolve_windows_fused(sc: dict, fused_tables, gs_i, gs_q,
     cosa = lanes(sc['cosA'], jnp.float32)
     sina = lanes(sc['sinA'], jnp.float32)
     f_idx = lanes(sc['f_idx'], jnp.int32)
-    addr = lanes(jnp.clip(sc['addr'], 0, Lp - 1), jnp.int32)
+    # compact-rows mode compares raw addresses against the static row
+    # values; the one-hot mode clips into the Toeplitz row range
+    addr = lanes(sc['addr'] if rows is not None
+                 else jnp.clip(sc['addr'], 0, Lp - 1), jnp.int32)
     nsamp = lanes(jnp.minimum(sc['n_samp'], W), jnp.int32)
     gsi = jnp.pad(jnp.transpose(gs_i, (1, 0))[:, None, :],
                   ((0, 0), (0, 0), (0, b_pad - B)))
@@ -314,6 +346,7 @@ def resolve_windows_fused(sc: dict, fused_tables, gs_i, gs_q,
 
     acc_i, acc_q, energy = _resolve_call(
         amp, cosa, sina, gsi, gsq, f_idx, addr, nsamp, key, sigma,
-        inv_ring, t_dac, bas, tb, ck, w_pad, ring, native_rng, interpret)
+        inv_ring, t_dac, bas, tb, ck, w_pad, ring, native_rng, rows,
+        interpret)
     back = lambda a: jnp.transpose(a[:, 0, :B], (1, 0))[..., None]
     return back(acc_i), back(acc_q), back(energy)

@@ -500,7 +500,7 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
 
 def _resolve_fused(st: dict, bits, valid, key, tables, fused_tables,
                    response, W: int, Lp: int, ck: int, ring: bool = False,
-                   native_rng: bool = None):
+                   native_rng: bool = None, rows: tuple = None):
     """Slot-compacted resolve through the fused Pallas kernel
     (:func:`..ops.resolve_pallas.resolve_windows_fused`): same
     per-sample chain as :func:`_resolve` with every intermediate in
@@ -518,7 +518,7 @@ def _resolve_fused(st: dict, bits, valid, key, tables, fused_tables,
                    g1[None, :, :], g0[None, :, :])            # [B, C, 2]
     acc_i, acc_q, energy = resolve_windows_fused(
         sc, fused_tables, gs[..., 0], gs[..., 1], sigma, inv_ring, key,
-        W, Lp, ck=ck, ring=ring, native_rng=native_rng,
+        W, Lp, ck=ck, ring=ring, native_rng=native_rng, rows=rows,
         interpret=jax.default_backend() != 'tpu')
     new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)[..., 0]
     return _scatter_slot_bit(bits, valid, new_bit, oh_slot, has_pending)
@@ -601,8 +601,34 @@ def _resolve_analytic(st: dict, bits, valid, key, tables, env_pads,
     return bits, valid | fired
 
 
+def _static_meas_env_addrs(mp, max_rows: int = 8):
+    """The set of envelope-table addresses the resolver can ever see,
+    derived statically from the program — or ``None`` when not
+    derivable.
+
+    Sound over-approximation: the pulse env latch only ever holds its
+    initial 0 or an immediate the program writes (``p_env`` values at
+    instructions whose write-enable includes the env field,
+    PULSE_PARAM_ORDER bit 0) — unless some env write sources the word
+    from a register, in which case the value set is data-dependent and
+    this returns ``None`` (the resolver falls back to the full
+    Toeplitz row range).  Most programs use a handful of envelopes, so
+    the fused kernel's envelope fetch collapses from a [lanes, R=384]
+    one-hot matmul to a ``len(addrs)``-way row select — for the bench
+    program (every envelope at table offset 0) a single broadcast row.
+    """
+    soa = mp.soa
+    wen_env = (np.asarray(soa.p_wen) & 1) == 1
+    if np.any(((np.asarray(soa.p_regsel) & 1) == 1) & wen_env):
+        return None
+    words = np.asarray(soa.p_env)[wen_env]
+    addrs = sorted({0} | {int((w & 0xfff) * 4) for w in words.ravel()})
+    return tuple(addrs) if len(addrs) <= max_rows else None
+
+
 def _build_mode_tables(env_stack, freq_stack, mode: str, W: int,
-                       chunk: int, interps: tuple) -> dict:
+                       chunk: int, interps: tuple,
+                       rows: tuple = None) -> dict:
     """Per-mode resolve tables: padded env planes plus the mode's
     precomputed lookup structures (Toeplitz windows + carrier basis for
     'persample'; the DAC-resolution kernel tables for 'fused').
@@ -627,13 +653,20 @@ def _build_mode_tables(env_stack, freq_stack, mode: str, W: int,
         from ..ops.resolve_pallas import build_fused_tables, fused_chunk
         ck = fused_chunk(chunk, W)
         t_dac, bas, _ = build_fused_tables(
-            env_pads, _carrier_basis(freq_stack, W), W, interps, ck)
+            env_pads, _carrier_basis(freq_stack, W), W, interps, ck,
+            rows=rows)
         tabs['t_dac'], tabs['bas'] = t_dac, bas
+        # the row ADDRESSES the table was built for, carried with it:
+        # the kernel's equality select is only correct against these
+        # exact values, so run_physics_batch cross-checks them when
+        # prebuilt tables are passed in
+        tabs['rows'] = jnp.asarray([-1] if rows is None else list(rows),
+                                   jnp.int32)
     return tabs
 
 
 _build_tables_jit = functools.partial(
-    jax.jit, static_argnames=('mode', 'W', 'chunk', 'interps'))(
+    jax.jit, static_argnames=('mode', 'W', 'chunk', 'interps', 'rows'))(
         _build_mode_tables)
 
 
@@ -641,7 +674,7 @@ _build_tables_jit = functools.partial(
                                              'max_epochs', 'chunk',
                                              'spcs', 'interps', 'mode',
                                              'ring', 'traits',
-                                             'native_rng'))
+                                             'native_rng', 'rows'))
 def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      tabs, freq_stack, g0, g1, sigma, inv_ring,
                      key, dev_params, meas_u,
@@ -650,7 +683,7 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      spcs: tuple = (), interps: tuple = (),
                      mode: str = 'persample', ring: bool = False,
                      traits: tuple = None,
-                     native_rng: bool = None) -> dict:
+                     native_rng: bool = None, rows: tuple = None) -> dict:
     B = init_states.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
@@ -704,7 +737,7 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
         elif mode == 'fused':
             bits, valid = _resolve_fused(
                 st, bits, valid, jax.random.fold_in(key, ep), tables,
-                fused_tables, response, W, lp, ck, ring, native_rng)
+                fused_tables, response, W, lp, ck, ring, native_rng, rows)
         else:
             bits, valid = _resolve(st, bits, valid, jax.random.fold_in(
                 key, ep), tables, env_pads, response, W, chunk, interps,
@@ -772,7 +805,9 @@ def prepare_physics_tables(mp, model: ReadoutPhysics) -> dict:
     W = int(model.window_samples or w_auto)
     return _build_tables_jit(
         env_stack, freq_stack, model.resolve_mode, W, model.resolve_chunk,
-        tuple(int(x) for x in np.asarray(interp_m)))
+        tuple(int(x) for x in np.asarray(interp_m)),
+        _static_meas_env_addrs(mp) if model.resolve_mode == 'fused'
+        else None)
 
 
 def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
@@ -852,12 +887,27 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     inv_ring = jnp.float32(0.0 if model.ring_tau <= 0
                            else 1.0 / model.ring_tau)
     interps = tuple(int(x) for x in np.asarray(interp_m))
+    rows = _static_meas_env_addrs(mp) if model.resolve_mode == 'fused' \
+        else None
+    if tables is not None and model.resolve_mode == 'fused' \
+            and not isinstance(tables.get('rows'), jax.core.Tracer):
+        # prebuilt tables must have been built for THIS program's static
+        # envelope addresses — the kernel's row select silently reads
+        # the wrong envelope otherwise
+        want = [-1] if rows is None else list(rows)
+        have = np.asarray(tables['rows']).tolist() \
+            if 'rows' in tables else None
+        if have != want:
+            raise ValueError(
+                f'prebuilt tables were built for envelope addresses '
+                f'{have}, but this program/model needs {want} — '
+                f'rebuild with prepare_physics_tables(mp, model)')
     if tables is None:
         # eager call: separate small compile; under an outer trace this
         # inlines (the status quo for jit-wrapped callers)
         tables = _build_tables_jit(env_stack, freq_stack,
                                    model.resolve_mode, W,
-                                   model.resolve_chunk, interps)
+                                   model.resolve_chunk, interps, rows)
     return _run_physics_jit(
         soa, spc, interp, sync_part, init_states, init_regs, tables,
         freq_stack, as_iq(model.g0), as_iq(model.g1),
@@ -866,4 +916,4 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         C * cfg.max_meas + 1, model.resolve_chunk,
         tuple(int(x) for x in np.asarray(spc_m)), interps,
         model.resolve_mode, model.ring_tau > 0, program_traits(mp),
-        model.fused_native_rng)
+        model.fused_native_rng, rows)

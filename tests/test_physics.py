@@ -374,3 +374,68 @@ def test_cw_measurement_pulse_flagged():
     out2 = simulate(mp, meas_bits=np.array([[1, 0]]), max_steps=32,
                     max_pulses=4, max_meas=2)
     assert int(np.asarray(out2['err'])[0]) & ERR_CW_MEAS == 0
+
+
+def _two_envelope_mp():
+    """One core, two readout gates with different envelope lengths —
+    two distinct envelope-table addresses on the measurement element."""
+    import copy
+    from distributed_processor_tpu.qchip import Gate, _entry_from_dict
+    sim = Simulator(n_qubits=1)
+    entries = sim.qchip.gates['Q0read'].to_dict()
+    g2 = copy.deepcopy(entries)
+    for e in g2:
+        e['twidth'] = e['twidth'] / 2
+    sim.qchip.gates['Q0read2'] = Gate('Q0read2',
+                                      [_entry_from_dict(e) for e in g2])
+    return sim.compile([{'name': 'read', 'qubit': ['Q0']},
+                        {'name': 'read2', 'qubit': ['Q0']}])
+
+
+def test_fused_compact_rows_multi_envelope():
+    """The fused kernel's static-address row select (round-3 perf work)
+    must be exact with MULTIPLE envelope addresses in play: bit-equal
+    to the XLA per-sample path at sigma=0, and to the full-Toeplitz
+    fused path (rows analysis disabled)."""
+    from distributed_processor_tpu.sim import physics as ph
+    mp = _two_envelope_mp()
+    assert ph._static_meas_env_addrs(mp) == (0, 256)
+    init = (np.arange(24) % 2).astype(np.int32).reshape(24, 1)
+    kw = dict(max_steps=200, max_pulses=16, max_meas=4)
+    outs = {}
+    for mode in ('fused', 'persample'):
+        model = ReadoutPhysics(sigma=0.0, resolve_mode=mode)
+        outs[mode] = np.asarray(run_physics_batch(
+            mp, model, 5, 24, init_states=init, **kw)['meas_bits'])
+    np.testing.assert_array_equal(outs['fused'], outs['persample'])
+    np.testing.assert_array_equal(outs['fused'][:, 0, 0], init[:, 0])
+    # full-Toeplitz fallback (what >8 envelopes / register-sourced env
+    # words get) agrees bit-for-bit
+    orig = ph._static_meas_env_addrs
+    ph._static_meas_env_addrs = lambda *a, **k: None
+    try:
+        model = ReadoutPhysics(sigma=0.0, resolve_mode='fused')
+        full = np.asarray(run_physics_batch(
+            mp, model, 5, 24, init_states=init, **kw)['meas_bits'])
+    finally:
+        ph._static_meas_env_addrs = orig
+    np.testing.assert_array_equal(full, outs['fused'])
+
+
+def test_static_env_addrs_fallbacks():
+    """The static envelope-address analysis must refuse (None) exactly
+    when the value set is data-dependent: a register-sourced env write."""
+    from distributed_processor_tpu import isa
+    from distributed_processor_tpu.decoder import machine_program_from_cmds
+    from distributed_processor_tpu.sim.physics import _static_meas_env_addrs
+    mp = machine_program_from_cmds([[
+        isa.alu_cmd('reg_alu', 'i', 4096, 'id0', write_reg_addr=1),
+        isa.pulse_cmd(env_regaddr=1, freq_word=1, phase_word=0,
+                      amp_word=10, cfg_word=2, cmd_time=10),
+        isa.done_cmd()]])
+    assert _static_meas_env_addrs(mp) is None
+    mp2 = machine_program_from_cmds([[
+        isa.pulse_cmd(env_word=(2 << 12) | 3, freq_word=1, phase_word=0,
+                      amp_word=10, cfg_word=2, cmd_time=10),
+        isa.done_cmd()]])
+    assert _static_meas_env_addrs(mp2) == (0, 12)   # {0} + 3*4
