@@ -110,7 +110,7 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     Returns ``{'shots', 'mean_pulses' [C], 'meas1_rate' [C],
     'err_shots', 'incomplete_batches'}``.
     """
-    from ..sim.physics import run_physics_batch
+    from ..sim.physics import run_physics_batch, prepare_physics_tables
     from dataclasses import replace
     cfg = replace(cfg, **cfg_kw) if cfg else InterpreterConfig(**cfg_kw)
     cfg = replace(cfg, record_pulses=False)       # stats only
@@ -123,6 +123,10 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     n_batches = total_shots // batch
     if isinstance(key, int):
         key = jax.random.PRNGKey(key)
+    # resolve tables once (separate small jit) — the per-batch step
+    # takes them as device-array args instead of re-deriving them every
+    # batch inside its own module (see physics.prepare_physics_tables)
+    tables = prepare_physics_tables(mp, model)
 
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
@@ -133,10 +137,11 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
                              f'dp={n_dp}')
         local_shots = batch // n_dp
 
-        def local(k):
+        def local(k, tabs):
             k_local = jax.random.fold_in(k, jax.lax.axis_index('dp'))
             out = run_physics_batch(mp, model, k_local, local_shots,
-                                    init_regs=init_regs, cfg=cfg)
+                                    init_regs=init_regs, cfg=cfg,
+                                    tables=tabs)
             stats = dict(physics_batch_stats(out),
                          incomplete=out['incomplete'].astype(jnp.int32))
             stats = jax.tree.map(lambda x: jax.lax.psum(x, 'dp'), stats)
@@ -144,15 +149,19 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
             stats['incomplete'] = jnp.minimum(stats['incomplete'], 1)
             return stats
 
-        step = jax.jit(shard_map(local, mesh=mesh, in_specs=P(),
-                                 out_specs=P(), check_vma=False))
+        sharded = jax.jit(shard_map(local, mesh=mesh, in_specs=(P(), P()),
+                                    out_specs=P(), check_vma=False))
+        step = lambda k: sharded(k, tables)
     else:
         @jax.jit
-        def step(k):
+        def step(k, tabs):
             out = run_physics_batch(mp, model, k, batch,
-                                    init_regs=init_regs, cfg=cfg)
+                                    init_regs=init_regs, cfg=cfg,
+                                    tables=tabs)
             return dict(physics_batch_stats(out),
                         incomplete=out['incomplete'].astype(jnp.int32))
+        _step = step
+        step = lambda k: _step(k, tables)
 
     meta = _sweep_fingerprint(mp, model, batch, key, cfg, init_regs,
                               mesh.shape['dp'] if mesh is not None else 0)
