@@ -333,3 +333,18 @@ def test_sweep_fingerprint_array_model_fields(tmp_path):
     enc = _jsonable(m)
     _json.dumps(enc)                   # round-trippable
     assert enc['g0'] == [[1.0, 0.0], [0.5, 0.5]]
+
+
+def test_cli_run_physics_bloch(tmp_path, capsys):
+    """`run --physics --device bloch` drives the SU(2) co-state from
+    the command line: an X90-then-read program measures ~50/50."""
+    prog_path = tmp_path / 'p.json'
+    prog_path.write_text(json.dumps(
+        [{'name': 'X90', 'qubit': ['Q0']},
+         {'name': 'read', 'qubit': ['Q0']}]))
+    cli_main(['--qubits', '1', 'run', str(prog_path), '--physics',
+              '--device', 'bloch', '--shots', '256', '--sigma', '0.01',
+              '--p1-init', '0.0'])
+    out = json.loads(capsys.readouterr().out)
+    assert out['error_shots'] == 0
+    assert 0.3 < out['meas1_rate_per_core'][0] < 0.7
