@@ -82,7 +82,12 @@ def t2_echo_program(qubit: str, delay_s: float) -> list[dict]:
 def ghz_program(qubits) -> list[dict]:
     """GHZ-state preparation + readout: H on the first qubit, a CNOT
     chain, barrier, read all (uses the CNOT calibrations the default
-    qchip defines for adjacent pairs)."""
+    qchip defines for adjacent pairs).
+
+    Every CNOT is fenced with a barrier over all qubits — on hardware
+    (and in the schedule the statevec device's discrete-event gate
+    replays in time order) this keeps a deep chain's drives from
+    overlapping the neighbour's CR tone."""
     q0 = qubits[0]
     prog = [
         {'name': 'virtual_z', 'qubit': [q0], 'phase': np.pi / 2},
@@ -90,6 +95,7 @@ def ghz_program(qubits) -> list[dict]:
         {'name': 'virtual_z', 'qubit': [q0], 'phase': np.pi / 2},
     ]
     for a, b in zip(qubits, qubits[1:]):
+        prog.append({'name': 'barrier', 'qubit': list(qubits)})
         prog.append({'name': 'CNOT', 'qubit': [a, b]})
     prog.append({'name': 'barrier', 'qubit': list(qubits)})
     for q in qubits:

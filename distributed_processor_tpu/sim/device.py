@@ -55,6 +55,47 @@ interpreter's ``lax.while_loop``:
     jitted step as traced arrays, so sweeping T1/T2/detuning never
     recompiles.
 
+``'statevec'``
+    The entangling model: one full ``2^n_cores``-dimensional state
+    vector per shot (complex64 ``[B, 2^C]``), evolved as a quantum
+    trajectory.  Everything 'bloch' does per-core holds (phase-word
+    rotation axes, detuning precession, projective measurement), plus:
+
+    * **Two-qubit interactions are real.**  A drive pulse on a core
+      whose frequency word matches a configured coupling (see
+      ``couplings``) applies an entangling rotation — ZX for
+      cross-resonance pulses (control driven at the target's
+      frequency), ZZ for ef-frequency drives — with angle
+      ``(pi/2) * amp / zx90_amp`` (resp. ``zz90_amp``).  The default
+      qchip's CNOT (echoed-CR + target X90 + virtual-z) and CZ
+      calibrations compose *exactly* to CNOT / CZ under this model
+      (pinned by tests/test_device_statevec.py), so GHZ preparation
+      produces genuinely correlated bits and two-qubit RB sees real
+      entangling errors.
+    * **Noise is trajectory-unraveled.**  T1 is a quantum-jump
+      amplitude-damping channel (jump probability per gap weighted by
+      the qubit's excited population), pure dephasing a stochastic Z,
+      1q depolarization a stochastic X/Y/Z after each drive pulse, and
+      2q depolarization (``depol2_per_pulse``) a stochastic two-qubit
+      Pauli after each coupling pulse.  Shot-averaged statistics
+      reproduce the ensemble channels; draws are deterministic per
+      (shot, step) given the run key.
+    * **Measurement projects jointly.**  Readouts collapse the full
+      vector (sequential conditioning across cores within a step gives
+      the exact joint distribution), so GHZ parity correlations survive
+      into the sampled bits and through the readout DSP chain.
+
+    **Ordering**: cores advance per *instruction step*, not per clock,
+    so cross-core application order would not match trigger-time order
+    on its own.  With couplings configured, the interpreter adds a
+    conservative discrete-event gate (sim/interpreter.py ``_step``
+    stall mask): a pulse trigger fires only once no other live core
+    could still produce an earlier-time op, making application order =
+    schedule order by construction.  Pulses with *equal* trigger times
+    co-fire and apply in a fixed stage order (1q rotations, couplings,
+    measurements) — a genuine physical overlap either way.  See
+    docs/PHYSICS.md "Entangling model".
+
 The model evolves *inside* the execution loop (sim/interpreter.py
 ``_step`` physics block) because feedback makes it stateful: an active
 reset's conditional X180 must see the post-measurement collapsed state,
@@ -69,7 +110,17 @@ from dataclasses import dataclass
 
 import numpy as np
 
-DEVICE_KINDS = ('parity', 'bloch')
+DEVICE_KINDS = ('parity', 'bloch', 'statevec')
+
+# default two-qubit interaction reference amplitudes: the amp word that
+# produces a pi/2 ZX (cross-resonance) / ZZ (ef-drive) rotation, matched
+# to the default qchip's CNOT/CZ calibrations (models/default_qchip.py:
+# CR_AMP = 0.35, CZ_AMP = 0.42 on the 16-bit amp scale)
+ZX90_AMP_DEFAULT = 22937     # round(0.35 * (2^16 - 1))
+ZZ90_AMP_DEFAULT = 27525     # round(0.42 * (2^16 - 1))
+
+# statevec state is [shots, 2^n_cores]: cap the exponential axis
+STATEVEC_MAX_CORES = 12
 
 
 @dataclass(frozen=True)
@@ -90,11 +141,52 @@ class DeviceModel:
     t2_s: float | tuple = math.inf
     depol_per_pulse: float = 0.0
     clk_period_s: float = 2e-9
+    # -- statevec-only fields (ignored by 'parity'/'bloch') -------------
+    # two-qubit couplings: ((ctrl_core, freq_idx, target_core, kind),
+    # ...) with kind 'zx' (cross-resonance: a drive pulse on ctrl at the
+    # target's frequency applies exp(-i theta/2 Z_c (cos phi X_t +
+    # sin phi Y_t))) or 'zz' (ef-frequency drive: exp(-i theta/2
+    # Z_c Z_t), phase-word-independent since ZZ is diagonal).  Derive
+    # from a compiled program + qchip with
+    # models.coupling.couplings_from_qchip.
+    couplings: tuple = ()
+    zx90_amp: int = ZX90_AMP_DEFAULT   # amp word of a pi/2 ZX rotation
+    zz90_amp: int = ZZ90_AMP_DEFAULT   # amp word of a pi/2 ZZ rotation
+    # two-qubit depolarization per coupling pulse: with this
+    # probability, one of the 15 non-identity two-qubit Paulis (uniform)
+    # is applied to the coupled pair after the interaction — the
+    # injectable error rate two-qubit RB recovers, distinct from the
+    # single-qubit ``depol_per_pulse`` channel (which statevec applies
+    # as a trajectory-sampled X/Y/Z after each 1q drive pulse).
+    depol2_per_pulse: float = 0.0
 
     def __post_init__(self):
         if self.kind not in DEVICE_KINDS:
             raise ValueError(f'unknown device kind {self.kind!r}; '
                              f'one of {DEVICE_KINDS}')
+        for cp in self.couplings:
+            if len(cp) != 4 or cp[3] not in ('zx', 'zz'):
+                raise ValueError(
+                    f'coupling entries are (ctrl_core, freq_idx, '
+                    f'target_core, "zx"|"zz"); got {cp!r}')
+            if cp[0] == cp[2]:
+                raise ValueError(f'coupling {cp!r} pairs a core with itself')
+
+    def statevec_static(self) -> tuple:
+        """Hashable compile-time facts for the statevec step body:
+        ``(couplings, has_detuning, has_decay, has_depol1, has_depol2)``
+        — zero-rate channels are dropped from the traced step entirely
+        (changing a rate between zero and nonzero recompiles; sweeping
+        nonzero values does not, since the rates themselves are traced
+        arrays)."""
+        def nz(v):
+            return bool(np.any(np.asarray(v, np.float64) != 0.0))
+        def finite(v):
+            return bool(np.any(np.isfinite(np.asarray(v, np.float64))))
+        return (tuple(tuple(cp) for cp in self.couplings),
+                nz(self.detuning_hz),
+                finite(self.t1_s) or finite(self.t2_s),
+                nz(self.depol_per_pulse), nz(self.depol2_per_pulse))
 
     def per_clock_rates(self, n_cores: int):
         """Per-core per-clock rate arrays ``(det_cyc, inv_t1, inv_t2)``:

@@ -50,7 +50,7 @@ import jax.numpy as jnp
 from .. import isa
 from ..elements import PHASE_BITS
 from ..hwconfig import FPGAConfig
-from .device import DEVICE_KINDS
+from .device import DEVICE_KINDS, STATEVEC_MAX_CORES
 from .oracle import (INIT_TIME, QCLK_RST_DELAY, MEAS_LATENCY,
                      STICKY_RACE_MARGIN)
 
@@ -155,6 +155,66 @@ def _ohsel(table, oh):
     return jnp.sum(table * oh, axis=-1)
 
 
+# ---- statevec device helpers ------------------------------------------
+# Basis convention: core c is bit (C-1-c) of the state index, so
+# ``psi.reshape(B, 2, 2, ...)`` puts core 0 on the first qubit axis and
+# a bitstring reads left-to-right as (q0, q1, ...).
+
+_PAULI_1 = np.stack([
+    np.eye(2), [[0, 1], [1, 0]], [[0, -1j], [1j, 0]], [[1, 0], [0, -1]],
+]).astype(np.complex64)                                 # I, X, Y, Z
+_PAULI_2 = np.stack([np.kron(_PAULI_1[a], _PAULI_1[b])
+                     for a in range(4) for b in range(4)])  # [16, 4, 4]
+
+
+@functools.lru_cache()
+def _sv_zsign(C: int) -> np.ndarray:
+    """``[C, 2^C]`` float32: Z eigenvalue (+1/-1) of core c in basis d."""
+    d = np.arange(1 << C)
+    return np.stack([1.0 - 2.0 * ((d >> (C - 1 - c)) & 1)
+                     for c in range(C)]).astype(np.float32)
+
+
+def _sv_apply_1q(psi, U, c: int, C: int):
+    """Apply per-shot 2x2 ``U`` [B,2,2] to qubit ``c`` of ``psi`` [B,D]."""
+    B = psi.shape[0]
+    pn = jnp.moveaxis(psi.reshape((B,) + (2,) * C), 1 + c, 1)
+    sh = pn.shape
+    pn = jnp.einsum('bxu,bud->bxd', U, pn.reshape(B, 2, -1))
+    return jnp.moveaxis(pn.reshape(sh), 1, 1 + c).reshape(B, -1)
+
+
+def _sv_apply_pair(psi, U4, cc: int, tt: int, C: int):
+    """Apply per-shot 4x4 ``U4`` [B,4,4] to qubits ``(cc, tt)`` (index
+    within the 4-block is ``bit_cc * 2 + bit_tt``)."""
+    B = psi.shape[0]
+    pn = jnp.moveaxis(psi.reshape((B,) + (2,) * C), (1 + cc, 1 + tt), (1, 2))
+    sh = pn.shape
+    pn = jnp.einsum('bxu,bud->bxd', U4, pn.reshape(B, 4, -1))
+    return jnp.moveaxis(pn.reshape(sh), (1, 2), (1 + cc, 1 + tt)) \
+        .reshape(B, -1)
+
+
+def _sv_rot_1q(theta, phi):
+    """``exp(-i theta/2 (cos phi X + sin phi Y))`` as [B, 2, 2] c64."""
+    ch, sh = jnp.cos(0.5 * theta), jnp.sin(0.5 * theta)
+    cp, sp = jnp.cos(phi), jnp.sin(phi)
+    d = jax.lax.complex(ch, jnp.zeros_like(ch))
+    o01 = jax.lax.complex(-sh * sp, -sh * cp)     # -i e^{-i phi} sin
+    o10 = jax.lax.complex(sh * sp, -sh * cp)      # -i e^{+i phi} sin
+    return jnp.stack([jnp.stack([d, o01], -1),
+                      jnp.stack([o10, d], -1)], -2)
+
+
+def _sv_rot_zx(theta, phi):
+    """``exp(-i theta/2 Z (x) (cos phi X + sin phi Y))`` as [B, 4, 4]:
+    block-diagonal (control-conditioned +/- rotation of the target)."""
+    up, dn = _sv_rot_1q(theta, phi), _sv_rot_1q(-theta, phi)
+    z = jnp.zeros_like(up)
+    return jnp.concatenate(
+        [jnp.concatenate([up, z], -1), jnp.concatenate([z, dn], -1)], -2)
+
+
 def _alu_vec(op, in0, in1):
     """Vectorised 8-op ALU on int32 lanes (reference: hdl/alu.v:20-51).
 
@@ -248,12 +308,26 @@ def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
             'meas_freq': z(B, C, M), 'meas_env': z(B, C, M),
             'meas_gtime': z(B, C, M),
             'phys_wait': jnp.zeros((B, C), bool),
-            **({'qturns': z(B, C)} if cfg.device == 'parity' else
-               {'bloch': jnp.zeros((B, C, 3), jnp.float32),
-                'phys_t': jnp.full((B, C), INIT_TIME, jnp.int32),
-                'meas_p1': jnp.zeros((B, C, M), jnp.float32)})}
+            **_device_state(cfg, B, C, M)}
            if cfg.physics else {}),
     )
+
+
+def _device_state(cfg: InterpreterConfig, B: int, C: int, M: int) -> dict:
+    """Device-co-state carry arrays per device kind (sim/device.py)."""
+    z = lambda *s: jnp.zeros(s, dtype=jnp.int32)
+    if cfg.device == 'parity':
+        return {'qturns': z(B, C)}
+    cont = {'phys_t': jnp.full((B, C), INIT_TIME, jnp.int32),
+            'meas_p1': jnp.zeros((B, C, M), jnp.float32)}
+    if cfg.device == 'bloch':
+        return {'bloch': jnp.zeros((B, C, 3), jnp.float32), **cont}
+    # 'statevec': one 2^C-dim state vector per shot
+    if C > STATEVEC_MAX_CORES:
+        raise ValueError(
+            f"device='statevec' holds a [shots, 2^n_cores] state vector; "
+            f"n_cores={C} exceeds the cap of {STATEVEC_MAX_CORES}")
+    return {'psi': jnp.zeros((B, 1 << C), jnp.complex64), **cont}
 
 
 def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
@@ -439,6 +513,29 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     stalled = is_fproc & ~f_ready
     if has_sync:
         stalled = stalled | (at_sync & ~sync_ready[:, None])
+    if cfg.physics and cfg.device == 'statevec' and dev is not None \
+            and len(dev['static'][0]) > 0:
+        # Conservative discrete-event gate: cores advance per
+        # *instruction step*, so without this a core with few
+        # instructions can apply a time-later pulse in an earlier step
+        # than a busy neighbour's time-earlier one — fatal once
+        # couplings make cross-core pulses non-commuting.  A pulse
+        # trigger may fire only when no other live core could still
+        # produce an earlier-time op: each core's frontier is its
+        # pending trigger time if it sits at one, else its local clock
+        # (both lower-bound everything it can still emit, since
+        # trig = max(trig, time) and time is monotone).  The minimum-
+        # frontier pulse is always allowed, so the gate cannot
+        # deadlock; equal-time pulses co-fire and apply in the stage
+        # order below (a genuine physical overlap either way).
+        is_ptk = kind == isa.K_PULSE_TRIG
+        trig_e = jnp.maximum(offset + g('cmd_time'), time)
+        frontier = jnp.where(live & is_ptk, trig_e,
+                             jnp.where(live, time, INT32_MAX))
+        pt_ok = jnp.all(
+            (trig_e[:, :, None] <= frontier[:, None, :])
+            | ~live[:, None, :] | jnp.eye(C, dtype=bool)[None], axis=-1)
+        stalled = stalled | (is_ptk & live & ~pt_ok)
     adv = live & ~stalled                     # cores executing this step
 
     # ---- pulse-register latch + trigger --------------------------------
@@ -526,7 +623,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                 qturns = qturns + jnp.where(is_drive, dq, 0)
             state_bit = (qturns >> 1) & 1
             phys_updates = dict(qturns=qturns)
-        else:  # 'bloch'
+        elif cfg.device == 'bloch':
             if dev is None:
                 raise ValueError(
                     "device='bloch' needs device-model parameter arrays; "
@@ -579,6 +676,149 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             z1 = jnp.where(is_meas_pulse, zc, jnp.where(is_drive, rz, z))
             phys_updates = dict(
                 bloch=jnp.stack([x1, y1, z1], axis=-1),
+                phys_t=jnp.where(touch, trig, st['phys_t']),
+                meas_p1=jnp.where(mwr, p1[..., None], st['meas_p1']),
+            )
+        else:  # 'statevec' — entangling full-state trajectory model
+            if dev is None:
+                raise ValueError(
+                    "device='statevec' needs device-model parameters; "
+                    "run it via sim.physics.run_physics_batch")
+            (det_cyc, inv_t1, inv_t2, depol1, depol2, zx90, zz90,
+             meas_u, traj_key) = dev['params']
+            couplings, has_det, has_decay, has_dp1, has_dp2 = dev['static']
+            psi = st['psi']                                   # [B, 2^C] c64
+            zsign = jnp.asarray(_sv_zsign(C))                 # [C, D]
+            bit1 = (1.0 - zsign) * 0.5                        # 1 where |1>
+            is_drive = fire & (elem == cfg.drive_elem)
+            freqw = pp[..., 2]
+            # coupling-pulse masks: a drive pulse whose frequency word
+            # matches a configured (ctrl, freq_idx) entry is a 2q
+            # interaction, not a 1q rotation (static unroll — the
+            # coupling map is compile-time configuration)
+            cp_masks = [is_drive[:, cc] & (freqw[:, cc] == fi)
+                        for (cc, fi, tt, kd) in couplings]
+            is_cr = jnp.zeros((B, C), bool)
+            for mk, (cc, fi, tt, kd) in zip(cp_masks, couplings):
+                is_cr = is_cr | (mk[:, None]
+                                 & (jnp.arange(C) == cc)[None, :])
+            is_1q = is_drive & ~is_cr
+            touch = is_drive | is_meas_pulse
+            dt = jnp.where(touch,
+                           (trig - st['phys_t']).astype(jnp.float32), 0.0)
+            if has_decay or has_dp1 or has_dp2:
+                # per-step trajectory uniforms, deterministic per
+                # (shot, core, step) given the run key
+                traj_u = jax.random.uniform(
+                    jax.random.fold_in(traj_key, step_i), (B, C, 6),
+                    jnp.float32)
+            # (1) free evolution: detuning precession, one exact
+            # diagonal Rz over all touched cores (a [B,C]x[C,D] matmul)
+            if has_det:
+                alpha = (2 * np.pi) * det_cyc[None, :] * dt
+                arg = jnp.einsum('bc,cd->bd', -0.5 * alpha, zsign)
+                psi = psi * jax.lax.complex(jnp.cos(arg), jnp.sin(arg))
+            # (2) T1 / pure-dephasing quantum jumps per touched core:
+            # amplitude damping as a jump unraveling (jump prob
+            # p_decay * P(|1>)), dephasing as a stochastic Z — the
+            # shot-ensemble reproduces the Lindblad channels the bloch
+            # model applies deterministically
+            if has_decay:
+                inv_phi = jnp.maximum(inv_t2 - 0.5 * inv_t1, 0.0)
+                for c in range(C):
+                    p_dec = 1.0 - jnp.exp(-dt[:, c] * inv_t1[c])
+                    p1c = jnp.sum(bit1[c][None]
+                                  * (psi.real**2 + psi.imag**2), -1)
+                    jump = traj_u[:, c, 0] < p_dec * p1c
+                    damp = 1.0 - (1.0 - jnp.sqrt(1.0 - p_dec))[:, None] \
+                        * bit1[c][None, :]
+                    nrm = jnp.sqrt(jnp.maximum(1.0 - p_dec * p1c, 1e-12))
+                    psi_nj = psi * (damp / nrm[:, None])
+                    pn = jnp.moveaxis(psi.reshape((B,) + (2,) * C),
+                                      1 + c, 1).reshape(B, 2, -1)
+                    pj = jnp.stack(
+                        [pn[:, 1, :], jnp.zeros_like(pn[:, 0, :])], 1)
+                    pj = jnp.moveaxis(pj.reshape((B, 2) + (2,) * (C - 1)),
+                                      1, 1 + c).reshape(B, -1)
+                    pj = pj / jnp.sqrt(jnp.maximum(p1c, 1e-12))[:, None]
+                    psi = jnp.where(jump[:, None], pj, psi_nj)
+                    p_phi = 1.0 - jnp.exp(-dt[:, c] * inv_phi[c])
+                    flip = traj_u[:, c, 1] < 0.5 * p_phi
+                    psi = jnp.where(flip[:, None],
+                                    psi * zsign[c][None, :], psi)
+            # (3) 1q drive rotations (same angle/axis convention as
+            # 'bloch'), with stochastic 1q depol folded into the op
+            theta1 = ((np.pi / 2) / cfg.x90_amp if cfg.x90_amp > 0
+                      else 0.0) * pp[..., 3].astype(jnp.float32)
+            theta1 = jnp.where(is_1q, theta1, 0.0)
+            phi1 = (2 * np.pi / (1 << PHASE_BITS)) \
+                * pp[..., 1].astype(jnp.float32)
+            pauli1 = jnp.asarray(_PAULI_1)
+            for c in range(C):
+                U = _sv_rot_1q(theta1[:, c], phi1[:, c])
+                if has_dp1:
+                    occ = (traj_u[:, c, 2] < depol1) & is_1q[:, c]
+                    pick = jnp.minimum(
+                        (traj_u[:, c, 3] * 3).astype(jnp.int32), 2) + 1
+                    sel = jnp.where(occ, pick, 0)
+                    N = jnp.einsum(
+                        'bk,kxy->bxy',
+                        jax.nn.one_hot(sel, 4, dtype=jnp.complex64),
+                        pauli1)
+                    U = jnp.einsum('bxy,byu->bxu', N, U)
+                psi = _sv_apply_1q(psi, U, c, C)
+            # (4) coupling pulses: ZX (cross-resonance) / ZZ (ef drive)
+            # interactions with stochastic 2q depol.  Ordering contract:
+            # same-step stages apply 1q-then-coupling-then-measure;
+            # non-commuting cross-core sequences need barriers
+            # (sim/device.py docstring, docs/PHYSICS.md).
+            amp_f = pp[..., 3].astype(jnp.float32)
+            pauli2 = jnp.asarray(_PAULI_2)
+            for mk, (cc, fi, tt, kd) in zip(cp_masks, couplings):
+                ref = zz90 if kd == 'zz' else zx90
+                th = jnp.where(mk, (np.pi / 2) * amp_f[:, cc] / ref, 0.0)
+                if kd == 'zz':
+                    zz_row = (zsign[cc] * zsign[tt])[None, :]
+                    arg = -0.5 * th[:, None] * zz_row
+                    psi = psi * jax.lax.complex(jnp.cos(arg),
+                                                jnp.sin(arg))
+                else:
+                    U4 = _sv_rot_zx(th, phi1[:, cc])
+                    psi = _sv_apply_pair(psi, U4, cc, tt, C)
+                if has_dp2:
+                    occ = (traj_u[:, cc, 4] < depol2) & mk
+                    pick = jnp.minimum(
+                        (traj_u[:, cc, 5] * 15).astype(jnp.int32), 14)
+                    sel = jnp.where(occ, pick + 1, 0)   # 0 = identity
+                    P4 = jnp.einsum(
+                        'bk,kxy->bxy',
+                        jax.nn.one_hot(sel, 16, dtype=jnp.complex64),
+                        pauli2)
+                    psi = _sv_apply_pair(psi, P4, cc, tt, C)
+            # (5) measurement: joint projective collapse, sequential
+            # conditioning across cores (exact joint distribution for
+            # the commuting Z measurements of a step)
+            u_sel = jnp.sum(meas_u * oh_mslot.astype(jnp.float32), -1)
+            p1_cols, bit_cols = [], []
+            for c in range(C):
+                mc = is_meas_pulse[:, c]
+                p1c = jnp.clip(jnp.sum(
+                    bit1[c][None] * (psi.real**2 + psi.imag**2), -1),
+                    0.0, 1.0)
+                bitc = (u_sel[:, c] < p1c).astype(jnp.int32) \
+                    * mc.astype(jnp.int32)
+                keep = jnp.where(bitc[:, None] == 1, bit1[c][None, :],
+                                 1.0 - bit1[c][None, :])
+                p_sel = jnp.where(bitc == 1, p1c, 1.0 - p1c)
+                proj = psi * (keep
+                              / jnp.sqrt(jnp.maximum(p_sel, 1e-12))[:, None])
+                psi = jnp.where(mc[:, None], proj, psi)
+                p1_cols.append(jnp.where(mc, p1c, 0.0))
+                bit_cols.append(bitc)
+            p1 = jnp.stack(p1_cols, axis=-1)                  # [B, C]
+            state_bit = jnp.stack(bit_cols, axis=-1)
+            phys_updates = dict(
+                psi=psi,
                 phys_t=jnp.where(touch, trig, st['phys_t']),
                 meas_p1=jnp.where(mwr, p1[..., None], st['meas_p1']),
             )

@@ -62,7 +62,7 @@ import jax.numpy as jnp
 from ..elements import ENV_CW_SENTINEL, IQ_SCALE
 from ..ops.waveform import (PHASE_BITS, AMP_SCALE, complex_to_iq,
                             carrier_phase)
-from .device import DeviceModel
+from .device import DeviceModel, STATEVEC_MAX_CORES
 from .interpreter import (InterpreterConfig, _program_constants, _init_state,
                           _exec_loop, _finalize, _check_fabric,
                           program_traits)
@@ -674,7 +674,8 @@ _build_tables_jit = functools.partial(
                                              'max_epochs', 'chunk',
                                              'spcs', 'interps', 'mode',
                                              'ring', 'traits',
-                                             'native_rng', 'rows'))
+                                             'native_rng', 'rows',
+                                             'dev_static'))
 def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      tabs, freq_stack, g0, g1, sigma, inv_ring,
                      key, dev_params, meas_u,
@@ -683,13 +684,24 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      spcs: tuple = (), interps: tuple = (),
                      mode: str = 'persample', ring: bool = False,
                      traits: tuple = None,
-                     native_rng: bool = None, rows: tuple = None) -> dict:
+                     native_rng: bool = None, rows: tuple = None,
+                     traj_key=None, dev_static: tuple = None) -> dict:
     B = init_states.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
     if cfg.device == 'parity':
         st0['qturns'] = 2 * init_states
         dev = None
+    elif cfg.device == 'statevec':
+        # basis one-hot from the initial bits (core 0 = MSB,
+        # interpreter._sv_zsign convention)
+        weights = jnp.asarray([1 << (C - 1 - c) for c in range(C)],
+                              jnp.int32)
+        idx = jnp.sum(init_states * weights[None, :], axis=-1)
+        st0['psi'] = (idx[:, None]
+                      == jnp.arange(1 << C)[None, :]).astype(jnp.complex64)
+        dev = {'params': dev_params + (meas_u, traj_key),
+               'static': dev_static}
     else:
         zf = jnp.zeros((B, C), jnp.float32)
         st0['bloch'] = jnp.stack(
@@ -854,7 +866,8 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     init_states = jnp.asarray(init_states, jnp.int32)
     if init_regs is not None:
         init_regs = jnp.asarray(init_regs, jnp.int32)
-    if model.device.kind == 'bloch':
+    traj_key, dev_static = None, None
+    if model.device.kind in ('bloch', 'statevec'):
         # projective-measurement uniforms, one per (shot, core, slot) —
         # drawn from a stream independent of the resolve noise (fold_in
         # of the parent key) so existing parity-mode draws are unchanged
@@ -864,6 +877,18 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         meas_u = jax.random.uniform(
             jax.random.fold_in(key, 0x424c4f43),
             (shots, C, cfg.max_meas), jnp.float32)
+        if model.device.kind == 'statevec':
+            if C > STATEVEC_MAX_CORES:
+                raise ValueError(
+                    f"device='statevec' holds a [shots, 2^n_cores] state "
+                    f"vector; n_cores={C} exceeds the cap of "
+                    f"{STATEVEC_MAX_CORES}")
+            dev_params = dev_params + (
+                jnp.float32(model.device.depol2_per_pulse),
+                jnp.float32(model.device.zx90_amp),
+                jnp.float32(model.device.zz90_amp))
+            traj_key = jax.random.fold_in(key, 0x53563251)
+            dev_static = model.device.statevec_static()
     else:
         dev_params, meas_u = None, None
 
@@ -916,4 +941,4 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         C * cfg.max_meas + 1, model.resolve_chunk,
         tuple(int(x) for x in np.asarray(spc_m)), interps,
         model.resolve_mode, model.ring_tau > 0, program_traits(mp),
-        model.fused_native_rng, rows)
+        model.fused_native_rng, rows, traj_key, dev_static)
