@@ -103,6 +103,59 @@ def repetition_physics_kwargs(n_data: int) -> dict:
     return dict(max_pulses=16, max_meas=2, **_lut_fabric_kwargs(n_data))
 
 
+def _zero_amp_pulse(dest_q: int, freq_q: int) -> dict:
+    """A zero-amplitude drive pulse on ``Q<dest_q>.qdrv`` at qubit
+    ``freq_q``'s frequency: rotates nothing, but gives the statevec
+    device's stochastic error channels a pulse to fire on (1q depol
+    when freq_q == dest_q, the 2q coupling channel otherwise)."""
+    return {'name': 'pulse', 'dest': f'Q{dest_q}.qdrv',
+            'freq': 4.2e9 + 0.11e9 * freq_q,        # default-qchip freqs
+            'phase': 0.0, 'amp': 0.0, 'twidth': 24e-9,
+            'env': {'env_func': 'square', 'paradict': {}}}
+
+
+def correlated_noise_stage(pairs) -> list[dict]:
+    """Pairwise-correlated error injection: one zero-amplitude
+    cross-resonance pulse per (control, target) pair.  With
+    ``DeviceModel.depol2_per_pulse = p``, each pair suffers one of the
+    15 two-qubit Paulis with probability p — including the both-flip
+    errors (4/15 of them) that defeat a distance-3 majority vote with a
+    SINGLE event, which is what makes correlated noise strictly worse
+    for the repetition code than independent noise of equal marginal
+    strength (tests/test_repetition_correlated.py)."""
+    out = []
+    qubits = sorted({q for ab in pairs for q in ab})
+    for a, b in pairs:
+        out.append({'name': 'barrier',
+                    'qubit': [f'Q{q}' for q in qubits]})
+        out.append(_zero_amp_pulse(a, b))
+    return out
+
+
+def independent_noise_stage(qubits) -> list[dict]:
+    """Per-qubit independent error injection: one zero-amplitude 1q
+    drive pulse per qubit; ``DeviceModel.depol_per_pulse = p`` then
+    flips each qubit independently with probability 2p/3."""
+    return [_zero_amp_pulse(q, q) for q in qubits]
+
+
+def repetition_logical_program(n_data: int = 3, noise: list = None,
+                               slack_s: float = 3e-6) -> list[dict]:
+    """Noise stage + one full syndrome round + verification readout:
+    inject errors, measure every data qubit, apply the LUT
+    majority-vote correction, then read again — the second-round
+    majority is the logical state after correction.  Run with
+    ``repetition_physics_kwargs(n_data)``."""
+    qubits = [f'Q{i}' for i in range(n_data)]
+    program = list(noise or [])
+    program.append({'name': 'barrier', 'qubit': qubits})
+    program += repetition_round_program(n_data, slack_s)
+    program.append({'name': 'barrier', 'qubit': qubits})
+    for q in qubits:
+        program.append({'name': 'read', 'qubit': [q]})
+    return program
+
+
 def corrected_counts(out, n_data: int) -> np.ndarray:
     """Per-core correction count from a run's pulse records: cores that
     fired the 2-pulse flip after the readout."""

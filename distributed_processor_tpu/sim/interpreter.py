@@ -521,19 +521,42 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         # than a busy neighbour's time-earlier one — fatal once
         # couplings make cross-core pulses non-commuting.  A pulse
         # trigger may fire only when no other live core could still
-        # produce an earlier-time op: each core's frontier is its
+        # produce an earlier-time op.  Each core's base frontier is its
         # pending trigger time if it sits at one, else its local clock
         # (both lower-bound everything it can still emit, since
-        # trig = max(trig, time) and time is monotone).  The minimum-
-        # frontier pulse is always allowed, so the gate cannot
-        # deadlock; equal-time pulses co-fire and apply in the stage
-        # order below (a genuine physical overlap either way).
+        # trig = max(trig, time) and time is monotone); a core stalled
+        # at the sync barrier or on an unfired fproc measurement would
+        # freeze its clock and deadlock the gate, so those inherit a
+        # sounder bound instead — the sync release is >= every
+        # participant's frontier, and an fproc reader resumes only
+        # after its producer's next measurement, so it inherits the
+        # producer's frontier (for LUT reads, the max over the masked
+        # producers).  With these bounds the minimum pending trigger is
+        # always allowed, so the gate cannot deadlock; equal-time
+        # pulses co-fire and apply in the stage order below (a genuine
+        # physical overlap either way).
         is_ptk = kind == isa.K_PULSE_TRIG
         trig_e = jnp.maximum(offset + g('cmd_time'), time)
-        frontier = jnp.where(live & is_ptk, trig_e,
-                             jnp.where(live, time, INT32_MAX))
+        f0 = jnp.where(live & is_ptk, trig_e,
+                       jnp.where(live, time, INT32_MAX))
+        fr = f0
+        neg = jnp.int32(-INT32_MAX)
+        if has_sync:
+            f_part = jnp.max(jnp.where(sync_part[None, :], f0, neg),
+                             axis=-1, keepdims=True)
+            fr = jnp.where(at_sync & live, jnp.maximum(fr, f_part), fr)
+        if any_fproc:
+            fstall = is_fproc & live & ~f_ready & ~f_phys
+            if cfg.fabric in ('sticky', 'fresh'):
+                prod_f = _ohsel(f0[:, None, :], oh_prod)
+            else:  # 'lut'
+                lut_f = jnp.max(jnp.where(lmask_j[None, :], f0, neg),
+                                axis=-1, keepdims=True)
+                prod_f = jnp.where(fid == 0, f0,
+                                   jnp.broadcast_to(lut_f, f0.shape))
+            fr = jnp.where(fstall, jnp.maximum(fr, prod_f), fr)
         pt_ok = jnp.all(
-            (trig_e[:, :, None] <= frontier[:, None, :])
+            (trig_e[:, :, None] <= fr[:, None, :])
             | ~live[:, None, :] | jnp.eye(C, dtype=bool)[None], axis=-1)
         stalled = stalled | (is_ptk & live & ~pt_ok)
     adv = live & ~stalled                     # cores executing this step

@@ -205,12 +205,65 @@ def test_statevec_needs_physics_path(sim2):
 
 def test_statevec_core_cap():
     """n_cores > 12 would allocate 2^C amplitudes per shot: refuse."""
+    from distributed_processor_tpu import isa
+    from distributed_processor_tpu.decoder import machine_program_from_cmds
     from distributed_processor_tpu.sim.device import STATEVEC_MAX_CORES
-    sim = Simulator(n_qubits=2)
-    mp = sim.compile([{'name': 'X90', 'qubit': ['Q0']}])
+    wide = machine_program_from_cmds(
+        [[isa.pulse_cmd(cmd_time=10), isa.done_cmd()]]
+        * (STATEVEC_MAX_CORES + 1))
     model = ReadoutPhysics(device=DeviceModel('statevec'))
-    # fake a wide machine program via n_cores on the cap check
-    assert STATEVEC_MAX_CORES == 12
+    with pytest.raises(ValueError, match='exceeds the cap'):
+        run_physics_batch(wide, model, 0, 1)
+
+
+def test_event_gate_sync_no_deadlock():
+    """Regression: the discrete-event gate must not deadlock against a
+    SYNC-stalled core.  Core 0 fires a pulse scheduled past core 1's
+    frozen clock, then both sync; with a naive frontier (the stalled
+    core's local time) core 0 waits on core 1 and core 1 waits at the
+    barrier — forever.  The sync-stalled core's frontier must instead
+    be the release lower bound (max over participants' frontiers)."""
+    from distributed_processor_tpu import isa
+    from distributed_processor_tpu.decoder import machine_program_from_cmds
+    mp = machine_program_from_cmds([
+        [isa.pulse_cmd(cmd_time=500, cfg_word=0), isa.sync(0),
+         isa.done_cmd()],
+        [isa.sync(0), isa.pulse_cmd(cmd_time=20, cfg_word=0),
+         isa.done_cmd()],
+    ])
+    model = ReadoutPhysics(sigma=0.0, device=DeviceModel(
+        'statevec', couplings=((0, 0, 1, 'zx'),)))
+    out = run_physics_batch(mp, model, 0, 4, max_steps=256)
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
+
+
+def test_event_gate_fproc_no_deadlock():
+    """Regression: a reader stalled on its neighbour's *unfired*
+    measurement must not freeze the gate either — the producer's
+    readout pulse (scheduled past the reader's frozen clock) has to be
+    allowed to fire.  The reader inherits the producer's frontier."""
+    from distributed_processor_tpu import isa
+    from distributed_processor_tpu.decoder import machine_program_from_cmds
+    mp = machine_program_from_cmds([
+        # core 0: read core 1's measurement (fresh), then flip, done
+        [isa.alu_cmd('jump_fproc', 'i', 1, 'eq', jump_cmd_ptr=2,
+                     func_id=1),
+         isa.jump_i(3),
+         isa.pulse_cmd(cmd_time=900, cfg_word=0, env_word=(2 << 12)),
+         isa.done_cmd()],
+        # core 1: measurement pulse late enough to be past core 0's clock
+        [isa.pulse_cmd(cmd_time=400, cfg_word=2, env_word=(2 << 12)),
+         isa.done_cmd()],
+    ])
+    model = ReadoutPhysics(sigma=0.0, device=DeviceModel(
+        'statevec', couplings=((0, 0, 1, 'zx'),)))
+    out = run_physics_batch(mp, model, 0, 4, fabric='fresh', max_steps=256)
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
+
+
+def test_coupling_validation():
     with pytest.raises(ValueError, match='coupling'):
         DeviceModel('statevec', couplings=((0, 0, 0, 'zx'),))
     with pytest.raises(ValueError, match='zx'):

@@ -1,0 +1,89 @@
+"""Repetition-code correlated-error sensitivity (round-3 criterion 3).
+
+The distance-3 majority vote corrects any single flip, so independent
+errors of strength f leak through only at O(f^2) — but a correlated
+two-qubit error (both qubits of a pair flipped by ONE event) defeats it
+linearly.  With the statevec device, pairwise-correlated errors are
+physically real (2q Pauli channel on coupling pulses), and the
+physics-closed LUT round measurably distinguishes them from independent
+errors of equal-or-greater marginal strength.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.models.coupling import couplings_from_qchip
+from distributed_processor_tpu.models.default_qchip import make_default_qchip
+from distributed_processor_tpu.models.repetition import (
+    correlated_noise_stage, independent_noise_stage,
+    repetition_logical_program, repetition_physics_kwargs)
+from distributed_processor_tpu.sim.device import DeviceModel
+from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                   run_physics_batch)
+
+SHOTS = 4096
+
+
+@pytest.fixture(scope='module')
+def setup():
+    return Simulator(n_qubits=3), make_default_qchip(3)
+
+
+def _round(setup, noise, key, **dev_kw):
+    sim, qchip = setup
+    prog = repetition_logical_program(3, noise)
+    mp = sim.compile(prog)
+    cps = couplings_from_qchip(mp, qchip)
+    model = ReadoutPhysics(sigma=0.0, device=DeviceModel(
+        'statevec', couplings=cps, **dev_kw))
+    out = run_physics_batch(mp, model, key, SHOTS,
+                            init_states=np.zeros((SHOTS, 3), np.int32),
+                            max_steps=8000, **repetition_physics_kwargs(3))
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
+    syndrome = np.asarray(out['meas_state'])[:, :, 0]   # pre-correction
+    final = np.asarray(out['meas_bits'])[:, :, 1]       # post-correction
+    return syndrome, (final.sum(axis=1) >= 2)           # logical flip
+
+
+def test_noiseless_round_is_silent(setup):
+    syndrome, logical = _round(setup, correlated_noise_stage([(0, 1),
+                                                              (1, 2)]), 0)
+    assert not np.any(syndrome) and not np.any(logical)
+
+
+def test_correlated_beats_majority_vote(setup):
+    """Pairwise-correlated errors produce a logical error rate several
+    times the independent rate at matched (here: strictly smaller)
+    marginal flip probabilities — the linear-vs-quadratic signature."""
+    p2 = 0.05
+    syn_c, log_c = _round(setup, correlated_noise_stage([(0, 1), (1, 2)]),
+                          1, depol2_per_pulse=p2)
+    # independent stage tuned to a HIGHER per-qubit marginal than any
+    # correlated-channel qubit sees (2p/3 = 0.0527 > 2*8*p2/15 - eps)
+    p1 = 0.079
+    syn_i, log_i = _round(setup, independent_noise_stage([0, 1, 2]),
+                          2, depol_per_pulse=p1)
+    marg_c, marg_i = syn_c.mean(axis=0), syn_i.mean(axis=0)
+    assert np.all(marg_i >= marg_c - 0.01), (marg_c, marg_i)
+    rate_c, rate_i = log_c.mean(), log_i.mean()
+    # independent errors follow the exact majority-vote closed form
+    f = 2 * p1 / 3
+    pred_i = 3 * f**2 * (1 - f) + f**3
+    assert abs(rate_i - pred_i) < 4 * np.sqrt(pred_i * (1 - pred_i) / SHOTS)
+    # correlated errors leak through linearly: several-fold worse
+    assert rate_c > 2.0 * rate_i, (rate_c, rate_i)
+    assert rate_c > 0.015
+
+
+def test_single_independent_flip_always_corrected(setup):
+    """Determinism check on the correction path itself: with exactly
+    one qubit flipped at injection (X180 via two X90s), the round
+    always restores the codeword — zero logical errors."""
+    sim, qchip = setup
+    noise = [{'name': 'X90', 'qubit': ['Q1']},
+             {'name': 'X90', 'qubit': ['Q1']}]
+    syndrome, logical = _round((sim, qchip), noise, 3)
+    assert np.all(syndrome == [0, 1, 0])
+    assert not np.any(logical)
