@@ -103,18 +103,26 @@ def repetition_physics_kwargs(n_data: int) -> dict:
     return dict(max_pulses=16, max_meas=2, **_lut_fabric_kwargs(n_data))
 
 
-def _zero_amp_pulse(dest_q: int, freq_q: int) -> dict:
+def _zero_amp_pulse(dest_q: int, freq_q: int, qchip=None) -> dict:
     """A zero-amplitude drive pulse on ``Q<dest_q>.qdrv`` at qubit
     ``freq_q``'s frequency: rotates nothing, but gives the statevec
     device's stochastic error channels a pulse to fire on (1q depol
-    when freq_q == dest_q, the 2q coupling channel otherwise)."""
+    when freq_q == dest_q, the 2q coupling channel otherwise).
+
+    The frequency is resolved from ``qchip`` — it must match the target
+    qubit's drive frequency exactly or the coupling map never fires and
+    the 'noise' silently injects nothing (models/coupling.py matches by
+    frequency value)."""
+    if qchip is None:
+        from .default_qchip import make_default_qchip
+        qchip = make_default_qchip(max(dest_q, freq_q) + 1)
     return {'name': 'pulse', 'dest': f'Q{dest_q}.qdrv',
-            'freq': 4.2e9 + 0.11e9 * freq_q,        # default-qchip freqs
+            'freq': qchip.get_qubit_freq(f'Q{freq_q}.freq'),
             'phase': 0.0, 'amp': 0.0, 'twidth': 24e-9,
             'env': {'env_func': 'square', 'paradict': {}}}
 
 
-def correlated_noise_stage(pairs) -> list[dict]:
+def correlated_noise_stage(pairs, qchip=None) -> list[dict]:
     """Pairwise-correlated error injection: one zero-amplitude
     cross-resonance pulse per (control, target) pair.  With
     ``DeviceModel.depol2_per_pulse = p``, each pair suffers one of the
@@ -128,15 +136,15 @@ def correlated_noise_stage(pairs) -> list[dict]:
     for a, b in pairs:
         out.append({'name': 'barrier',
                     'qubit': [f'Q{q}' for q in qubits]})
-        out.append(_zero_amp_pulse(a, b))
+        out.append(_zero_amp_pulse(a, b, qchip))
     return out
 
 
-def independent_noise_stage(qubits) -> list[dict]:
+def independent_noise_stage(qubits, qchip=None) -> list[dict]:
     """Per-qubit independent error injection: one zero-amplitude 1q
     drive pulse per qubit; ``DeviceModel.depol_per_pulse = p`` then
     flips each qubit independently with probability 2p/3."""
-    return [_zero_amp_pulse(q, q) for q in qubits]
+    return [_zero_amp_pulse(q, q, qchip) for q in qubits]
 
 
 def repetition_logical_program(n_data: int = 3, noise: list = None,

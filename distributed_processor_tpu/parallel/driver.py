@@ -110,7 +110,8 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     Returns ``{'shots', 'mean_pulses' [C], 'meas1_rate' [C],
     'err_shots', 'incomplete_batches'}``.
     """
-    from ..sim.physics import run_physics_batch, prepare_physics_tables
+    from ..sim.physics import (run_physics_batch, prepare_physics_tables,
+                               validate_physics_tables)
     from dataclasses import replace
     cfg = replace(cfg, **cfg_kw) if cfg else InterpreterConfig(**cfg_kw)
     cfg = replace(cfg, record_pulses=False)       # stats only
@@ -127,6 +128,9 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     # takes them as device-array args instead of re-deriving them every
     # batch inside its own module (see physics.prepare_physics_tables)
     tables = prepare_physics_tables(mp, model)
+    # inside the jitted step the carried build parameters are tracers,
+    # so validate here, eagerly, where they are concrete
+    validate_physics_tables(mp, model, tables)
 
     if mesh is not None:
         from jax.sharding import PartitionSpec as P

@@ -130,6 +130,12 @@ class InterpreterConfig:
     device: str = 'parity'
     drive_elem: int = 0           # element whose pulses rotate the qubit
     x90_amp: int = 0              # amp word of one quarter turn (0 = off)
+    # physics mode: CW readout integration horizon in DAC samples.
+    # 0 = a CW-envelope measurement pulse is an error (ERR_CW_MEAS —
+    # no intrinsic window length); > 0 = the resolver demodulates CW
+    # windows over this many samples and the bit becomes available
+    # after the corresponding clocks (set via ReadoutPhysics.cw_horizon)
+    cw_horizon: int = 0
     alu_instr_clks: int = 5
     jump_cond_clks: int = 5
     jump_fproc_clks: int = 8
@@ -374,6 +380,30 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     qclk = time - offset
     is_fproc = (kind == isa.K_ALU_FPROC) | (kind == isa.K_JUMP_FPROC)
 
+    # ---- discrete-event gate, stage A (statevec + couplings only) ------
+    # Base frontiers for the pulse-trigger ordering gate applied in the
+    # stall-mask section below: each core's frontier lower-bounds the
+    # trigger time of anything it can still emit (pending trigger if it
+    # sits at one, else its local clock — trig = max(trig, time) and
+    # time is monotone; sync-stalled cores are raised to the release
+    # lower bound).  Computed before the fabric so the sticky branch
+    # can use producer frontiers to prove a latched snapshot final.
+    pt_gate = cfg.physics and cfg.device == 'statevec' \
+        and dev is not None and len(dev['static'][0]) > 0
+    if pt_gate:
+        is_ptk = kind == isa.K_PULSE_TRIG
+        trig_e = jnp.maximum(offset + g('cmd_time'), time)
+        f0_gate = jnp.where(live & is_ptk, trig_e,
+                            jnp.where(live, time, INT32_MAX))
+        fr_gate = f0_gate
+        at_sync_g = live & (kind == isa.K_SYNC)
+        if has_sync:
+            neg_g = jnp.int32(-INT32_MAX)
+            f_part = jnp.max(jnp.where(sync_part[None, :], f0_gate, neg_g),
+                             axis=-1, keepdims=True)
+            fr_gate = jnp.where(at_sync_g, jnp.maximum(fr_gate, f_part),
+                                fr_gate)
+
     # ---- fproc fabric (reference: hdl/fproc_meas.sv / core_state_mgr.sv /
     # hdl/fproc_lut.sv, selected statically by cfg.fabric; dropped
     # entirely when the program has no fproc instructions) ---------------
@@ -428,6 +458,16 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         valid_p = sel_m(meas_valid.astype(jnp.int32))
         f_time_ok = (sel(st['done'].astype(jnp.int32)) == 1) \
             | (sel(time) >= req)
+        if pt_gate:
+            # under the event gate, a producer stalled at a far-future
+            # trigger would freeze its clock and deadlock the sticky
+            # read (and inheriting its frontier would let time-later
+            # pulses overtake the reader — unsound).  Instead the
+            # latched snapshot is provably FINAL once the producer's
+            # frontier passes the request: any measurement it can still
+            # fire lands at frontier + MEAS_LATENCY > req, comfortably
+            # outside the race margin — so serve the read.
+            f_time_ok = f_time_ok | (sel(fr_gate) >= req)
         m_cnt = jnp.sum((mavail_p <= req[..., None]).astype(jnp.int32), -1)
         oh_latest = _onehot(jnp.maximum(m_cnt - 1, 0), cfg.max_meas)
         latest_valid = (m_cnt == 0) | (_ohsel(valid_p, oh_latest) == 1)
@@ -513,48 +553,48 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     stalled = is_fproc & ~f_ready
     if has_sync:
         stalled = stalled | (at_sync & ~sync_ready[:, None])
-    if cfg.physics and cfg.device == 'statevec' and dev is not None \
-            and len(dev['static'][0]) > 0:
+    if pt_gate:
         # Conservative discrete-event gate: cores advance per
         # *instruction step*, so without this a core with few
         # instructions can apply a time-later pulse in an earlier step
         # than a busy neighbour's time-earlier one — fatal once
         # couplings make cross-core pulses non-commuting.  A pulse
         # trigger may fire only when no other live core could still
-        # produce an earlier-time op.  Each core's base frontier is its
-        # pending trigger time if it sits at one, else its local clock
-        # (both lower-bound everything it can still emit, since
-        # trig = max(trig, time) and time is monotone); a core stalled
-        # at the sync barrier or on an unfired fproc measurement would
-        # freeze its clock and deadlock the gate, so those inherit a
-        # sounder bound instead — the sync release is >= every
-        # participant's frontier, and an fproc reader resumes only
-        # after its producer's next measurement, so it inherits the
-        # producer's frontier (for LUT reads, the max over the masked
-        # producers).  With these bounds the minimum pending trigger is
+        # produce an earlier-time op.  Frontier bounds (stage A above)
+        # are strengthened by a monotone fixpoint over stall chains —
+        # a sync-stalled core's ops land at the release, which is >=
+        # every participant's frontier; a fresh/LUT fproc reader
+        # resumes only after its producer's next measurement, so it
+        # inherits the producer's frontier (LUT: max over the masked
+        # producers).  Iterating n_cores times propagates bounds
+        # through chains of any length (reader -> sync -> pulse, ...);
+        # each raise is justified by the previous iterate, so the
+        # fixpoint is sound by induction.  Sticky readers need (and
+        # may take) no inheritance: the snapshot-finality relaxation in
+        # the fabric section serves them as soon as the producer's
+        # frontier passes the request.  The minimum pending trigger is
         # always allowed, so the gate cannot deadlock; equal-time
         # pulses co-fire and apply in the stage order below (a genuine
         # physical overlap either way).
-        is_ptk = kind == isa.K_PULSE_TRIG
-        trig_e = jnp.maximum(offset + g('cmd_time'), time)
-        f0 = jnp.where(live & is_ptk, trig_e,
-                       jnp.where(live, time, INT32_MAX))
-        fr = f0
+        fr = fr_gate
         neg = jnp.int32(-INT32_MAX)
-        if has_sync:
-            f_part = jnp.max(jnp.where(sync_part[None, :], f0, neg),
-                             axis=-1, keepdims=True)
-            fr = jnp.where(at_sync & live, jnp.maximum(fr, f_part), fr)
-        if any_fproc:
+        inherit_fproc = any_fproc and cfg.fabric in ('fresh', 'lut')
+        if inherit_fproc:
             fstall = is_fproc & live & ~f_ready & ~f_phys
-            if cfg.fabric in ('sticky', 'fresh'):
-                prod_f = _ohsel(f0[:, None, :], oh_prod)
-            else:  # 'lut'
-                lut_f = jnp.max(jnp.where(lmask_j[None, :], f0, neg),
-                                axis=-1, keepdims=True)
-                prod_f = jnp.where(fid == 0, f0,
-                                   jnp.broadcast_to(lut_f, f0.shape))
-            fr = jnp.where(fstall, jnp.maximum(fr, prod_f), fr)
+        for _ in range(C if (has_sync or inherit_fproc) else 0):
+            if has_sync:
+                f_part = jnp.max(jnp.where(sync_part[None, :], fr, neg),
+                                 axis=-1, keepdims=True)
+                fr = jnp.where(at_sync_g, jnp.maximum(fr, f_part), fr)
+            if inherit_fproc:
+                if cfg.fabric == 'fresh':
+                    prod_f = _ohsel(fr[:, None, :], oh_prod)
+                else:  # 'lut'
+                    lut_f = jnp.max(jnp.where(lmask_j[None, :], fr, neg),
+                                    axis=-1, keepdims=True)
+                    prod_f = jnp.where(fid == 0, fr,
+                                       jnp.broadcast_to(lut_f, fr.shape))
+                fr = jnp.where(fstall, jnp.maximum(fr, prod_f), fr)
         pt_ok = jnp.all(
             (trig_e[:, :, None] <= fr[:, None, :])
             | ~live[:, None, :] | jnp.eye(C, dtype=bool)[None], axis=-1)
@@ -632,10 +672,22 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     phys_updates = {}
     cw_meas_err = 0
     if cfg.physics:
-        # a CW readout window has no length for the resolver to
-        # demodulate — flag it loudly instead of yielding silent 0 bits
-        cw_meas_err = jnp.where(is_meas_pulse & (env_len == 0xfff),
-                                ERR_CW_MEAS, 0)
+        if cfg.cw_horizon > 0:
+            # CW readout with a configured horizon: the bit exists once
+            # the horizon's worth of samples has been integrated — the
+            # availability uses the horizon duration in clocks instead
+            # of the (zero) envelope duration
+            cw_clks = (cfg.cw_horizon + spc_e - 1) // spc_e
+            meas_avail = jnp.where(
+                (oh_mslot == 1) & (is_meas_pulse
+                                   & (env_len == 0xfff))[..., None],
+                (trig + cw_clks + cfg.meas_latency)[..., None], meas_avail)
+        else:
+            # a CW readout window has no length for the resolver to
+            # demodulate — flag it loudly instead of yielding silent
+            # 0 bits
+            cw_meas_err = jnp.where(is_meas_pulse & (env_len == 0xfff),
+                                    ERR_CW_MEAS, 0)
         mwr = (oh_mslot == 1) & is_meas_pulse[..., None]
         if cfg.device == 'parity':
             qturns = st['qturns']

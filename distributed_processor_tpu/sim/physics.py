@@ -116,6 +116,27 @@ class ReadoutPhysics:
     # O(B*C*M*chunk) instead of O(B*C*M*W) — million-shot batches with
     # 2k-sample readout windows fit HBM
     resolve_chunk: int = 512
+    # CW (hold-until-next) readout envelopes: integration horizon in
+    # DAC samples.  0 = refuse (ERR_CW_MEAS, the safe default — a CW
+    # window has no intrinsic length); > 0 = demodulate CW measurement
+    # windows over exactly this many samples (must be <= the table
+    # window W), with the envelope playing through its table and
+    # holding the final sample — the element contract's CW word
+    # (reference: python/distproc/hwconfig.py:12-67 get_cw_env_word)
+    # becomes usable for readout instead of an error.
+    cw_horizon: int = 0
+    # ADC noise color: AR(1) pole per sample (0 = white).  With
+    # 0 < noise_ar1 < 1 the per-sample resolver draws stationary
+    # unit-variance AR(1) noise (exact, IIR state carried across
+    # chunks; the in-chunk recursion is one lower-triangular matmul on
+    # the MXU).  Positively-correlated noise is NOT collapsed by the
+    # matched filter the way white noise is — the accumulated noise
+    # variance gains the double sum over rho^|t-t'| — so assignment
+    # fidelity degrades for smooth envelopes; tests/test_ringdown.py
+    # measures the penalty.  'analytic' (white-noise closed form) and
+    # 'fused' (in-kernel white generator) refuse rather than silently
+    # whiten.
+    noise_ar1: float = 0.0
     # fused-mode ADC noise generator: None = auto (in-kernel
     # counter-based RNG on real TPU, streamed threefry under
     # interpret); True/False forces it.  Static — part of the compiled
@@ -177,8 +198,10 @@ def _physics_tables(mp, meas_elem: int):
             int(w_auto))
 
 
-def _window_scalars(st: dict, tables):
-    """Per-measurement synthesis scalars, ``[B,C,M]`` each."""
+def _window_scalars(st: dict, tables, cw_samp: int = 0):
+    """Per-measurement synthesis scalars, ``[B,C,M]`` each.
+    ``cw_samp``: static CW-readout horizon in DAC samples (0 = CW
+    windows stay zero-length; the interpreter flags them as errors)."""
     env_stack, freq_stack, spc_m, interp_m = tables
     B, C, M = st['meas_env'].shape
     amp = st['meas_amp'].astype(jnp.float32) / AMP_SCALE          # [B,C,M]
@@ -192,7 +215,7 @@ def _window_scalars(st: dict, tables):
     nw = (envw >> 12) & 0xfff
     interp_c = interp_m[None, :, None]
     spc_c = spc_m[None, :, None]
-    n_samp = jnp.where(nw == ENV_CW_SENTINEL, 0, nw * 4 * interp_c)
+    n_samp = jnp.where(nw == ENV_CW_SENTINEL, cw_samp, nw * 4 * interp_c)
     n0_car = st['meas_gtime'] * spc_c
     # factored carrier: theta(s) = A + 2*pi*f*s with the per-window
     # scalar A = 2*pi*f*n0 + ph — the only transcendentals taken at
@@ -375,7 +398,7 @@ def _synth_windows(st: dict, tables, W: int):
     return _synth_window_chunk(sc, toeplitz, basis, jnp.int32(0), W, interps)
 
 
-def _compact_pending_slot(st: dict, valid, tables):
+def _compact_pending_slot(st: dict, valid, tables, cw_samp: int = 0):
     """First fired-but-unresolved measurement slot per (shot, core).
 
     Returns ``(sc, state_sel, oh_slot, has_pending)``: the compacted
@@ -396,7 +419,7 @@ def _compact_pending_slot(st: dict, valid, tables):
     st_sel = {k: take(st[k]) for k in
               ('meas_amp', 'meas_phase', 'meas_freq', 'meas_env',
                'meas_gtime')}
-    sc = _window_scalars(st_sel, tables)
+    sc = _window_scalars(st_sel, tables, cw_samp)
     return sc, take(st['meas_state']), oh_slot, has_pending
 
 
@@ -408,9 +431,23 @@ def _scatter_slot_bit(bits, valid, new_bit, oh_slot, has_pending):
     return bits, valid | resolved
 
 
+def _ar1_tables(rho, chunk: int):
+    """AR(1) in-chunk recursion as one lower-triangular matmul:
+    ``n[i] = sum_j T[i, j] w[j] + rpow[i] * n_carry`` with
+    ``T[i, j] = c * rho^(i-j)`` (i >= j, c = sqrt(1 - rho^2)) and
+    ``rpow[i] = rho^(i+1)`` — exact unit-variance stationary AR(1),
+    sequential only across chunks (one carried sample), MXU work
+    within them."""
+    i = jnp.arange(chunk, dtype=jnp.float32)
+    d = i[:, None] - i[None, :]
+    c = jnp.sqrt(jnp.maximum(1.0 - rho * rho, 0.0))
+    T = jnp.where(d >= 0, c * rho ** d, 0.0)                # [ck, ck]
+    return T, rho ** (i + 1.0)
+
+
 def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
              W: int, chunk: int = None, interps=None, prebuilt=None,
-             ring: bool = False):
+             ring: bool = False, cw: int = 0, colored=None):
     """Demodulate pending readout windows into bits — one slot per
     (shot, core) per call.  ``prebuilt``: optional ``(toeplitz, basis)``
     built once by the caller — pass it when calling from inside a loop
@@ -444,7 +481,7 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
     chunk = _aligned_chunk(chunk, W, interps)
     n_chunks = -(-W // chunk)
     sc, state_sel, oh_slot, has_pending = \
-        _compact_pending_slot(st, valid, tables)
+        _compact_pending_slot(st, valid, tables, cw)
     # honor the W truncation exactly (the last chunk may run past W, and
     # a model.window_samples shorter than the natural envelope window
     # must clip the integration the way the unchunked path's shape did)
@@ -464,13 +501,25 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
         basis = _carrier_basis(tables[1], n_chunks * chunk)
 
     def chunk_body(carry, k):
-        acc_i, acc_q, energy = carry
+        if colored is None:
+            acc_i, acc_q, energy = carry
+        else:
+            acc_i, acc_q, energy, n_prev = carry
         y_i, y_q = _synth_window_chunk(sc, toeplitz, basis, k * chunk,
                                        chunk, interps)           # [B,C,1,w]
         # one fused I+Q noise draw (leading axis of 2 — a TRAILING axis
         # of 2 would tile-pad 64x on TPU (8,128) lanes and blow HBM)
-        nz = sigma * jax.random.normal(
+        white = jax.random.normal(
             jax.random.fold_in(key, k), (2, B, C, 1, chunk), jnp.float32)
+        if colored is None:
+            nz = sigma * white
+        else:
+            # AR(1) coloring: whites through the triangular kernel plus
+            # the cross-chunk IIR carry (exact stationary process)
+            T_rho, rpow = colored
+            n_cur = jnp.einsum('zbcms,ts->zbcmt', white, T_rho) \
+                + n_prev[..., None] * rpow
+            nz = sigma * n_cur
         # resonator ring-up: the state-dependent transmission builds as
         # w(s) = 1 - exp(-(s+1)/ring_tau) over the window (the template
         # y and the ADC noise are NOT scaled — only the signal path).
@@ -488,19 +537,26 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
         acc_i = acc_i + jnp.sum(r_i * y_i + r_q * y_q, axis=-1)  # [B,C,1]
         acc_q = acc_q + jnp.sum(r_q * y_i - r_i * y_q, axis=-1)
         energy = energy + jnp.sum(y_i * y_i + y_q * y_q, axis=-1)
-        return (acc_i, acc_q, energy), None
+        if colored is None:
+            return (acc_i, acc_q, energy), None
+        return (acc_i, acc_q, energy, n_cur[..., -1]), None
 
     zeros = jnp.zeros((B, C, 1), jnp.float32)
-    (acc_i, acc_q, energy), _ = jax.lax.scan(
-        chunk_body, (zeros, zeros, zeros),
-        jnp.arange(n_chunks, dtype=jnp.int32))
+    carry0 = (zeros, zeros, zeros)
+    if colored is not None:
+        # stationary initial IIR state (unit variance, like the process)
+        carry0 = carry0 + (jax.random.normal(
+            jax.random.fold_in(key, 0x41523149), (2, B, C, 1), jnp.float32),)
+    (acc_i, acc_q, energy, *_), _ = jax.lax.scan(
+        chunk_body, carry0, jnp.arange(n_chunks, dtype=jnp.int32))
     new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)[..., 0]
     return _scatter_slot_bit(bits, valid, new_bit, oh_slot, has_pending)
 
 
 def _resolve_fused(st: dict, bits, valid, key, tables, fused_tables,
                    response, W: int, Lp: int, ck: int, ring: bool = False,
-                   native_rng: bool = None, rows: tuple = None):
+                   native_rng: bool = None, rows: tuple = None,
+                   cw: int = 0):
     """Slot-compacted resolve through the fused Pallas kernel
     (:func:`..ops.resolve_pallas.resolve_windows_fused`): same
     per-sample chain as :func:`_resolve` with every intermediate in
@@ -512,7 +568,7 @@ def _resolve_fused(st: dict, bits, valid, key, tables, fused_tables,
     from ..ops.resolve_pallas import resolve_windows_fused
     g0, g1, sigma, inv_ring = response
     sc, state_sel, oh_slot, has_pending = \
-        _compact_pending_slot(st, valid, tables)
+        _compact_pending_slot(st, valid, tables, cw)
     state_sel = state_sel[..., 0]                             # [B, C]
     gs = jnp.where(state_sel[..., None] == 1,
                    g1[None, :, :], g0[None, :, :])            # [B, C, 2]
@@ -537,7 +593,7 @@ def _discriminate_acc(acc_i, acc_q, energy, g0, g1):
 
 
 def _resolve_analytic(st: dict, bits, valid, key, tables, env_pads,
-                      response, W: int):
+                      response, W: int, cw: int = 0):
     """Exact distributional shortcut of :func:`_resolve` for the
     white-noise matched-filter model.
 
@@ -563,7 +619,7 @@ def _resolve_analytic(st: dict, bits, valid, key, tables, env_pads,
     B, C, M = bits.shape
     fired = jnp.arange(M)[None, None, :] < st['n_meas'][..., None]
     pending = fired & ~valid
-    sc = _window_scalars(st, tables)
+    sc = _window_scalars(st, tables, cw)
 
     env_i_pad, env_q_pad = env_pads                   # [C, Lp]
     Lp = env_i_pad.shape[1]
@@ -626,9 +682,21 @@ def _static_meas_env_addrs(mp, max_rows: int = 8):
     return tuple(addrs) if len(addrs) <= max_rows else None
 
 
+_MODE_CODES = {'persample': 0, 'fused': 1, 'analytic': 2}
+
+
+def _tables_meta(model: 'ReadoutPhysics', W: int, interps: tuple) -> tuple:
+    """The build parameters a prebuilt tables dict must match: window,
+    aligned chunk, resolve mode, and measurement element — mismatches
+    would make dynamic_slice clamping silently read wrong table chunks
+    (advisor round-3)."""
+    return (W, _aligned_chunk(model.resolve_chunk, W, interps),
+            _MODE_CODES[model.resolve_mode], int(model.meas_elem))
+
+
 def _build_mode_tables(env_stack, freq_stack, mode: str, W: int,
                        chunk: int, interps: tuple,
-                       rows: tuple = None) -> dict:
+                       rows: tuple = None, meta: tuple = None) -> dict:
     """Per-mode resolve tables: padded env planes plus the mode's
     precomputed lookup structures (Toeplitz windows + carrier basis for
     'persample'; the DAC-resolution kernel tables for 'fused').
@@ -643,6 +711,11 @@ def _build_mode_tables(env_stack, freq_stack, mode: str, W: int,
     """
     env_pads = _pad_env_planes(env_stack, _aligned_chunk(chunk, W, interps))
     tabs = {'env_pads': env_pads}
+    if meta is not None:
+        # build parameters carried WITH the tables (as a device array so
+        # the dict stays a uniform pytree): run_physics_batch
+        # cross-checks them when prebuilt tables are passed in
+        tabs['meta'] = jnp.asarray(list(meta), jnp.int32)
     if mode == 'persample':
         chunk_a = _aligned_chunk(chunk, W, interps)
         tabs['toeplitz'] = tuple(_toeplitz_tables(env_pads, chunk_a,
@@ -666,8 +739,8 @@ def _build_mode_tables(env_stack, freq_stack, mode: str, W: int,
 
 
 _build_tables_jit = functools.partial(
-    jax.jit, static_argnames=('mode', 'W', 'chunk', 'interps', 'rows'))(
-        _build_mode_tables)
+    jax.jit, static_argnames=('mode', 'W', 'chunk', 'interps', 'rows',
+                              'meta'))(_build_mode_tables)
 
 
 @functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'W',
@@ -675,7 +748,8 @@ _build_tables_jit = functools.partial(
                                              'spcs', 'interps', 'mode',
                                              'ring', 'traits',
                                              'native_rng', 'rows',
-                                             'dev_static'))
+                                             'dev_static', 'cw',
+                                             'colored'))
 def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      tabs, freq_stack, g0, g1, sigma, inv_ring,
                      key, dev_params, meas_u,
@@ -685,7 +759,9 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      mode: str = 'persample', ring: bool = False,
                      traits: tuple = None,
                      native_rng: bool = None, rows: tuple = None,
-                     traj_key=None, dev_static: tuple = None) -> dict:
+                     traj_key=None, dev_static: tuple = None,
+                     cw: int = 0, colored: bool = False,
+                     rho=None) -> dict:
     B = init_states.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
@@ -724,6 +800,8 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
         lp = env_pads[0].shape[1]
     elif mode == 'persample':
         prebuilt = (tabs['toeplitz'], tabs['basis'])
+    colored_tabs = _ar1_tables(
+        rho, _aligned_chunk(chunk, W, interps)) if colored else None
 
     def cond(carry):
         st, bits, valid, ep = carry
@@ -745,15 +823,16 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                         dev, traits)
         if mode == 'analytic':
             bits, valid = _resolve_analytic(st, bits, valid, key, tables,
-                                            env_pads, response, W)
+                                            env_pads, response, W, cw)
         elif mode == 'fused':
             bits, valid = _resolve_fused(
                 st, bits, valid, jax.random.fold_in(key, ep), tables,
-                fused_tables, response, W, lp, ck, ring, native_rng, rows)
+                fused_tables, response, W, lp, ck, ring, native_rng, rows,
+                cw)
         else:
             bits, valid = _resolve(st, bits, valid, jax.random.fold_in(
                 key, ep), tables, env_pads, response, W, chunk, interps,
-                prebuilt, ring)
+                prebuilt, ring, cw, colored_tabs)
         st = dict(st, paused=jnp.zeros_like(st['paused']))
         return st, bits, valid, ep + 1
 
@@ -765,6 +844,68 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
     out['meas_bits_valid'] = valid
     out['epochs'] = ep
     return out
+
+
+def _validate_tables(model: ReadoutPhysics, tables: dict, W: int,
+                     interps: tuple, rows: tuple,
+                     skip_traced: bool = False) -> None:
+    """Check prebuilt resolve tables were built for THIS program/model:
+    a window/chunk/mode/meas_elem mismatch makes the chunk scan's
+    dynamic_slice clamp silently read wrong table chunks, and a stale
+    fused row set makes the kernel's equality select read the wrong
+    envelope.  The build parameters ride with the dict ('meta'/'rows');
+    with ``skip_traced`` they are left unchecked when they are tracers
+    (an outer jit) — eager callers who cache tables and then jit their
+    step should call :func:`validate_physics_tables` once, eagerly,
+    where the values are concrete."""
+    def traced(x):
+        return isinstance(x, jax.core.Tracer)
+    if 'meta' in tables:
+        if traced(tables['meta']):
+            if not skip_traced:
+                raise ValueError(
+                    'validate_physics_tables must run eagerly (the '
+                    'tables are tracers here) — call it before your jit')
+        else:
+            want = list(_tables_meta(model, W, interps))
+            have = np.asarray(tables['meta']).tolist()
+            if have != want:
+                names = ('window_samples W', 'aligned resolve_chunk',
+                         'resolve_mode code', 'meas_elem')
+                bad = {n: (h, w) for n, h, w in zip(names, have, want)
+                       if h != w}
+                raise ValueError(
+                    f'prebuilt tables were built for different resolve '
+                    f'parameters — (built, needed): {bad} — rebuild '
+                    f'with prepare_physics_tables(mp, model)')
+    if model.resolve_mode == 'fused' and not traced(tables.get('rows')):
+        want = [-1] if rows is None else list(rows)
+        have = np.asarray(tables['rows']).tolist() \
+            if 'rows' in tables else None
+        if have != want:
+            raise ValueError(
+                f'prebuilt tables were built for envelope addresses '
+                f'{have}, but this program/model needs {want} — '
+                f'rebuild with prepare_physics_tables(mp, model)')
+
+
+def validate_physics_tables(mp, model: ReadoutPhysics,
+                            tables: dict) -> None:
+    """Eagerly validate prebuilt tables against ``(mp, model)``.
+
+    :func:`run_physics_batch` performs this check automatically when it
+    runs eagerly, but inside an outer ``jax.jit`` the carried build
+    parameters are tracers and cannot be compared — so a caller that
+    caches ``prepare_physics_tables`` output and passes it into a
+    jitted step should call this once, eagerly, at table-cache time
+    (the sweep driver does; parallel/driver.py)."""
+    env_stack, freq_stack, spc_m, interp_m, w_auto = \
+        _physics_tables(mp, model.meas_elem)
+    W = int(model.window_samples or w_auto)
+    interps = tuple(int(x) for x in np.asarray(interp_m))
+    rows = _static_meas_env_addrs(mp) if model.resolve_mode == 'fused' \
+        else None
+    _validate_tables(model, tables, W, interps, rows, skip_traced=False)
 
 
 def physics_config(base: InterpreterConfig, model: ReadoutPhysics,
@@ -779,7 +920,7 @@ def physics_config(base: InterpreterConfig, model: ReadoutPhysics,
     base = base if base is not None else InterpreterConfig()
     defaults = InterpreterConfig()
     overrides = {}
-    for name in ('x90_amp', 'drive_elem', 'meas_elem'):
+    for name in ('x90_amp', 'drive_elem', 'meas_elem', 'cw_horizon'):
         if name in kw:
             raise ValueError(
                 f'{name} is set on the ReadoutPhysics model for physics '
@@ -815,11 +956,13 @@ def prepare_physics_tables(mp, model: ReadoutPhysics) -> dict:
     env_stack, freq_stack, spc_m, interp_m, w_auto = \
         _physics_tables(mp, model.meas_elem)
     W = int(model.window_samples or w_auto)
+    interps = tuple(int(x) for x in np.asarray(interp_m))
     return _build_tables_jit(
         env_stack, freq_stack, model.resolve_mode, W, model.resolve_chunk,
-        tuple(int(x) for x in np.asarray(interp_m)),
+        interps,
         _static_meas_env_addrs(mp) if model.resolve_mode == 'fused'
-        else None)
+        else None,
+        _tables_meta(model, W, interps))
 
 
 def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
@@ -902,6 +1045,19 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     # worst case (the loop exits early once every shot is done)
     if model.resolve_mode not in ('persample', 'fused', 'analytic'):
         raise ValueError(f'unknown resolve_mode {model.resolve_mode!r}')
+    if model.cw_horizon < 0 or model.cw_horizon > W:
+        raise ValueError(
+            f'cw_horizon={model.cw_horizon} must lie in [0, W={W}] — '
+            f'the resolve tables cover W samples; raise '
+            f'window_samples to integrate longer CW windows')
+    if not 0.0 <= model.noise_ar1 < 1.0:
+        raise ValueError(f'noise_ar1={model.noise_ar1} must be in [0, 1)')
+    if model.noise_ar1 > 0 and model.resolve_mode != 'persample':
+        raise ValueError(
+            f"resolve_mode={model.resolve_mode!r} generates white ADC "
+            f"noise (analytic: closed form; fused: in-kernel "
+            f"generator); colored noise (noise_ar1 > 0) needs "
+            f"resolve_mode='persample'")
     if model.ring_tau > 0 and model.resolve_mode == 'analytic':
         import warnings
         warnings.warn(
@@ -914,25 +1070,16 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     interps = tuple(int(x) for x in np.asarray(interp_m))
     rows = _static_meas_env_addrs(mp) if model.resolve_mode == 'fused' \
         else None
-    if tables is not None and model.resolve_mode == 'fused' \
-            and not isinstance(tables.get('rows'), jax.core.Tracer):
-        # prebuilt tables must have been built for THIS program's static
-        # envelope addresses — the kernel's row select silently reads
-        # the wrong envelope otherwise
-        want = [-1] if rows is None else list(rows)
-        have = np.asarray(tables['rows']).tolist() \
-            if 'rows' in tables else None
-        if have != want:
-            raise ValueError(
-                f'prebuilt tables were built for envelope addresses '
-                f'{have}, but this program/model needs {want} — '
-                f'rebuild with prepare_physics_tables(mp, model)')
+    if tables is not None:
+        _validate_tables(model, tables, W, interps, rows,
+                         skip_traced=True)
     if tables is None:
         # eager call: separate small compile; under an outer trace this
         # inlines (the status quo for jit-wrapped callers)
         tables = _build_tables_jit(env_stack, freq_stack,
                                    model.resolve_mode, W,
-                                   model.resolve_chunk, interps, rows)
+                                   model.resolve_chunk, interps, rows,
+                                   _tables_meta(model, W, interps))
     return _run_physics_jit(
         soa, spc, interp, sync_part, init_states, init_regs, tables,
         freq_stack, as_iq(model.g0), as_iq(model.g1),
@@ -941,4 +1088,6 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         C * cfg.max_meas + 1, model.resolve_chunk,
         tuple(int(x) for x in np.asarray(spc_m)), interps,
         model.resolve_mode, model.ring_tau > 0, program_traits(mp),
-        model.fused_native_rng, rows, traj_key, dev_static)
+        model.fused_native_rng, rows, traj_key, dev_static,
+        int(model.cw_horizon), model.noise_ar1 > 0,
+        jnp.float32(model.noise_ar1))

@@ -74,7 +74,7 @@ class SweepAccumulator:
 
     @classmethod
     def resume(cls, path: str, checkpoint_every: int = 0,
-               meta: dict = None) -> 'SweepAccumulator':
+               meta: dict = None, strict: bool = False) -> 'SweepAccumulator':
         """Load the checkpoint at ``path`` (fresh accumulator if absent).
 
         With ``meta`` given, a checkpoint whose stored identity differs
@@ -83,8 +83,17 @@ class SweepAccumulator:
         with *no* stored identity (written before fingerprinting, or by
         an older fingerprint version) is treated as legacy: accepted
         with a warning rather than rejected, since there is nothing to
-        compare against.
+        compare against.  ``strict=True`` upgrades both legacy paths to
+        hard errors — no identity and no version skew are tolerated, so
+        fields whose representation changed between fingerprint versions
+        (and would otherwise be skipped with a warning) can never smuggle
+        a different sweep past validation.
         """
+        if strict and meta is None:
+            raise ValueError(
+                'strict=True requires meta (the identity to validate '
+                'against) — without it strict resume would be a silent '
+                'no-op')
         acc = cls(path, checkpoint_every, meta=meta)
         if os.path.exists(path):
             arrays, stored = load_results(path)
@@ -94,6 +103,13 @@ class SweepAccumulator:
                 import warnings
                 want_ver = acc.meta.get('fingerprint_version')
                 have_ver = stored.get('fingerprint_version')
+                if strict and (not stored or have_ver != want_ver):
+                    raise ValueError(
+                        f'strict resume: checkpoint {path} has '
+                        f'fingerprint version {have_ver if stored else None}'
+                        f' but this sweep requires {want_ver} — '
+                        f'version-skewed/unfingerprinted checkpoints are '
+                        f'rejected under strict=True')
                 if not stored:
                     warnings.warn(
                         f'checkpoint {path} carries no identity — '

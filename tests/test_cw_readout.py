@@ -1,0 +1,110 @@
+"""Finite-horizon CW readout demodulation (round-3 weak #5).
+
+The element contract allows CW (hold-until-next) readout envelopes
+(reference: python/distproc/hwconfig.py:12-67 get_cw_env_word); round 3
+flagged them as ERR_CW_MEAS because a CW window has no intrinsic
+length.  ``ReadoutPhysics.cw_horizon`` closes the hole: CW measurement
+windows demodulate over a configured horizon, with the envelope playing
+through its table and holding the final sample.
+
+The pin: the default qchip's rdlo envelope is a square, so a CW window
+with horizon equal to the finite envelope's sample count must produce
+BIT-IDENTICAL results to the finite program under the same key — in
+every resolve mode — and the analytic closed form must agree with the
+per-sample chain exactly at sigma=0.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu.elements import ENV_CW_SENTINEL
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.sim.interpreter import ERR_CW_MEAS
+from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                   run_physics_batch)
+
+KW = dict(max_steps=1024, max_pulses=8, max_meas=2)
+SHOTS = 256
+
+
+@pytest.fixture(scope='module')
+def programs():
+    """(finite_mp, cw_mp, n_samp): the same compiled read program with
+    the rdlo env word patched to the CW sentinel in the copy."""
+    import copy
+    sim = Simulator(n_qubits=1)
+    mp = sim.compile([{'name': 'read', 'qubit': ['Q0']}])
+    soa = mp.soa
+    meas_rows = (np.asarray(soa.p_cfg) & 0b11) == 2
+    assert np.any(meas_rows)
+    envw = int(np.asarray(soa.p_env)[meas_rows][0])
+    n_words, addr = (envw >> 12) & 0xfff, envw & 0xfff
+    ecfg = mp.tables[0].elem_cfgs[2]
+    n_samp = n_words * 4 * int(ecfg.interp_ratio)
+    cw_mp = copy.deepcopy(mp)
+    cw_mp.soa.p_env[np.asarray(meas_rows)] = \
+        (ENV_CW_SENTINEL << 12) | addr
+    return mp, cw_mp, n_samp
+
+
+def test_cw_without_horizon_is_an_error(programs):
+    _, cw_mp, _ = programs
+    model = ReadoutPhysics(sigma=0.0)
+    out = run_physics_batch(cw_mp, model, 0, 4, **KW)
+    err = np.asarray(out['err'])
+    assert np.all(err & ERR_CW_MEAS), 'CW readout must flag ERR_CW_MEAS'
+
+
+@pytest.mark.parametrize('mode', ['persample', 'fused', 'analytic'])
+def test_cw_matches_finite_square_window(programs, mode):
+    """Square envelope + hold == square envelope: CW at horizon n_samp
+    is bit-identical to the finite program, per resolve mode."""
+    mp, cw_mp, n_samp = programs
+    kw = dict(sigma=15.0, p1_init=0.5, resolve_mode=mode)
+    fin = run_physics_batch(mp, ReadoutPhysics(**kw), 7, SHOTS, **KW)
+    cw = run_physics_batch(cw_mp, ReadoutPhysics(cw_horizon=n_samp, **kw),
+                           7, SHOTS, **KW)
+    for out in (fin, cw):
+        assert not np.any(np.asarray(out['err']))
+        assert not bool(out['incomplete'])
+    np.testing.assert_array_equal(np.asarray(fin['meas_bits']),
+                                  np.asarray(cw['meas_bits']))
+    # the noise is doing real work: some assignment errors at this sigma
+    mism = np.asarray(cw['meas_bits'])[:, 0, 0] \
+        != np.asarray(cw['meas_state'])[:, 0, 0]
+    assert 0 < mism.mean() < 0.5
+
+
+def test_cw_analytic_agrees_with_persample_noiseless(programs):
+    _, cw_mp, n_samp = programs
+    outs = [run_physics_batch(
+        cw_mp, ReadoutPhysics(sigma=0.0, p1_init=0.5, resolve_mode=m,
+                              cw_horizon=n_samp), 3, SHOTS, **KW)
+        for m in ('persample', 'analytic')]
+    np.testing.assert_array_equal(np.asarray(outs[0]['meas_bits']),
+                                  np.asarray(outs[1]['meas_bits']))
+    # noiseless discrimination is perfect
+    np.testing.assert_array_equal(np.asarray(outs[0]['meas_bits'])[:, 0, 0],
+                                  np.asarray(outs[0]['meas_state'])[:, 0, 0])
+
+
+def test_cw_shorter_horizon_less_energy(programs):
+    """Half the horizon integrates half the energy: assignment error at
+    fixed sigma must rise."""
+    _, cw_mp, n_samp = programs
+    errs = []
+    for h in (n_samp, n_samp // 4):
+        out = run_physics_batch(
+            cw_mp, ReadoutPhysics(sigma=12.0, p1_init=0.5,
+                                  cw_horizon=h), 11, 2048, **KW)
+        bits = np.asarray(out['meas_bits'])[:, 0, 0]
+        true = np.asarray(out['meas_state'])[:, 0, 0]
+        errs.append((bits != true).mean())
+    assert errs[1] > errs[0] * 1.5, errs
+
+
+def test_cw_horizon_validation(programs):
+    _, cw_mp, _ = programs
+    with pytest.raises(ValueError, match='cw_horizon'):
+        run_physics_batch(cw_mp, ReadoutPhysics(cw_horizon=10**6), 0, 2,
+                          **KW)

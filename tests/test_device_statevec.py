@@ -263,6 +263,76 @@ def test_event_gate_fproc_no_deadlock():
     assert not np.any(np.asarray(out['err']))
 
 
+def test_event_gate_sticky_serves_final_snapshot():
+    """Regression (review round 4): under the gate, a sticky read whose
+    producer sits at a far-future pending trigger must be SERVED (the
+    latched snapshot is final — any future measurement lands at
+    frontier + latency, past the request), not deadlocked, and other
+    cores' time-later pulses must NOT be admitted ahead of the reader's
+    earlier ones.  Core 1 reads core 0's bit at ~117 and branches into
+    a pulse at 130 while core 0 still holds a pending trigger at 1000;
+    correct outcome: the read serves bit 1, the branch pulse fires, and
+    the run completes with no error."""
+    from distributed_processor_tpu import isa
+    from distributed_processor_tpu.decoder import machine_program_from_cmds
+    mp = machine_program_from_cmds([
+        # producer: measurement at 10 (avail 74+), then a far pulse
+        [isa.pulse_cmd(cmd_time=10, cfg_word=2, env_word=(8 << 12),
+                       amp_word=30000),
+         isa.pulse_cmd(cmd_time=1000, cfg_word=0, env_word=4096),
+         isa.done_cmd()],
+        # reader: idle to 114, sticky-read producer, guarded pulse
+        [isa.idle(114),
+         isa.alu_cmd('jump_fproc', 'i', 1, 'eq', jump_cmd_ptr=3,
+                     func_id=0),
+         isa.jump_i(4),
+         isa.pulse_cmd(cmd_time=130, cfg_word=0, env_word=4096),
+         isa.done_cmd()],
+    ])
+    # hand-built programs carry empty envelope tables: give the
+    # measurement element a real window so the resolver has energy
+    for t in mp.tables:
+        t.envs[2] = np.ones(32, complex)
+        t.freqs[2] = {'freq': np.array([0.0]), 'iq15': np.zeros((1, 15))}
+    model = ReadoutPhysics(sigma=0.0, p1_init=1.0, device=DeviceModel(
+        'statevec', couplings=((0, 0, 1, 'zx'),)))
+    out = run_physics_batch(mp, model, 0, 4, fabric='sticky',
+                            init_states=np.ones((4, 2), np.int32),
+                            max_steps=512)
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
+    # the guarded pulse fired: the sticky read served bit 1
+    assert np.all(np.asarray(out['n_pulses'])[:, 1] == 1)
+
+
+def test_event_gate_chain_no_deadlock():
+    """Regression (review round 4): frontier bounds must propagate
+    through multi-link stall chains.  Core 0 fproc-reads core 1's
+    unfired measurement; core 1 waits at a sync barrier with core 2;
+    core 2 holds the only pending pulse trigger — with one-level
+    inheritance core 2's pulse stalls on core 0's frozen clock forever.
+    The fixpoint raises core 0's bound through core 1's sync bound to
+    core 2's trigger, so the pulse fires and everything completes."""
+    from distributed_processor_tpu import isa
+    from distributed_processor_tpu.decoder import machine_program_from_cmds
+    mp = machine_program_from_cmds([
+        [isa.alu_cmd('jump_fproc', 'i', 1, 'eq', jump_cmd_ptr=2,
+                     func_id=1),
+         isa.jump_i(2),
+         isa.done_cmd()],
+        [isa.sync(0), isa.pulse_cmd(cmd_time=5, cfg_word=2, env_word=0),
+         isa.done_cmd()],
+        [isa.pulse_cmd(cmd_time=100, cfg_word=0, env_word=4096),
+         isa.sync(0), isa.done_cmd()],
+    ])
+    model = ReadoutPhysics(sigma=0.0, device=DeviceModel(
+        'statevec', couplings=((0, 0, 1, 'zx'),)))
+    out = run_physics_batch(mp, model, 0, 4, fabric='fresh',
+                            max_steps=512)
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
+
+
 def test_coupling_validation():
     with pytest.raises(ValueError, match='coupling'):
         DeviceModel('statevec', couplings=((0, 0, 0, 'zx'),))

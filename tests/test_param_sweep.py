@@ -227,3 +227,60 @@ def test_physics_sweep_warns_on_incomplete_batches(tmp_path):
         out = run_physics_sweep(mp, model, 32, 16, key=5,
                                 max_steps=3, max_pulses=8, max_meas=2)
     assert out['incomplete_batches'] == 2
+
+
+def test_prebuilt_tables_mismatch_rejected():
+    """Advisor round-3: tables built for a different window/chunk/mode/
+    meas_elem must be rejected, not silently chunk-sliced wrong."""
+    from dataclasses import replace
+    from distributed_processor_tpu.simulator import Simulator
+    from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                       prepare_physics_tables,
+                                                       run_physics_batch)
+    sim = Simulator(n_qubits=1)
+    mp = sim.compile([{'name': 'X90', 'qubit': ['Q0']},
+                      {'name': 'read', 'qubit': ['Q0']}])
+    model = ReadoutPhysics(sigma=0.0, window_samples=512)
+    tabs = prepare_physics_tables(mp, model)
+    # matching tables run fine
+    out = run_physics_batch(mp, model, 0, 2, tables=tabs, max_steps=512,
+                            max_pulses=8, max_meas=2)
+    assert not bool(out['incomplete'])
+    for wrong in (replace(model, window_samples=256),
+                  replace(model, resolve_chunk=64),
+                  replace(model, resolve_mode='analytic')):
+        with pytest.raises(ValueError, match='different resolve'):
+            run_physics_batch(mp, wrong, 0, 2, tables=tabs, max_steps=512,
+                              max_pulses=8, max_meas=2)
+
+
+def test_strict_resume_rejects_version_skew(tmp_path):
+    """Advisor round-3: strict=True refuses unfingerprinted or
+    version-skewed checkpoints that the lenient path accepts with a
+    warning."""
+    from distributed_processor_tpu.utils.results import SweepAccumulator
+    path = str(tmp_path / 'acc.npz')
+    # legacy checkpoint: no identity at all
+    acc = SweepAccumulator(path)
+    acc.add({'n': np.int64(3)})
+    acc.save()
+    meta = {'fingerprint_version': 2, 'batch': 16}
+    with pytest.warns(UserWarning, match='no identity'):
+        SweepAccumulator.resume(path, meta=meta)
+    with pytest.raises(ValueError, match='strict resume'):
+        SweepAccumulator.resume(path, meta=meta, strict=True)
+    # version-skewed checkpoint
+    acc = SweepAccumulator(path, meta={'fingerprint_version': 1,
+                                       'batch': 16})
+    acc.add({'n': np.int64(3)})
+    acc.save()
+    with pytest.warns(UserWarning, match='fingerprint version'):
+        SweepAccumulator.resume(path, meta=meta)
+    with pytest.raises(ValueError, match='strict resume'):
+        SweepAccumulator.resume(path, meta=meta, strict=True)
+    # matching version passes strict
+    acc = SweepAccumulator(path, meta=meta)
+    acc.add({'n': np.int64(3)})
+    acc.save()
+    got = SweepAccumulator.resume(path, meta=meta, strict=True)
+    assert got.n_batches == 1

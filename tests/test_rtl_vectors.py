@@ -35,6 +35,16 @@ with open(_PATH) as f:
 CSTROBE_DELAY = _VECTORS['cstrobe_delay']
 
 
+def _fabric_kwargs(case) -> dict:
+    kw = {}
+    if 'fabric' in case:
+        kw['fabric'] = case['fabric']
+    if 'lut_mask' in case:
+        kw['lut_mask'] = tuple(case['lut_mask'])
+        kw['lut_table'] = tuple(case['lut_table'])
+    return kw
+
+
 def _build(case):
     cores = [[getattr(isa, ins['fn'])(**ins['kw']) for ins in core]
              for core in case['cores']]
@@ -81,9 +91,7 @@ def _check_scalars(exp, out, label):
 def test_jax_engine_matches_rtl_vectors(case):
     mp = _build(case)
     exp = case['expected']
-    kw = {}
-    if 'fabric' in case:
-        kw['fabric'] = case['fabric']
+    kw = _fabric_kwargs(case)
     meas = np.asarray(case['meas_bits'], np.int32) \
         if case.get('meas_bits') is not None else None
     out = simulate(mp, meas_bits=meas, max_meas=4, **kw)
@@ -113,9 +121,7 @@ def test_jax_engine_matches_rtl_vectors(case):
 def test_oracle_matches_rtl_vectors(case):
     mp = _build(case)
     exp = case['expected']
-    kw = {}
-    if 'fabric' in case:
-        kw['fabric'] = case['fabric']
+    kw = _fabric_kwargs(case)
     meas = np.asarray(case['meas_bits']) \
         if case.get('meas_bits') is not None else None
     out = run_oracle(mp, meas_bits=meas, **kw)
