@@ -133,6 +133,9 @@ def correlated_noise_stage(pairs, qchip=None) -> list[dict]:
     strength (tests/test_repetition_correlated.py)."""
     out = []
     qubits = sorted({q for ab in pairs for q in ab})
+    if qchip is None and pairs:
+        from .default_qchip import make_default_qchip
+        qchip = make_default_qchip(max(qubits) + 1)
     for a, b in pairs:
         out.append({'name': 'barrier',
                     'qubit': [f'Q{q}' for q in qubits]})
@@ -144,6 +147,10 @@ def independent_noise_stage(qubits, qchip=None) -> list[dict]:
     """Per-qubit independent error injection: one zero-amplitude 1q
     drive pulse per qubit; ``DeviceModel.depol_per_pulse = p`` then
     flips each qubit independently with probability 2p/3."""
+    qubits = list(qubits)
+    if qchip is None and qubits:
+        from .default_qchip import make_default_qchip
+        qchip = make_default_qchip(max(qubits) + 1)
     return [_zero_amp_pulse(q, q, qchip) for q in qubits]
 
 

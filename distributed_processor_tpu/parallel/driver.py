@@ -85,6 +85,7 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
                       key=0, cfg: InterpreterConfig = None,
                       init_regs=None, checkpoint: str = None,
                       checkpoint_every: int = 0, mesh=None,
+                      strict_resume: bool = False,
                       **cfg_kw) -> dict:
     """Physics-closed sweep: ``total_shots`` in ``batch``-sized steps.
 
@@ -172,7 +173,11 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     if checkpoint and checkpoint_every <= 0:
         checkpoint_every = 1          # a requested checkpoint that never
                                       # writes mid-run resumes nothing
-    acc = SweepAccumulator.resume(checkpoint, checkpoint_every, meta=meta) \
+    # strict_resume: reject version-skewed/unfingerprinted checkpoints
+    # outright instead of the warn-and-accept legacy path
+    # (utils/results.py SweepAccumulator.resume)
+    acc = SweepAccumulator.resume(checkpoint, checkpoint_every, meta=meta,
+                                  strict=strict_resume) \
         if checkpoint else SweepAccumulator(meta=meta)
     if acc.n_batches > n_batches:
         raise ValueError(

@@ -685,13 +685,31 @@ def _static_meas_env_addrs(mp, max_rows: int = 8):
 _MODE_CODES = {'persample': 0, 'fused': 1, 'analytic': 2}
 
 
-def _tables_meta(model: 'ReadoutPhysics', W: int, interps: tuple) -> tuple:
+def _tables_meta(model: 'ReadoutPhysics', W: int, interps: tuple,
+                 mp=None) -> tuple:
     """The build parameters a prebuilt tables dict must match: window,
-    aligned chunk, resolve mode, and measurement element — mismatches
-    would make dynamic_slice clamping silently read wrong table chunks
-    (advisor round-3)."""
+    aligned chunk, resolve mode, measurement element, and a digest of
+    the program's measurement-element envelope/frequency CONTENT —
+    a W/chunk mismatch makes dynamic_slice clamping silently read wrong
+    table chunks, and same-shape tables from a different program would
+    otherwise demodulate with the wrong envelopes (advisor round-3 +
+    round-4 review)."""
+    import zlib
+    digest = 0
+    if mp is not None:
+        h = 0
+        for c in range(mp.n_cores):
+            t = mp.tables[c]
+            if model.meas_elem < len(t.envs):
+                h = zlib.crc32(np.ascontiguousarray(
+                    np.asarray(t.envs[model.meas_elem])).tobytes(), h)
+            if model.meas_elem < len(t.freqs):
+                h = zlib.crc32(np.ascontiguousarray(np.asarray(
+                    t.freqs[model.meas_elem]['freq'], np.float64))
+                    .tobytes(), h)
+        digest = int(h) & 0x7fffffff
     return (W, _aligned_chunk(model.resolve_chunk, W, interps),
-            _MODE_CODES[model.resolve_mode], int(model.meas_elem))
+            _MODE_CODES[model.resolve_mode], int(model.meas_elem), digest)
 
 
 def _build_mode_tables(env_stack, freq_stack, mode: str, W: int,
@@ -846,7 +864,7 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
     return out
 
 
-def _validate_tables(model: ReadoutPhysics, tables: dict, W: int,
+def _validate_tables(mp, model: ReadoutPhysics, tables: dict, W: int,
                      interps: tuple, rows: tuple,
                      skip_traced: bool = False) -> None:
     """Check prebuilt resolve tables were built for THIS program/model:
@@ -867,11 +885,12 @@ def _validate_tables(model: ReadoutPhysics, tables: dict, W: int,
                     'validate_physics_tables must run eagerly (the '
                     'tables are tracers here) — call it before your jit')
         else:
-            want = list(_tables_meta(model, W, interps))
+            want = list(_tables_meta(model, W, interps, mp))
             have = np.asarray(tables['meta']).tolist()
             if have != want:
                 names = ('window_samples W', 'aligned resolve_chunk',
-                         'resolve_mode code', 'meas_elem')
+                         'resolve_mode code', 'meas_elem',
+                         'envelope/frequency content digest')
                 bad = {n: (h, w) for n, h, w in zip(names, have, want)
                        if h != w}
                 raise ValueError(
@@ -905,7 +924,7 @@ def validate_physics_tables(mp, model: ReadoutPhysics,
     interps = tuple(int(x) for x in np.asarray(interp_m))
     rows = _static_meas_env_addrs(mp) if model.resolve_mode == 'fused' \
         else None
-    _validate_tables(model, tables, W, interps, rows, skip_traced=False)
+    _validate_tables(mp, model, tables, W, interps, rows, skip_traced=False)
 
 
 def physics_config(base: InterpreterConfig, model: ReadoutPhysics,
@@ -962,7 +981,7 @@ def prepare_physics_tables(mp, model: ReadoutPhysics) -> dict:
         interps,
         _static_meas_env_addrs(mp) if model.resolve_mode == 'fused'
         else None,
-        _tables_meta(model, W, interps))
+        _tables_meta(model, W, interps, mp))
 
 
 def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
@@ -1071,7 +1090,7 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     rows = _static_meas_env_addrs(mp) if model.resolve_mode == 'fused' \
         else None
     if tables is not None:
-        _validate_tables(model, tables, W, interps, rows,
+        _validate_tables(mp, model, tables, W, interps, rows,
                          skip_traced=True)
     if tables is None:
         # eager call: separate small compile; under an outer trace this
@@ -1079,7 +1098,7 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         tables = _build_tables_jit(env_stack, freq_stack,
                                    model.resolve_mode, W,
                                    model.resolve_chunk, interps, rows,
-                                   _tables_meta(model, W, interps))
+                                   _tables_meta(model, W, interps, mp))
     return _run_physics_jit(
         soa, spc, interp, sync_part, init_states, init_regs, tables,
         freq_stack, as_iq(model.g0), as_iq(model.g1),

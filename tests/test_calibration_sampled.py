@@ -1,0 +1,94 @@
+"""Sampled-bit calibration recovery at realistic scale (round-3 weak #4).
+
+The bloch-device expectation tests read ``meas_p1`` with one shot and
+sigma=0 — exact but not what a real calibration run does.  This is the
+real workflow: per-core device parameters are recovered from SAMPLED
+BITS, at realistic shot counts, through the NOISY readout channel
+(finite sigma -> a few % assignment error), with every point executed
+by the dp-sharded sweep driver over the 8-device CPU mesh — the same
+path a hardware calibration would take (readout + fproc contract,
+reference: python/distproc/hwconfig.py:69-98).
+
+The free amplitude/offset in the fitters absorbs the readout-error
+contrast loss ((1-2*eps) scaling), so frequency and decay constants
+recover unbiased; tolerances are CI-stable at these shot counts.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu.analysis import fit_ramsey, fit_t1
+from distributed_processor_tpu.models.experiments import (ramsey_program,
+                                                          t1_program)
+from distributed_processor_tpu.parallel import run_physics_sweep, make_mesh
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.sim.device import DeviceModel
+from distributed_processor_tpu.sim.physics import ReadoutPhysics
+
+KW = dict(max_steps=2000, max_pulses=32, max_meas=2)
+SHOTS, BATCH = 2048, 2048     # per delay point; dp=8 -> 256 per shard
+
+
+def _p1_curves(sim, programs, model, mesh, key0=0):
+    """meas1_rate per core per program point, via the sweep driver."""
+    curves = []
+    for i, prog in enumerate(programs):
+        mp = sim.compile(prog)
+        out = run_physics_sweep(mp, model, SHOTS, BATCH, key=key0 + i,
+                                mesh=mesh, **KW)
+        assert out['err_shots'] == 0 and out['incomplete_batches'] == 0
+        curves.append(out['meas1_rate'])
+    return np.stack(curves)                      # [points, n_cores]
+
+
+def test_ramsey_detuning_per_core_from_sampled_bits():
+    """Per-core detunings recovered from noisy sampled-bit Ramsey
+    fringes on the mesh — distinct values per core, ~15 readout-error
+    percent contrast loss absorbed by the fit."""
+    mesh = make_mesh(n_dp=8)
+    sim = Simulator(n_qubits=2)
+    det = (0.5e6, 0.8e6)
+    model = ReadoutPhysics(
+        sigma=15.0, p1_init=0.0,
+        device=DeviceModel('bloch', detuning_hz=det, t2_s=40e-6))
+    delays = np.linspace(0.1e-6, 6.1e-6, 14)
+    # both qubits swept in one program: Q0's Ramsey then Q1's
+    progs = [ramsey_program('Q0', float(d)) + ramsey_program('Q1', float(d))
+             for d in delays]
+    curves = _p1_curves(sim, progs, model, mesh)
+    for c, want in enumerate(det):
+        f, _, _ = fit_ramsey(delays, curves[:, c])
+        np.testing.assert_allclose(f, want, rtol=0.05)
+
+
+def test_t1_per_core_from_sampled_bits():
+    """Per-core T1 recovered from sampled-bit decay through the noisy
+    channel on the mesh."""
+    mesh = make_mesh(n_dp=8)
+    sim = Simulator(n_qubits=2)
+    t1s = (12e-6, 25e-6)
+    model = ReadoutPhysics(
+        sigma=15.0, p1_init=0.0,
+        device=DeviceModel('bloch', t1_s=t1s))
+    delays = np.linspace(0.5e-6, 45e-6, 10)
+    progs = [t1_program('Q0', float(d)) + t1_program('Q1', float(d))
+             for d in delays]
+    curves = _p1_curves(sim, progs, model, mesh)
+    for c, want in enumerate(t1s):
+        t1, _ = fit_t1(delays, curves[:, c])
+        np.testing.assert_allclose(t1, want, rtol=0.12)
+
+
+def test_assignment_error_is_really_there():
+    """The channel is genuinely noisy at sigma=15: a |0>-prep read
+    misassigns a few percent of shots — the recovery tests above go
+    through a lossy channel, not a disguised noise-free one."""
+    mesh = make_mesh(n_dp=8)
+    sim = Simulator(n_qubits=1)
+    mp = sim.compile([{'name': 'read', 'qubit': ['Q0']}])
+    model = ReadoutPhysics(sigma=15.0, p1_init=0.0,
+                           device=DeviceModel('bloch'))
+    out = run_physics_sweep(mp, model, SHOTS, BATCH, key=3, mesh=mesh,
+                            **KW)
+    eps = float(out['meas1_rate'][0])
+    assert 0.005 < eps < 0.15, eps

@@ -252,6 +252,19 @@ def test_prebuilt_tables_mismatch_rejected():
         with pytest.raises(ValueError, match='different resolve'):
             run_physics_batch(mp, wrong, 0, 2, tables=tabs, max_steps=512,
                               max_pulses=8, max_meas=2)
+    # same shapes, different CONTENT: a program against another qchip
+    # (shifted readout frequency) must be rejected by the digest
+    from distributed_processor_tpu.models.default_qchip import \
+        make_default_qchip_dict
+    from distributed_processor_tpu.qchip import QChip
+    d = make_default_qchip_dict(1)
+    d['Qubits']['Q0']['readfreq'] = 6.5e9
+    sim_b = Simulator(qchip=QChip(d), n_qubits=1)
+    mp_b = sim_b.compile([{'name': 'X90', 'qubit': ['Q0']},
+                          {'name': 'read', 'qubit': ['Q0']}])
+    with pytest.raises(ValueError, match='digest'):
+        run_physics_batch(mp_b, model, 0, 2, tables=tabs, max_steps=512,
+                          max_pulses=8, max_meas=2)
 
 
 def test_strict_resume_rejects_version_skew(tmp_path):
