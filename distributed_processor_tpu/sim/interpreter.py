@@ -136,6 +136,17 @@ class InterpreterConfig:
     # windows over this many samples and the bit becomes available
     # after the corresponding clocks (set via ReadoutPhysics.cw_horizon)
     cw_horizon: int = 0
+    # instruction steps per while_loop iteration (static unroll of the
+    # loop body): >1 amortizes per-iteration overhead XLA cannot fuse
+    # across the while boundary over k steps.  Semantics are identical
+    # — each sub-step runs the full step body including quiescence
+    # detection, and sub-steps past the max_steps budget are masked to
+    # exact no-ops (same results AND step counts as k=1, including
+    # budget-exhausted shots).  Measured a WASH on v5e (the per-step
+    # fixed cost is intra-step kernel latency, not loop-boundary
+    # overhead — docs/PERF.md "the measured overhead budget"); kept as
+    # an exact, tested knob for different devices/programs.
+    steps_per_iter: int = 1
     alu_instr_clks: int = 5
     jump_cond_clks: int = 5
     jump_fproc_clks: int = 8
@@ -1027,9 +1038,10 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
             settled = settled | st['paused']
         return (~jnp.all(settled)) & (st['_steps'] < cfg.max_steps)
 
-    def body(st):
+    def one(st):
         steps = st.pop('_steps')
         paused = st.pop('paused') if cfg.physics else None
+        st_in = st
         st2 = _step(st, steps, soa, spc, interp, sync_part, meas_bits,
                     meas_valid, cfg, dev, traits)
         # quiescence detection per shot: no live core changed state
@@ -1047,8 +1059,30 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
         st2['err'] = jnp.where(hard[:, None] & ~st2['done'],
                                st2['err'] | ERR_FPROC_DEADLOCK, st2['err'])
         st2['done'] = st2['done'] | hard[:, None]
-        st2['_steps'] = steps + 1
+        if cfg.steps_per_iter > 1:
+            # exactness vs k=1: the while condition is only evaluated
+            # between k-step bodies, so sub-steps past the max_steps
+            # budget OR after the batch settles (k=1 would have exited
+            # the loop there, freezing the step budget for later
+            # physics epochs) must be true no-ops — a scalar-predicate
+            # select per carry leaf
+            settled_in = jnp.all(st_in['done'], axis=-1)
+            if cfg.physics:
+                st_in = dict(st_in, paused=paused)
+                settled_in = settled_in | paused
+            ok = (steps < cfg.max_steps) & ~jnp.all(settled_in)
+            st2 = {k: jnp.where(ok, v, st_in[k]) for k, v in st2.items()}
+            st2['_steps'] = jnp.where(ok, steps + 1, steps)
+        else:
+            st2['_steps'] = steps + 1
         return st2
+
+    def body(st):
+        # static unroll: k sub-steps per while iteration (see
+        # InterpreterConfig.steps_per_iter)
+        for _ in range(max(1, cfg.steps_per_iter)):
+            st = one(st)
+        return st
 
     return jax.lax.while_loop(cond, body, st0)
 

@@ -439,3 +439,40 @@ def test_static_env_addrs_fallbacks():
                       amp_word=10, cfg_word=2, cmd_time=10),
         isa.done_cmd()]])
     assert _static_meas_env_addrs(mp2) == (0, 12)   # {0} + 3*4
+
+
+def test_steps_per_iter_unroll_equivalent():
+    """steps_per_iter > 1 (while-body unroll, the exec-phase perf knob)
+    is bit-identical to the default on a feedback program."""
+    from distributed_processor_tpu.simulator import Simulator
+    from distributed_processor_tpu.models.experiments import active_reset
+    from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                       run_physics_batch)
+    sim = Simulator(n_qubits=2)
+    mp = sim.compile(active_reset(['Q0', 'Q1'], n_rounds=2))
+    model = ReadoutPhysics(sigma=0.05, p1_init=0.5)
+    kw = dict(max_steps=4 * mp.n_instr + 64, max_pulses=16, max_meas=4,
+              max_resets=4)
+    base = run_physics_batch(mp, model, 3, 64, **kw)
+    for k in (2, 5):
+        unr = run_physics_batch(mp, model, 3, 64, steps_per_iter=k, **kw)
+        assert not bool(unr['incomplete'])
+        np.testing.assert_array_equal(np.asarray(base['meas_bits']),
+                                      np.asarray(unr['meas_bits']))
+        np.testing.assert_array_equal(np.asarray(base['err']),
+                                      np.asarray(unr['err']))
+        np.testing.assert_array_equal(np.asarray(base['qclk']),
+                                      np.asarray(unr['qclk']))
+    # max_steps-boundary exactness: a budget that cuts execution short
+    # must produce identical results and step counts for every k (the
+    # unroll masks past-budget sub-steps to no-ops)
+    for short in (7, 10):
+        kw_s = dict(kw, max_steps=short)
+        b = run_physics_batch(mp, model, 3, 16, **kw_s)
+        for k in (2, 5):
+            u = run_physics_batch(mp, model, 3, 16, steps_per_iter=k,
+                                  **kw_s)
+            assert int(u['steps']) == int(b['steps'])
+            for f in ('meas_bits', 'err', 'qclk', 'done', 'n_meas'):
+                np.testing.assert_array_equal(np.asarray(b[f]),
+                                              np.asarray(u[f]), f)

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Exec-phase overhead decomposition (round-3 weak #3).
+
+The bench's exec phase (the batched interpreter while_loop, no resolve)
+sits at ~18% of HBM peak; docs/PERF.md attributed the rest to
+per-iteration fusion-boundary overhead without a measurement.  This
+tool produces the measurement: timing the PURE exec phase (injected
+bits — no physics, no resolve) across batch sizes decomposes the
+per-step cost as
+
+    t_batch = I * (a + b * B)
+
+with I the interpreter steps: ``a`` is the per-iteration FIXED cost
+(kernel launches, while-loop condition, carry aliasing — everything
+that does not scale with shots) and ``b`` the per-shot streaming cost
+(the carry-bytes HBM traffic).  The fixed fraction a/(a + b*B) at the
+bench batch is the measured fusion-boundary budget.  A second sweep
+re-times the same program with ``steps_per_iter`` unrolled k sub-steps
+per iteration: overhead that amortizes with k is per-ITERATION
+(recoverable by unrolling); what remains is per-STEP.
+
+    python tools/exec_profile.py            # real chip
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+
+import numpy as np
+
+
+def main():
+    import jax
+    from bench import build_machine_program
+    from distributed_processor_tpu.sim.interpreter import (
+        InterpreterConfig, simulate_batch)
+
+    n_qubits = int(os.environ.get('BENCH_QUBITS', 8))
+    depth = int(os.environ.get('BENCH_DEPTH', 12))
+    reps = int(os.environ.get('PROFILE_REPS', 5))
+    mp = build_machine_program(n_qubits, depth)
+    base = dict(max_steps=2 * mp.n_instr + 64,
+                max_pulses=int(mp.max_pulses_per_core(1)) + 4,
+                max_meas=2, max_resets=2, record_pulses=False)
+    rng = np.random.default_rng(0)
+
+    def timed(B, k):
+        cfg = InterpreterConfig(steps_per_iter=k, **base)
+        bits = rng.integers(0, 2, size=(B, mp.n_cores, 2))
+        out = simulate_batch(mp, bits, cfg=cfg)      # compile + warm
+        jax.block_until_ready(out['steps'])
+        steps = int(out['steps'])
+        ts = []
+        for r in range(reps):
+            bits = rng.integers(0, 2, size=(B, mp.n_cores, 2))
+            t0 = time.perf_counter()
+            out = simulate_batch(mp, bits, cfg=cfg)
+            assert not bool(jax.block_until_ready(out['incomplete']))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)), steps
+
+    result = {'platform': jax.devices()[0].platform,
+              'device': str(jax.devices()[0]),
+              'n_instr': mp.n_instr, 'reps': reps}
+
+    # 1. t(B) decomposition at k=1
+    batches = [int(x) for x in os.environ.get(
+        'PROFILE_BATCHES', '16384,65536,262144').split(',')]
+    rows = []
+    for B in batches:
+        t, steps = timed(B, 1)
+        rows.append((B, t, steps))
+        print(f'B={B:>7} k=1: {t*1e3:8.2f} ms  ({steps} steps)',
+              file=sys.stderr)
+    I = rows[0][2]
+    A = np.array([[1.0, B] for B, _, _ in rows])
+    y = np.array([t / I for _, t, _ in rows])
+    (a, b), *_ = np.linalg.lstsq(A, y, rcond=None)
+    B_bench = batches[-1]
+    fixed_frac = a / (a + b * B_bench)
+    result['per_step_fixed_s'] = float(a)
+    result['per_step_per_shot_s'] = float(b)
+    result['steps'] = I
+    result['fixed_frac_at_bench_batch'] = round(float(fixed_frac), 4)
+    result['t_ms'] = {str(B): round(t * 1e3, 2) for B, t, _ in rows}
+
+    # 2. unroll sweep at the bench batch: does the fixed cost amortize?
+    ks = [int(x) for x in os.environ.get('PROFILE_KS', '1,2,4,8')
+          .split(',')]
+    result['unroll_t_ms'] = {}
+    for k in ks:
+        t, _ = timed(B_bench, k)
+        result['unroll_t_ms'][str(k)] = round(t * 1e3, 2)
+        print(f'B={B_bench} k={k}: {t*1e3:8.2f} ms', file=sys.stderr)
+
+    # 3. unroll sweep at a small batch (fixed cost dominates there, so
+    # any per-iteration amortization shows up amplified)
+    result['unroll_small_t_ms'] = {}
+    for k in ks:
+        t, _ = timed(batches[0], k)
+        result['unroll_small_t_ms'][str(k)] = round(t * 1e3, 2)
+        print(f'B={batches[0]} k={k}: {t*1e3:8.2f} ms', file=sys.stderr)
+
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    main()
