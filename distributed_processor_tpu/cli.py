@@ -154,18 +154,23 @@ def cmd_run(args):
                 'use --p1-init for the thermal initial state instead')
         from .sim.device import DeviceModel
         from .sim.physics import ReadoutPhysics
-        if args.device != 'bloch' and (args.detuning_hz or args.t1_us
-                                       or args.t2_us or args.depol):
+        if args.device != 'statevec' and args.depol2:
+            raise SystemExit('--depol2 (two-qubit Pauli channel on '
+                             'coupling pulses) needs --device statevec')
+        if args.device == 'parity' and (args.detuning_hz or args.t1_us
+                                        or args.t2_us or args.depol):
             raise SystemExit(
                 '--detuning-hz/--t1-us/--t2-us/--depol need '
-                '--device bloch (the parity counter has no such physics)')
+                '--device bloch or statevec (the parity counter has no '
+                'such physics)')
         dev = DeviceModel(args.device,
                           detuning_hz=args.detuning_hz,
                           t1_s=args.t1_us * 1e-6 if args.t1_us else
                           float('inf'),
                           t2_s=args.t2_us * 1e-6 if args.t2_us else
                           float('inf'),
-                          depol_per_pulse=args.depol)
+                          depol_per_pulse=args.depol,
+                          depol2_per_pulse=args.depol2)
         kw['physics'] = ReadoutPhysics(sigma=args.sigma,
                                        p1_init=args.p1_init, device=dev)
     else:
@@ -254,18 +259,24 @@ def main(argv=None):
                    help='physics: per-sample ADC noise std dev')
     p.add_argument('--p1-init', type=float, default=0.1,
                    help='physics: thermal excited-state probability')
-    p.add_argument('--device', choices=('parity', 'bloch'),
+    p.add_argument('--device', choices=('parity', 'bloch', 'statevec'),
                    default='parity',
-                   help='physics: qubit co-state model — parity counter '
-                        'or SU(2) Bloch vector (sim/device.py)')
+                   help='physics: qubit co-state model — parity counter, '
+                        'SU(2) Bloch vector, or entangling statevec '
+                        '(full per-shot state vector; CNOT/CZ coupling '
+                        'map auto-derived from the program + gate '
+                        'library) — sim/device.py')
     p.add_argument('--detuning-hz', type=float, default=0.0,
-                   help='bloch: qubit-drive detuning (Ramsey fringes)')
+                   help='bloch/statevec: qubit-drive detuning '
+                        '(Ramsey fringes)')
     p.add_argument('--t1-us', type=float, default=0.0,
-                   help='bloch: T1 in microseconds (0 = off)')
+                   help='bloch/statevec: T1 in microseconds (0 = off)')
     p.add_argument('--t2-us', type=float, default=0.0,
-                   help='bloch: T2 in microseconds (0 = off)')
+                   help='bloch/statevec: T2 in microseconds (0 = off)')
     p.add_argument('--depol', type=float, default=0.0,
-                   help='bloch: depolarization per drive pulse')
+                   help='bloch/statevec: 1q depolarization per drive pulse')
+    p.add_argument('--depol2', type=float, default=0.0,
+                   help='statevec: 2q Pauli channel per coupling pulse')
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')

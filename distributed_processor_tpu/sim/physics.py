@@ -927,6 +927,27 @@ def validate_physics_tables(mp, model: ReadoutPhysics,
     _validate_tables(mp, model, tables, W, interps, rows, skip_traced=False)
 
 
+def _has_cross_core_freqs(mp, drive_elem: int = 0) -> bool:
+    """Does any core's drive-element frequency table contain a value
+    that appears in another core's?  The cross-resonance signature —
+    used to warn when a statevec run has no coupling map."""
+    per_core = []
+    for t in mp.tables:
+        if drive_elem < len(t.freqs):
+            per_core.append(np.asarray(t.freqs[drive_elem]['freq'],
+                                       np.float64))
+        else:
+            per_core.append(np.zeros(0))
+    for c, fc in enumerate(per_core):
+        for o, fo in enumerate(per_core):
+            if o == c or not len(fc) or not len(fo):
+                continue
+            if np.any(np.isclose(fc[:, None], fo[None, :], rtol=1e-12,
+                                 atol=1.0)):
+                return True
+    return False
+
+
 def physics_config(base: InterpreterConfig, model: ReadoutPhysics,
                    **kw) -> InterpreterConfig:
     """The effective interpreter config of a physics run.
@@ -1045,6 +1066,20 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
                     f"device='statevec' holds a [shots, 2^n_cores] state "
                     f"vector; n_cores={C} exceeds the cap of "
                     f"{STATEVEC_MAX_CORES}")
+            if not model.device.couplings and _has_cross_core_freqs(mp):
+                # a drive-element frequency shared across cores is the
+                # cross-resonance signature: with no coupling map those
+                # pulses silently execute as 1q rotations — divergent
+                # physics between this entry point and Simulator.run
+                # (which auto-derives the map from the gate library)
+                import warnings
+                warnings.warn(
+                    "device='statevec' with couplings=() but the program "
+                    'drives cross-core frequencies (the cross-resonance '
+                    'signature): entangling pulses will execute as 1q '
+                    'rotations.  Derive the map with '
+                    'models.coupling.couplings_from_qchip(mp, qchip) or '
+                    'run via Simulator.run (auto-derives).', stacklevel=2)
             dev_params = dev_params + (
                 jnp.float32(model.device.depol2_per_pulse),
                 jnp.float32(model.device.zx90_amp),

@@ -99,6 +99,21 @@ class Simulator:
                     'physics= resolves measurement bits in-sim; '
                     'meas_bits=/p1= cannot also be given')
             from .sim.physics import run_physics_batch, physics_config
+            if physics.device.kind == 'statevec':
+                from dataclasses import replace as _rep
+                if not physics.device.couplings:
+                    # derive the (core, freq-word) -> (target, kind)
+                    # coupling map from this program + gate library, so
+                    # CNOT/CZ calibrations entangle without manual wiring
+                    from .models.coupling import couplings_from_qchip
+                    physics = _rep(physics, device=_rep(
+                        physics.device,
+                        couplings=couplings_from_qchip(mp, self.qchip)))
+                if physics.device.couplings and 'max_steps' not in cfg_kw:
+                    # the discrete-event gate serializes cross-core pulse
+                    # triggers (worst case one core per step): scale the
+                    # statically-derived step budget by the core count
+                    cfg = _rep(cfg, max_steps=cfg.max_steps * mp.n_cores)
             out = dict(run_physics_batch(
                 mp, physics, key if key is not None else jax.random.PRNGKey(0),
                 shots, init_regs=init_regs, cfg=cfg))

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""GHZ preparation with real entanglement, physics-closed end to end.
+
+The flagship statevec demo: a gate-level GHZ program (H + CNOT chain)
+compiles through the 12-pass pipeline, the echoed-CR CNOT calibrations
+execute as EXACT entanglers on the per-shot state vector
+(sim/device.py 'statevec'), every readout window is synthesized +
+demodulated + discriminated in-sim, and the sampled bits carry the
+entanglement: noiseless shots agree across the whole chain, bit for
+bit, and the X-basis parity witnesses the coherence a classical
+mixture cannot produce.  A second pass turns on trajectory noise
+(T1, 2q depolarization, ADC sigma) and watches the parity degrade.
+
+    JAX_PLATFORMS=cpu python examples/ghz_statevec.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get('JAX_PLATFORMS'):
+    import jax
+    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+import numpy as np
+
+from distributed_processor_tpu.models import ghz_program
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.sim.device import DeviceModel
+from distributed_processor_tpu.sim.physics import ReadoutPhysics
+
+N, SHOTS = 4, 1024
+
+
+def main():
+    qubits = [f'Q{i}' for i in range(N)]
+    sim = Simulator(n_qubits=N)
+    prog = ghz_program(qubits)
+
+    # noiseless: exact GHZ through the closed loop (couplings derived
+    # automatically from the program + gate library by Simulator.run)
+    model = ReadoutPhysics(sigma=0.0, p1_init=0.0,
+                           device=DeviceModel('statevec'))
+    out = sim.run(prog, shots=SHOTS, physics=model, max_meas=4)
+    bits = np.asarray(out['meas_bits'])[:, :, 0]
+    agree = np.all(bits == bits[:, :1], axis=1).mean()
+    print(f'{N}-qubit GHZ, {SHOTS} shots, noiseless:')
+    print(f'  all-{N}-bits-agree fraction: {agree:.4f}  '
+          f'(mean bit {bits[:, 0].mean():.3f})')
+    assert agree == 1.0
+
+    # the coherence witness: measure every qubit in the X basis (Y90
+    # before each read).  The GHZ superposition gives a DETERMINISTIC
+    # N-fold X parity; a classical |0..0>/|1..1> mixture would give
+    # mean parity 0 — Z-agreement alone cannot tell them apart.
+    xprog = list(prog[:-N])                 # prep + CNOTs + barrier
+    for q in qubits:
+        xprog += [{'name': 'virtual_z', 'qubit': [q],
+                   'phase': np.pi / 2},
+                  {'name': 'X90', 'qubit': [q]},
+                  {'name': 'virtual_z', 'qubit': [q],
+                   'phase': -np.pi / 2}]
+    xprog += [{'name': 'read', 'qubit': [q]} for q in qubits]
+    out = sim.run(xprog, shots=SHOTS, physics=model, max_meas=4)
+    xbits = np.asarray(out['meas_bits'])[:, :, 0]
+    parity = np.prod(1 - 2 * xbits, axis=1)
+    print(f'  X-basis {N}-fold parity: {parity.mean():+.4f}  '
+          f'(deterministic — a classical mixture would give ~0)')
+    assert abs(parity.mean()) == 1.0
+
+    # with noise: T1, 2q depol on the CR pulses, finite readout sigma
+    noisy = ReadoutPhysics(sigma=10.0, p1_init=0.02, device=DeviceModel(
+        'statevec', t1_s=60e-6, depol2_per_pulse=0.01))
+    out = sim.run(prog, shots=SHOTS, physics=noisy, max_meas=4)
+    bits = np.asarray(out['meas_bits'])[:, :, 0]
+    agree = np.all(bits == bits[:, :1], axis=1).mean()
+    print(f'with T1=60us, depol2=1%/CR, sigma=10 readout:')
+    print(f'  all-{N}-bits-agree fraction: {agree:.4f}  '
+          f'(decoherence + assignment errors, as on hardware)')
+    assert 0.5 < agree < 1.0
+
+
+if __name__ == '__main__':
+    main()

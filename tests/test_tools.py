@@ -348,3 +348,40 @@ def test_cli_run_physics_bloch(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out)
     assert out['error_shots'] == 0
     assert 0.3 < out['meas1_rate_per_core'][0] < 0.7
+
+
+def test_cli_statevec_bell(tmp_path, capsys):
+    """--device statevec: the coupling map auto-derives from the
+    program + gate library, and a Bell program's sampled bits come out
+    perfectly correlated (identical per-core marginals at sigma=0)."""
+    import json
+    prog = [{'name': 'virtual_z', 'qubit': ['Q0'],
+             'phase': 1.5707963267948966},
+            {'name': 'X90', 'qubit': ['Q0']},
+            {'name': 'virtual_z', 'qubit': ['Q0'],
+             'phase': 1.5707963267948966},
+            {'name': 'CNOT', 'qubit': ['Q0', 'Q1']},
+            {'name': 'barrier', 'qubit': ['Q0', 'Q1']},
+            {'name': 'read', 'qubit': ['Q0']},
+            {'name': 'read', 'qubit': ['Q1']}]
+    p = tmp_path / 'bell.json'
+    p.write_text(json.dumps(prog))
+    cli_main(['--qubits', '2', 'run', str(p), '--shots', '64',
+              '--physics', '--sigma', '0', '--p1-init', '0',
+              '--device', 'statevec'])
+    out = json.loads(capsys.readouterr().out)
+    assert out['error_shots'] == 0
+    r0, r1 = out['meas1_rate_per_core']
+    assert abs(r0 - r1) < 1e-9          # Bell: bit-for-bit correlated
+    assert 0.2 < r0 < 0.8
+
+
+def test_cli_statevec_flag_validation(tmp_path):
+    import json
+    import pytest
+    p = tmp_path / 'x.json'
+    p.write_text(json.dumps([{'name': 'X90', 'qubit': ['Q0']},
+                             {'name': 'read', 'qubit': ['Q0']}]))
+    with pytest.raises(SystemExit, match='statevec'):
+        cli_main(['--qubits', '1', 'run', str(p), '--physics',
+                  '--device', 'bloch', '--depol2', '0.1'])
