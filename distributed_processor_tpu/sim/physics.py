@@ -930,7 +930,15 @@ def validate_physics_tables(mp, model: ReadoutPhysics,
 def _has_cross_core_freqs(mp, drive_elem: int = 0) -> bool:
     """Does any core's drive-element frequency table contain a value
     that appears in another core's?  The cross-resonance signature —
-    used to warn when a statevec run has no coupling map."""
+    used to warn when a statevec run has no coupling map.
+
+    Covers 'zx' (CR) couplings only: a CZ-style ef drive lives solely
+    in the control core's own table and is indistinguishable from a 1q
+    frequency without the gate library, so CZ-only programs with
+    ``couplings=()`` are NOT caught here — use
+    :func:`~..models.coupling.couplings_from_qchip` (or
+    ``Simulator.run``, which auto-derives) whenever the program
+    contains calibrated two-qubit gates."""
     per_core = []
     for t in mp.tables:
         if drive_elem < len(t.freqs):
@@ -1079,7 +1087,10 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
                     'signature): entangling pulses will execute as 1q '
                     'rotations.  Derive the map with '
                     'models.coupling.couplings_from_qchip(mp, qchip) or '
-                    'run via Simulator.run (auto-derives).', stacklevel=2)
+                    'run via Simulator.run (auto-derives).  (CZ-style '
+                    'ef drives cannot be detected without the gate '
+                    'library — derive the map explicitly for those.)',
+                    stacklevel=2)
             dev_params = dev_params + (
                 jnp.float32(model.device.depol2_per_pulse),
                 jnp.float32(model.device.zx90_amp),
