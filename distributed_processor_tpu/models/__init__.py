@@ -4,6 +4,7 @@ from .experiments import (active_reset, rabi_program, t1_program,
                           t2_echo_program)
 from .rb import rb_program, rb_sequence, clifford_table
 from .rb2q import (rb2q_program, rb2q_sequence, clifford2_table,
+                   rb2q_interleaved_program, element_index,
                    depol2_survival, count_cz)
 from .coupling import couplings_from_qchip
 from .readout import sample_meas_bits, apply_assignment_error, IQReadoutModel

@@ -80,12 +80,7 @@ def clifford2_table():
 
 def inverse2_index(net: np.ndarray) -> int:
     """Table index of the Clifford inverting ``net`` (projectively)."""
-    _, _, index = clifford2_table()
-    key = _canon_keys(net.conj().T[None])[0]
-    try:
-        return index[key]
-    except KeyError:
-        raise ValueError('net unitary is not a two-qubit Clifford')
+    return element_index(np.asarray(net).conj().T)
 
 
 def rb2q_sequence(rng, depth: int) -> list[int]:
@@ -133,6 +128,28 @@ def rb2q_program(qa: str, qb: str, depth: int, rng=None, seed: int = 0,
     predictions) and ``info['indices']``."""
     rng = rng or np.random.default_rng(seed)
     seq = rb2q_sequence(rng, depth)
+    return _emit_program(qa, qb, seq, delay_before)
+
+
+def depol2_survival(p2: float, n_cz: int) -> float:
+    """Exact |00> survival under depol2-only errors (see module doc)."""
+    return 0.25 + 0.75 * (1.0 - 16.0 * p2 / 15.0) ** n_cz
+
+
+def element_index(u: np.ndarray) -> int:
+    """Table index of the C2 element projectively equal to ``u``."""
+    _, _, index = clifford2_table()
+    key = _canon_keys(np.asarray(u, complex)[None])[0]
+    try:
+        return index[key]
+    except KeyError:
+        raise ValueError('not a two-qubit Clifford')
+
+
+def _emit_program(qa: str, qb: str, seq, delay_before: float
+                  ) -> tuple[list[dict], dict]:
+    """Shared emission tail: instructions for ``seq``, barrier, reads,
+    and the info dict both RB program builders return."""
     program = [{'name': 'delay', 't': delay_before}]
     for i in seq:
         program += clifford2_instructions(qa, qb, i)
@@ -142,6 +159,29 @@ def rb2q_program(qa: str, qb: str, depth: int, rng=None, seed: int = 0,
     return program, {'indices': seq, 'n_cz': count_cz(seq)}
 
 
-def depol2_survival(p2: float, n_cz: int) -> float:
-    """Exact |00> survival under depol2-only errors (see module doc)."""
-    return 0.25 + 0.75 * (1.0 - 16.0 * p2 / 15.0) ** n_cz
+def rb2q_interleaved_program(qa: str, qb: str, depth: int, rng=None,
+                             seed: int = 0,
+                             delay_before: float = 500e-9
+                             ) -> tuple[list[dict], dict]:
+    """Interleaved two-qubit RB with the calibrated CZ as the target
+    gate: each random C2 Clifford is followed by a bare CZ, and the
+    recovery inverts the FULL product (C2 is a group, so the net is
+    still an element and the recovery is exact).  Comparing the decay
+    against the reference curve (:func:`rb2q_program` with the same
+    depths) isolates the interleaved gate's error:
+    ``alpha_CZ = alpha_int / alpha_ref``, ``EPC_CZ = 3/4 (1 - alpha_CZ)``
+    — the standard interleaved-RB estimator, exact here for
+    depolarizing errors.  Returns ``(program, info)`` with
+    ``info['n_cz']`` counting every CZ pulse (random Cliffords' own
+    plus the ``depth`` interleaves plus the recovery's)."""
+    rng = rng or np.random.default_rng(seed)
+    words, unitaries, _ = clifford2_table()
+    cz_idx = element_index(_CZ)
+    seq = []
+    net = np.eye(4, dtype=complex)
+    for _ in range(depth):
+        i = int(rng.integers(N_CLIFFORD2))
+        seq += [i, cz_idx]
+        net = _CZ @ unitaries[i] @ net
+    seq.append(inverse2_index(net))
+    return _emit_program(qa, qb, seq, delay_before)
