@@ -159,6 +159,20 @@ class DeviceModel:
     # single-qubit ``depol_per_pulse`` channel (which statevec applies
     # as a trajectory-sampled X/Y/Z after each 1q drive pulse).
     depol2_per_pulse: float = 0.0
+    # Leakage out of the computational subspace, trajectory-unraveled
+    # with an absorbing classical flag (the standard approximation for
+    # a |2> level without a 3^C state space): after each 1q drive pulse
+    # on core c, with probability ``leak_per_pulse * P(|1>_c)`` the
+    # trajectory jumps — the state projects onto the core's |1>
+    # component (collapsing entangled partners consistently, the
+    # unraveling of L = |2><1|) and the core is marked leaked.  Leaked
+    # cores are frozen: later drives, couplings involving them, and
+    # T1/T2 no-op; their readouts return ``leak_readout_bit``
+    # (|2> discriminates near |1> on most devices).  Absorbing — no
+    # seepage back — and 1q-drive-induced only (CR-pulse leakage is a
+    # known omission).  The run output gains a ``leaked`` [B, C] flag.
+    leak_per_pulse: float = 0.0
+    leak_readout_bit: int = 1
 
     def __post_init__(self):
         if self.kind not in DEVICE_KINDS:
@@ -171,22 +185,31 @@ class DeviceModel:
                     f'target_core, "zx"|"zz"); got {cp!r}')
             if cp[0] == cp[2]:
                 raise ValueError(f'coupling {cp!r} pairs a core with itself')
+        if self.leak_readout_bit not in (0, 1):
+            raise ValueError('leak_readout_bit must be 0 or 1')
+        if not 0.0 <= float(np.asarray(self.leak_per_pulse)) <= 1.0:
+            raise ValueError('leak_per_pulse must be in [0, 1]')
 
     def statevec_static(self) -> tuple:
         """Hashable compile-time facts for the statevec step body:
-        ``(couplings, has_detuning, has_decay, has_depol1, has_depol2)``
-        — zero-rate channels are dropped from the traced step entirely
-        (changing a rate between zero and nonzero recompiles; sweeping
-        nonzero values does not, since the rates themselves are traced
-        arrays)."""
+        ``(couplings, has_detuning, has_decay, has_depol1, has_depol2,
+        has_leak, leak_readout_bit)`` — zero-rate channels are dropped
+        from the traced step entirely (changing a rate between zero and
+        nonzero recompiles; sweeping nonzero values does not, since the
+        rates themselves are traced arrays)."""
         def nz(v):
             return bool(np.any(np.asarray(v, np.float64) != 0.0))
         def finite(v):
             return bool(np.any(np.isfinite(np.asarray(v, np.float64))))
+        has_leak = nz(self.leak_per_pulse)
         return (tuple(tuple(cp) for cp in self.couplings),
                 nz(self.detuning_hz),
                 finite(self.t1_s) or finite(self.t2_s),
-                nz(self.depol_per_pulse), nz(self.depol2_per_pulse))
+                nz(self.depol_per_pulse), nz(self.depol2_per_pulse),
+                # leak_readout_bit is dead without leakage: pin it so a
+                # bit-only model change can't force a spurious recompile
+                has_leak,
+                int(self.leak_readout_bit) if has_leak else 1)
 
     def per_clock_rates(self, n_cores: int):
         """Per-core per-clock rate arrays ``(det_cyc, inv_t1, inv_t2)``:

@@ -344,7 +344,8 @@ def _device_state(cfg: InterpreterConfig, B: int, C: int, M: int) -> dict:
         raise ValueError(
             f"device='statevec' holds a [shots, 2^n_cores] state vector; "
             f"n_cores={C} exceeds the cap of {STATEVEC_MAX_CORES}")
-    return {'psi': jnp.zeros((B, 1 << C), jnp.complex64), **cont}
+    return {'psi': jnp.zeros((B, 1 << C), jnp.complex64),
+            'leaked': jnp.zeros((B, C), bool), **cont}
 
 
 def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
@@ -770,9 +771,11 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                 raise ValueError(
                     "device='statevec' needs device-model parameters; "
                     "run it via sim.physics.run_physics_batch")
-            (det_cyc, inv_t1, inv_t2, depol1, depol2, zx90, zz90,
+            (det_cyc, inv_t1, inv_t2, depol1, depol2, zx90, zz90, leak,
              meas_u, traj_key) = dev['params']
-            couplings, has_det, has_decay, has_dp1, has_dp2 = dev['static']
+            (couplings, has_det, has_decay, has_dp1, has_dp2,
+             has_leak, leak_bit) = dev['static']
+            leaked = st['leaked']                             # [B, C]
             psi = st['psi']                                   # [B, 2^C] c64
             zsign = jnp.asarray(_sv_zsign(C))                 # [C, D]
             bit1 = (1.0 - zsign) * 0.5                        # 1 where |1>
@@ -792,12 +795,14 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             touch = is_drive | is_meas_pulse
             dt = jnp.where(touch,
                            (trig - st['phys_t']).astype(jnp.float32), 0.0)
-            if has_decay or has_dp1 or has_dp2:
+            if has_decay or has_dp1 or has_dp2 or has_leak:
                 # per-step trajectory uniforms, deterministic per
-                # (shot, core, step) given the run key
+                # (shot, core, step) given the run key.  The leak
+                # column only exists when leakage is on, so non-leak
+                # models keep their exact draw streams (and results)
                 traj_u = jax.random.uniform(
-                    jax.random.fold_in(traj_key, step_i), (B, C, 6),
-                    jnp.float32)
+                    jax.random.fold_in(traj_key, step_i),
+                    (B, C, 7 if has_leak else 6), jnp.float32)
             # (1) free evolution: detuning precession, one exact
             # diagonal Rz over all touched cores (a [B,C]x[C,D] matmul)
             if has_det:
@@ -813,6 +818,11 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                 inv_phi = jnp.maximum(inv_t2 - 0.5 * inv_t1, 0.0)
                 for c in range(C):
                     p_dec = 1.0 - jnp.exp(-dt[:, c] * inv_t1[c])
+                    if has_leak:
+                        # a leaked core is physically in |2>: its psi
+                        # slot is a frozen |1> bookkeeping state that
+                        # must not relax or dephase
+                        p_dec = jnp.where(leaked[:, c], 0.0, p_dec)
                     p1c = jnp.sum(bit1[c][None]
                                   * (psi.real**2 + psi.imag**2), -1)
                     jump = traj_u[:, c, 0] < p_dec * p1c
@@ -829,6 +839,8 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                     pj = pj / jnp.sqrt(jnp.maximum(p1c, 1e-12))[:, None]
                     psi = jnp.where(jump[:, None], pj, psi_nj)
                     p_phi = 1.0 - jnp.exp(-dt[:, c] * inv_phi[c])
+                    if has_leak:
+                        p_phi = jnp.where(leaked[:, c], 0.0, p_phi)
                     flip = traj_u[:, c, 1] < 0.5 * p_phi
                     psi = jnp.where(flip[:, None],
                                     psi * zsign[c][None, :], psi)
@@ -837,6 +849,10 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             theta1 = ((np.pi / 2) / cfg.x90_amp if cfg.x90_amp > 0
                       else 0.0) * pp[..., 3].astype(jnp.float32)
             theta1 = jnp.where(is_1q, theta1, 0.0)
+            if has_leak:
+                # drives on a leaked core act on |2>, far off-resonant
+                # from the 0-1 transition: no-op in the model
+                theta1 = jnp.where(leaked, 0.0, theta1)
             phi1 = (2 * np.pi / (1 << PHASE_BITS)) \
                 * pp[..., 1].astype(jnp.float32)
             pauli1 = jnp.asarray(_PAULI_1)
@@ -844,6 +860,8 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                 U = _sv_rot_1q(theta1[:, c], phi1[:, c])
                 if has_dp1:
                     occ = (traj_u[:, c, 2] < depol1) & is_1q[:, c]
+                    if has_leak:
+                        occ = occ & ~leaked[:, c]
                     pick = jnp.minimum(
                         (traj_u[:, c, 3] * 3).astype(jnp.int32), 2) + 1
                     sel = jnp.where(occ, pick, 0)
@@ -853,6 +871,31 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                         pauli1)
                     U = jnp.einsum('bxy,byu->bxu', N, U)
                 psi = _sv_apply_1q(psi, U, c, C)
+                if has_leak:
+                    # leakage channel after the rotation, the full CPTP
+                    # unraveling of L = sqrt(p)|2><1| (excited
+                    # population drives the 1->2 transition): with
+                    # probability p * P(|1>) the trajectory JUMPS —
+                    # project onto the |1> component (collapsing
+                    # entangled partners consistently) and mark
+                    # absorbed; otherwise the NO-JUMP back-action damps
+                    # the |1> amplitude by sqrt(1-p) and renormalizes
+                    # (omitting it would over-weight |1> in surviving
+                    # trajectories and break the ensemble channel)
+                    exposed = is_1q[:, c] & ~leaked[:, c]
+                    p_eff = jnp.where(exposed, leak, 0.0)
+                    p1c = jnp.sum(bit1[c][None]
+                                  * (psi.real**2 + psi.imag**2), -1)
+                    occ = traj_u[:, c, 6] < p_eff * p1c
+                    proj = psi * (bit1[c][None, :]
+                                  / jnp.sqrt(jnp.maximum(p1c,
+                                                         1e-12))[:, None])
+                    damp = 1.0 - (1.0 - jnp.sqrt(1.0 - p_eff))[:, None] \
+                        * bit1[c][None, :]
+                    nrm = jnp.sqrt(jnp.maximum(1.0 - p_eff * p1c, 1e-12))
+                    psi_nj = psi * (damp / nrm[:, None])
+                    psi = jnp.where(occ[:, None], proj, psi_nj)
+                    leaked = leaked.at[:, c].set(leaked[:, c] | occ)
             # (4) coupling pulses: ZX (cross-resonance) / ZZ (ef drive)
             # interactions with stochastic 2q depol.  Ordering contract:
             # same-step stages apply 1q-then-coupling-then-measure;
@@ -861,6 +904,10 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             amp_f = pp[..., 3].astype(jnp.float32)
             pauli2 = jnp.asarray(_PAULI_2)
             for mk, (cc, fi, tt, kd) in zip(cp_masks, couplings):
+                if has_leak:
+                    # interactions involving a leaked core no-op (the
+                    # |2> level is out of both transition manifolds)
+                    mk = mk & ~leaked[:, cc] & ~leaked[:, tt]
                 ref = zz90 if kd == 'zz' else zx90
                 th = jnp.where(mk, (np.pi / 2) * amp_f[:, cc] / ref, 0.0)
                 if kd == 'zz':
@@ -891,6 +938,13 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                 p1c = jnp.clip(jnp.sum(
                     bit1[c][None] * (psi.real**2 + psi.imag**2), -1),
                     0.0, 1.0)
+                if has_leak:
+                    # a leaked core discriminates as leak_readout_bit
+                    # (|2> sits near |1> in IQ space on most devices);
+                    # no collapse — its slot was projected at leak
+                    # time.  Forcing p1c to exactly 0/1 forces the
+                    # uniform comparison below to the leak bit.
+                    p1c = jnp.where(leaked[:, c], float(leak_bit), p1c)
                 bitc = (u_sel[:, c] < p1c).astype(jnp.int32) \
                     * mc.astype(jnp.int32)
                 keep = jnp.where(bitc[:, None] == 1, bit1[c][None, :],
@@ -898,13 +952,14 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                 p_sel = jnp.where(bitc == 1, p1c, 1.0 - p1c)
                 proj = psi * (keep
                               / jnp.sqrt(jnp.maximum(p_sel, 1e-12))[:, None])
-                psi = jnp.where(mc[:, None], proj, psi)
+                do_proj = mc if not has_leak else mc & ~leaked[:, c]
+                psi = jnp.where(do_proj[:, None], proj, psi)
                 p1_cols.append(jnp.where(mc, p1c, 0.0))
                 bit_cols.append(bitc)
             p1 = jnp.stack(p1_cols, axis=-1)                  # [B, C]
             state_bit = jnp.stack(bit_cols, axis=-1)
             phys_updates = dict(
-                psi=psi,
+                psi=psi, leaked=leaked,
                 phys_t=jnp.where(touch, trig, st['phys_t']),
                 meas_p1=jnp.where(mwr, p1[..., None], st['meas_p1']),
             )

@@ -1094,7 +1094,8 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
             dev_params = dev_params + (
                 jnp.float32(model.device.depol2_per_pulse),
                 jnp.float32(model.device.zx90_amp),
-                jnp.float32(model.device.zz90_amp))
+                jnp.float32(model.device.zz90_amp),
+                jnp.float32(model.device.leak_per_pulse))
             traj_key = jax.random.fold_in(key, 0x53563251)
             dev_static = model.device.statevec_static()
     else:

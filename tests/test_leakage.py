@@ -1,0 +1,175 @@
+"""Leakage out of the computational subspace (statevec device).
+
+Trajectory-unraveled absorbing leakage: each 1q drive pulse leaks with
+probability ``leak_per_pulse * P(|1>)`` (the excited population drives
+the 1->2 transition), the trajectory projects onto the core's |1>
+component (collapsing entangled partners consistently), and the core
+is frozen — later drives, couplings, and T1/T2 no-op; readouts return
+``leak_readout_bit``.  The single-instruction
+theta=pi pulse train makes the accumulation EXACT: poles are fixed
+points of the no-jump back-action, so the post-pulse excited
+population alternates 1, 0, 1, 0, ... and after 2k pi pulses the leak
+probability is exactly 1 - (1 - p)^k.
+"""
+
+PI_PULSE = {'name': 'pulse', 'dest': 'Q0.qdrv', 'freq': 4.2e9,
+            'phase': 0.0, 'amp': 0.96, 'twidth': 24e-9,
+            'env': {'env_func': 'square', 'paradict': {}}}
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.models.coupling import couplings_from_qchip
+from distributed_processor_tpu.models.default_qchip import make_default_qchip
+from distributed_processor_tpu.sim.device import DeviceModel
+from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                   run_physics_batch)
+
+KW = dict(max_steps=4000, max_pulses=128, max_meas=4)
+
+
+@pytest.fixture(scope='module')
+def sim2():
+    return Simulator(n_qubits=2)
+
+
+def _run(sim, prog, shots, key, dev_kw, qchip=None, **kw):
+    mp = sim.compile(prog)
+    cps = couplings_from_qchip(mp, qchip or make_default_qchip(2))
+    model = ReadoutPhysics(sigma=0.0, p1_init=0.0, device=DeviceModel(
+        'statevec', couplings=cps, **dev_kw))
+    out = run_physics_batch(mp, model, key, shots, **{**KW, **kw})
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
+    return out
+
+
+def test_leak_accumulates_exactly(sim2):
+    """After 2k single-instruction pi pulses from |0> the leaked
+    fraction is 1 - (1-p)^k exactly: post-pulse P(|1>) alternates
+    1, 0, ... and the no-jump back-action is a no-op at poles, so only
+    every other pulse is exposed, at unit excited population."""
+    p, k, shots = 0.08, 6, 2048
+    prog = [dict(PI_PULSE) for _ in range(2 * k)] \
+        + [{'name': 'read', 'qubit': ['Q0']}]
+    out = _run(sim2, prog, shots, 3, dict(leak_per_pulse=p))
+    leaked = np.asarray(out['leaked'])[:, 0]
+    want = 1.0 - (1.0 - p) ** k
+    se = np.sqrt(want * (1 - want) / shots)
+    assert abs(leaked.mean() - want) < 4 * se, (leaked.mean(), want)
+    # leaked shots read the leak bit (default 1); the un-leaked end in
+    # |0> after the even pi count and read 0
+    bits = np.asarray(out['meas_bits'])[:, 0, 0]
+    np.testing.assert_array_equal(bits, leaked.astype(bits.dtype))
+
+
+def test_leaked_core_is_frozen(sim2):
+    """Once leaked, further drives no-op and every readout returns the
+    leak bit: a pi pulse at p=1 leaks with certainty (post-pulse
+    P(|1>) = 1), and a second pi pulse cannot bring the core back —
+    an unleaked run reads 0 after the pair."""
+    prog = [dict(PI_PULSE) for _ in range(4)] \
+        + [{'name': 'read', 'qubit': ['Q0']}]
+    out = _run(sim2, prog, 32, 1, dict(leak_per_pulse=1.0))
+    assert np.all(np.asarray(out['leaked'])[:, 0])
+    assert np.all(np.asarray(out['meas_bits'])[:, 0, 0] == 1)
+    # same program, no leakage: X360 returns to |0>
+    out = _run(sim2, prog, 32, 1, dict())
+    assert np.all(np.asarray(out['meas_bits'])[:, 0, 0] == 0)
+
+
+def test_leak_readout_bit_configurable(sim2):
+    prog = [dict(PI_PULSE), {'name': 'read', 'qubit': ['Q0']}]
+    out = _run(sim2, prog, 16, 2, dict(leak_per_pulse=1.0,
+                                       leak_readout_bit=0))
+    assert np.all(np.asarray(out['leaked'])[:, 0])
+    assert np.all(np.asarray(out['meas_bits'])[:, 0, 0] == 0)
+
+
+def test_leak_no_jump_back_action(sim2):
+    """The no-jump branch is a real back-action: surviving trajectories
+    damp their |1> amplitude by sqrt(1-p), so the ENSEMBLE reproduces
+    the Kraus channel exactly.  X90 then read with leak_readout_bit=0:
+    P(read 1) = (1 - p_jump) * P1' = 0.5 (1 - p) — distinguishable
+    from the back-action-free (wrong) model's 0.5 (1 - 0.5 p)."""
+    p, shots = 0.4, 4096
+    prog = [{'name': 'X90', 'qubit': ['Q0']},
+            {'name': 'read', 'qubit': ['Q0']}]
+    out = _run(sim2, prog, shots, 11, dict(leak_per_pulse=p,
+                                           leak_readout_bit=0))
+    bits = np.asarray(out['meas_bits'])[:, 0, 0]
+    want = 0.5 * (1.0 - p)                    # = 0.30
+    wrong = 0.5 * (1.0 - 0.5 * p)             # = 0.40 without back-action
+    se = np.sqrt(want * (1 - want) / shots)
+    assert abs(bits.mean() - want) < 4 * se, (bits.mean(), want)
+    assert abs(bits.mean() - wrong) > 8 * se
+    # leak fraction itself: p * P1 = 0.2
+    leaked = np.asarray(out['leaked'])[:, 0]
+    se_l = np.sqrt(0.2 * 0.8 / shots)
+    assert abs(leaked.mean() - 0.2) < 4 * se_l
+
+
+def test_leak_deterministic_branches(sim2):
+    """p=1 makes every branch deterministic through an entangling
+    program: the prep X90 either jumps (P1 = 1/2) or the no-jump
+    back-action projects the survivor to |0>; survivors' CZ (no 1q
+    pulses on Q1, unlike CNOT's target X90) maps |00> -> |00>, and
+    their final pi pulse (P1 = 1 after it) leaks with certainty.
+    Every shot therefore ends with Q0 leaked and Q1 = 0 exactly —
+    zz-coupling masking for leaked controls, the no-jump projection
+    (p=1 survivor -> |0>), and the jump projection all exercised."""
+    prog = [{'name': 'virtual_z', 'qubit': ['Q0'], 'phase': np.pi / 2},
+            {'name': 'X90', 'qubit': ['Q0']},
+            {'name': 'virtual_z', 'qubit': ['Q0'], 'phase': np.pi / 2},
+            {'name': 'barrier', 'qubit': ['Q0', 'Q1']},
+            {'name': 'CZ', 'qubit': ['Q0', 'Q1']},
+            {'name': 'barrier', 'qubit': ['Q0', 'Q1']},
+            dict(PI_PULSE),
+            {'name': 'barrier', 'qubit': ['Q0', 'Q1']},
+            {'name': 'read', 'qubit': ['Q0']},
+            {'name': 'read', 'qubit': ['Q1']}]
+    out = _run(sim2, prog, 256, 7, dict(leak_per_pulse=1.0))
+    leaked = np.asarray(out['leaked'])
+    bits = np.asarray(out['meas_bits'])[:, :, 0]
+    assert np.all(leaked[:, 0]) and not np.any(leaked[:, 1])
+    assert np.all(bits[:, 0] == 1)
+    assert not np.any(bits[:, 1])
+
+
+def test_leakage_defeats_repetition_code():
+    """The canonical QEC failure mode: a leaked data qubit reads 1
+    forever, so the majority-vote round 'corrects' the healthy
+    neighbours toward the error every time — logical failure rate far
+    above the unleaked case at matched marginals."""
+    from distributed_processor_tpu.models.repetition import (
+        repetition_logical_program, independent_noise_stage,
+        repetition_physics_kwargs)
+    sim = Simulator(n_qubits=3)
+    qchip = make_default_qchip(3)
+    shots = 1024
+    # leak injection: the noise stage's zero-amp pulses never excite,
+    # so leak ~ p * P(|1>) never fires off them — use a real X180 on
+    # the middle qubit with p_leak, which either leaks (stuck at 1) or
+    # returns to 0 (X360 total over the stage + correction unused)
+    noise = [{'name': 'X90', 'qubit': ['Q1']},
+             {'name': 'X90', 'qubit': ['Q1']},
+             {'name': 'X90', 'qubit': ['Q1']},
+             {'name': 'X90', 'qubit': ['Q1']}]
+    prog = repetition_logical_program(3, noise)
+    mp = sim.compile(prog)
+    cps = couplings_from_qchip(mp, qchip)
+    model = ReadoutPhysics(sigma=0.0, p1_init=0.0, device=DeviceModel(
+        'statevec', couplings=cps, leak_per_pulse=0.1))
+    out = run_physics_batch(mp, model, 5, shots, max_steps=8000,
+                            **repetition_physics_kwargs(3))
+    assert not np.any(np.asarray(out['err']))
+    leaked = np.asarray(out['leaked'])[:, 1]
+    final = np.asarray(out['meas_bits'])[:, :, 1]   # post-correction
+    assert 0.05 < leaked.mean() < 0.5
+    # the leaked qubit still reads 1 AFTER the correction round — the
+    # code cannot fix it, only mask it while the majority holds
+    np.testing.assert_array_equal(final[leaked, 1],
+                                  np.ones(int(leaked.sum()), final.dtype))
+    # unleaked shots are fully corrected
+    assert not np.any(final[~leaked])
