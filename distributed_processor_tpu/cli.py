@@ -157,6 +157,9 @@ def cmd_run(args):
         if args.device != 'statevec' and args.depol2:
             raise SystemExit('--depol2 (two-qubit Pauli channel on '
                              'coupling pulses) needs --device statevec')
+        if args.device != 'statevec' and args.leak:
+            raise SystemExit('--leak (computational-subspace leakage) '
+                             'needs --device statevec')
         if args.device == 'parity' and (args.detuning_hz or args.t1_us
                                         or args.t2_us or args.depol):
             raise SystemExit(
@@ -170,7 +173,9 @@ def cmd_run(args):
                           t2_s=args.t2_us * 1e-6 if args.t2_us else
                           float('inf'),
                           depol_per_pulse=args.depol,
-                          depol2_per_pulse=args.depol2)
+                          depol2_per_pulse=args.depol2,
+                          leak_per_pulse=args.leak,
+                          leak_readout_bit=args.leak_bit)
         kw['physics'] = ReadoutPhysics(sigma=args.sigma,
                                        p1_init=args.p1_init, device=dev)
     else:
@@ -190,6 +195,11 @@ def cmd_run(args):
         result['meas1_rate_per_core'] = \
             np.atleast_3d(bits)[..., 0].mean(0).tolist()
         result['epochs'] = int(out['epochs'])
+        if 'leaked' in out:
+            # the leak rate itself, separable from meas1 (which folds
+            # leaked shots in at --leak-bit)
+            result['leaked_rate_per_core'] = \
+                np.atleast_2d(np.asarray(out['leaked'])).mean(0).tolist()
     print(json.dumps(result, indent=2))
 
 
@@ -277,6 +287,11 @@ def main(argv=None):
                    help='bloch/statevec: 1q depolarization per drive pulse')
     p.add_argument('--depol2', type=float, default=0.0,
                    help='statevec: 2q Pauli channel per coupling pulse')
+    p.add_argument('--leak', type=float, default=0.0,
+                   help='statevec: leakage probability per 1q drive '
+                        'pulse (x P(|1>); CPTP trajectory unraveling)')
+    p.add_argument('--leak-bit', type=int, default=1, choices=(0, 1),
+                   help='statevec: bit a leaked core reads out as')
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')

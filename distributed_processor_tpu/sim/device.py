@@ -187,7 +187,12 @@ class DeviceModel:
                 raise ValueError(f'coupling {cp!r} pairs a core with itself')
         if self.leak_readout_bit not in (0, 1):
             raise ValueError('leak_readout_bit must be 0 or 1')
-        if not 0.0 <= float(np.asarray(self.leak_per_pulse)) <= 1.0:
+        leak = np.asarray(self.leak_per_pulse, np.float64)
+        if leak.ndim != 0:
+            raise ValueError(
+                'leak_per_pulse must be a scalar (per-core leak rates '
+                'are not supported yet)')
+        if not 0.0 <= float(leak) <= 1.0:
             raise ValueError('leak_per_pulse must be in [0, 1]')
 
     def statevec_static(self) -> tuple:

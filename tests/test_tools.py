@@ -385,3 +385,25 @@ def test_cli_statevec_flag_validation(tmp_path):
     with pytest.raises(SystemExit, match='statevec'):
         cli_main(['--qubits', '1', 'run', str(p), '--physics',
                   '--device', 'bloch', '--depol2', '0.1'])
+
+
+def test_cli_statevec_leak(tmp_path, capsys):
+    """--leak through the CLI: a pi pulse (P(|1>)=1 after it) at
+    leak=1.0 leaves every shot leaked, reading --leak-bit."""
+    prog = [{'name': 'pulse', 'dest': 'Q0.qdrv', 'freq': 4.2e9,
+             'phase': 0.0, 'amp': 0.96, 'twidth': 24e-9,
+             'env': {'env_func': 'square', 'paradict': {}}},
+            {'name': 'read', 'qubit': ['Q0']}]
+    p = tmp_path / 'leak.json'
+    p.write_text(json.dumps(prog))
+    for bit in (1, 0):
+        cli_main(['--qubits', '1', 'run', str(p), '--shots', '16',
+                  '--physics', '--sigma', '0', '--p1-init', '0',
+                  '--device', 'statevec', '--leak', '1.0',
+                  '--leak-bit', str(bit)])
+        out = json.loads(capsys.readouterr().out)
+        assert out['meas1_rate_per_core'] == [float(bit)]
+        assert out['leaked_rate_per_core'] == [1.0]
+    with pytest.raises(SystemExit, match='statevec'):
+        cli_main(['--qubits', '1', 'run', str(p), '--physics',
+                  '--device', 'bloch', '--leak', '0.1'])
