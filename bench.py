@@ -554,7 +554,11 @@ def main():
                 assert ok, f'{name} batch did not complete'
                 probe_times[name].append(dt)
             except Exception as e:  # pragma: no cover - defensive
-                probe_times[name] = f'{type(e).__name__}: {e}'[:120]
+                # keep the rounds already collected: earlier samples are
+                # valid measurements and still contribute a median
+                probe_times[name] = {
+                    'error': f'{type(e).__name__}: {e}'[:120],
+                    'times': probe_times[name]}
                 probes = [p for p in probes if p[0] != name]
 
     def _median_iqr(ts):
@@ -565,19 +569,29 @@ def main():
 
     probe_sps: dict = {}
     for name, ts in probe_times.items():
+        err = None
+        if isinstance(ts, dict):            # mid-run failure w/ partials
+            err, ts = ts['error'], ts['times']
         if isinstance(ts, str) or not ts:
-            probe_sps[name] = ts or 'no samples'
+            probe_sps[name] = err or ts or 'no samples'
             continue
         med, iqr = _median_iqr(ts)
         probe_sps[name] = {
             'sps_median': round(batch / med, 1),
             'sps_iqr_frac': round(iqr / med, 4),
             'rounds': len(ts)}
+        if err:
+            probe_sps[name]['error'] = err
 
     def _ratio(a, b):
-        """median ratio with summed relative IQR spread."""
+        """median ratio with summed relative IQR spread.  Probes that
+        failed mid-run (partial rounds) are excluded: a ratio of
+        non-contemporaneous medians — or one whose single-sample IQR is
+        trivially 0 — defeats the interleaved variance control."""
         pa, pb = probe_sps.get(a), probe_sps.get(b)
         if not (isinstance(pa, dict) and isinstance(pb, dict)):
+            return None
+        if 'error' in pa or 'error' in pb or pa['rounds'] != pb['rounds']:
             return None
         return {'ratio': round(pa['sps_median'] / pb['sps_median'], 4),
                 'spread': round(pa['sps_iqr_frac'] + pb['sps_iqr_frac'],
@@ -587,10 +601,14 @@ def main():
     probe_ratios = {f'{n}/{headline_mode}': _ratio(n, ref)
                     for n, _m, _d in probe_specs[1:]}
 
-    # legacy secondary keys, fed from the interleaved medians
+    # legacy secondary keys, fed from the interleaved medians; a probe
+    # that errored mid-run surfaces its error here (its partial median
+    # stays visible in probes_interleaved)
     def _sps_of(name):
         p = probe_sps.get(name)
-        return p['sps_median'] if isinstance(p, dict) else p
+        if isinstance(p, dict):
+            return p['error'] if 'error' in p else p['sps_median']
+        return p
     secondary_sps = {m: _sps_of(m)
                      for m in ('persample', 'fused', 'analytic')}
     other_device_sps = _sps_of(f'device:{other_device}')

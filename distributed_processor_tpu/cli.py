@@ -160,6 +160,10 @@ def cmd_run(args):
         if args.device != 'statevec' and args.leak:
             raise SystemExit('--leak (computational-subspace leakage) '
                              'needs --device statevec')
+        if args.leak_bit != 1 and not (args.device == 'statevec'
+                                       and args.leak):
+            raise SystemExit('--leak-bit has no effect without '
+                             '--device statevec and --leak > 0')
         if args.device == 'parity' and (args.detuning_hz or args.t1_us
                                         or args.t2_us or args.depol):
             raise SystemExit(

@@ -1040,6 +1040,14 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     3]`` Bloch vectors), ``meas_p1`` (pre-projection P(1) per slot — the
     noise-free expectation value), and ``phys_t`` (last evolution time).
     """
+    # did the caller size the step budget themselves?  Any caller-built
+    # cfg counts as sized (its max_steps was chosen or accepted — no
+    # value-coincidence heuristics); only the bare-default path (no cfg,
+    # no max_steps kwarg) gets the n_cores scaling below, the same
+    # scaling Simulator.run applies to its statically-derived budget
+    # (statevec's discrete-event gate can serialize cross-core pulse
+    # triggers — worst case one core per step)
+    explicit_steps = 'max_steps' in kw or cfg is not None
     cfg = physics_config(cfg, model, **kw)
     _check_fabric(cfg, mp.n_cores)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
@@ -1096,6 +1104,12 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
                 jnp.float32(model.device.zx90_amp),
                 jnp.float32(model.device.zz90_amp),
                 jnp.float32(model.device.leak_per_pulse))
+            if model.device.couplings and not explicit_steps:
+                # the event-ordering gate's serialization can exhaust a
+                # generic budget and flag shots incomplete (advisor
+                # round 4) — scale the default the way Simulator.run
+                # scales its statically-derived one
+                cfg = replace(cfg, max_steps=cfg.max_steps * C)
             traj_key = jax.random.fold_in(key, 0x53563251)
             dev_static = model.device.statevec_static()
     else:

@@ -1,0 +1,108 @@
+"""Statevec entangling device at flagship scale: C=8 compiled-path runs.
+
+Round-4 review missing #1: every statevec test stopped at 4 qubits while
+the reference ecosystem treats two-qubit calibrations as first-class at
+full system size (reference: python/test/qubitcfg.json:1152 Q5Q4CNOT in
+an 8-qubit library; python/distproc/hwconfig.py:112-115 N_CORES=8).
+These tests run the [shots, 2^8] trajectory engine through the full
+compiled path at C=8:
+
+* GHZ-8: an H + 7-CNOT chain prepares the 8-qubit GHZ state and every
+  shot's sampled bits agree across the whole chain (shot-exact parity,
+  the entanglement witness a product state cannot fake).
+* Distance-5 repetition with a correlated 2q error, embedded in an
+  8-core machine: the pair channel's both-flip signature shows up in
+  the syndrome correlations, and — unlike distance 3, which one
+  correlated event defeats (tests/test_repetition_correlated.py) —
+  the 5-qubit majority vote corrects every single pair event exactly.
+"""
+
+import numpy as np
+
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.models.coupling import couplings_from_qchip
+from distributed_processor_tpu.models.default_qchip import make_default_qchip
+from distributed_processor_tpu.models.experiments import ghz_program
+from distributed_processor_tpu.models.repetition import (
+    correlated_noise_stage, majority_lut, repetition_logical_program)
+from distributed_processor_tpu.sim.device import DeviceModel
+from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                   run_physics_batch)
+
+N = 8
+
+
+def test_ghz8_shot_exact_parity():
+    """All 8 sampled bits agree on every shot of a GHZ-8 preparation,
+    with ~50/50 marginals — through compile, the discrete-event ordering
+    gate (7 chained CR couplings), joint projective measurement, and the
+    physics-closed readout chain at C=8."""
+    sim = Simulator(n_qubits=N)
+    qchip = make_default_qchip(N)
+    mp = sim.compile(ghz_program([f'Q{i}' for i in range(N)]))
+    cps = couplings_from_qchip(mp, qchip)
+    assert len(cps) == N - 1          # the full CNOT chain is coupled
+    model = ReadoutPhysics(sigma=0.0, device=DeviceModel(
+        'statevec', couplings=cps))
+    shots = 256
+    out = run_physics_batch(mp, model, 2, shots,
+                            init_states=np.zeros((shots, N), np.int32),
+                            max_steps=40000, max_pulses=256, max_meas=4)
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
+    bits = np.asarray(out['meas_bits'])[:, :, 0]
+    assert np.all(bits == bits[:, :1]), \
+        'GHZ-8 bits must agree across all 8 cores on every shot'
+    assert 0.4 < bits[:, 0].mean() < 0.6
+    # every adjacent-pair ZZ parity is exactly +1
+    for a in range(N - 1):
+        zz = (1 - 2 * bits[:, a]) * (1 - 2 * bits[:, a + 1])
+        assert zz.mean() == 1.0
+
+
+def test_repetition5_correlated_error_at_c8():
+    """Distance-5 repetition round in an 8-core machine (3 spectator
+    cores read |0> and stay outside the LUT mask): a correlated (0,1)
+    pair channel at p2=0.3 produces the both-flip syndrome correlation
+    (P(both) = 4*p2/15, far above the independence product), and the
+    5-way majority vote corrects every shot — a single pair event flips
+    at most 2 of 5 data qubits, below the distance-5 threshold that
+    defeats distance 3."""
+    nd, p2, shots = 5, 0.3, 2048
+    sim = Simulator(n_qubits=N)
+    qchip = make_default_qchip(N)
+    prog = repetition_logical_program(
+        nd, correlated_noise_stage([(0, 1)], qchip)) + \
+        [{'name': 'read', 'qubit': [f'Q{i}']} for i in range(nd, N)]
+    mp = sim.compile(prog)
+    assert mp.n_cores == N
+    cps = couplings_from_qchip(mp, qchip)
+    model = ReadoutPhysics(sigma=0.0, device=DeviceModel(
+        'statevec', couplings=cps, depol2_per_pulse=p2))
+    out = run_physics_batch(
+        mp, model, 3, shots, init_states=np.zeros((shots, N), np.int32),
+        max_steps=40000, max_pulses=16, max_meas=2,
+        fabric='lut', lut_mask=(True,) * nd + (False,) * (N - nd),
+        lut_table=majority_lut(nd))
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
+    syn = np.asarray(out['meas_state'])[:, :nd, 0]    # pre-correction
+    fin = np.asarray(out['meas_bits'])[:, :nd, 1]     # post-correction
+    # both-flip correlation: P(flip0 & flip1) = 4*p2/15, >> independent
+    p_both = float((syn[:, 0] & syn[:, 1]).mean())
+    want = 4.0 * p2 / 15.0
+    se = np.sqrt(want * (1 - want) / shots)
+    assert abs(p_both - want) < 4 * se, (p_both, want)
+    assert p_both > 2.0 * syn[:, 0].mean() * syn[:, 1].mean()
+    # marginal flip rate per coupled qubit = 8*p2/15
+    marg = 8.0 * p2 / 15.0
+    se_m = np.sqrt(marg * (1 - marg) / shots)
+    for q in (0, 1):
+        assert abs(syn[:, q].mean() - marg) < 4 * se_m
+    assert not np.any(syn[:, 2:])                     # untouched qubits
+    # distance 5 corrects every single pair event: zero logical errors
+    # AND a fully restored codeword on every shot
+    assert not np.any(fin), 'distance-5 must correct all pair events'
+    # spectator cores measured |0> and stayed out of the syndrome
+    spect = np.asarray(out['meas_bits'])[:, nd:, 0]
+    assert not np.any(spect)
