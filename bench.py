@@ -84,7 +84,7 @@ import jax.numpy as jnp
 
 from distributed_processor_tpu.pipeline import compile_to_machine
 from distributed_processor_tpu.models import (
-    active_reset, rb_program, make_default_qchip)
+    active_reset, rb_program, make_default_qchip, couplings_from_qchip)
 from distributed_processor_tpu.sim.interpreter import InterpreterConfig
 from distributed_processor_tpu.sim.physics import (
     ReadoutPhysics, run_physics_batch, prepare_physics_tables)
@@ -109,6 +109,31 @@ def build_machine_program(n_qubits: int, depth: int):
     qchip = make_default_qchip(n_qubits)
     program = active_reset(qubits) + rb_program(qubits, depth, seed=1234)
     return compile_to_machine(program, qchip, n_qubits=n_qubits)
+
+
+def build_entangling_program(n_qubits: int, layers: int):
+    """Brickwork entangling workload for the ``statevec:cz`` probe:
+    active reset, then per layer an X90 on every qubit and CZ across
+    alternating adjacent pairs (barrier-fenced), then read all — the
+    coupling map, the discrete-event ordering gate, and joint collapse
+    all live at full system size, the scale the reference ecosystem
+    treats as first-class for 2q calibrations (reference:
+    python/test/qubitcfg.json:1152 Q5Q4CNOT in an 8-qubit library).
+    Returns ``(machine_program, qchip)``."""
+    qubits = [f'Q{i}' for i in range(n_qubits)]
+    qchip = make_default_qchip(n_qubits)
+    prog = active_reset(qubits)
+    for layer in range(layers):
+        prog.append({'name': 'barrier', 'qubit': qubits})
+        for q in qubits:
+            prog.append({'name': 'X90', 'qubit': [q]})
+        prog.append({'name': 'barrier', 'qubit': qubits})
+        for a in range(layer % 2, n_qubits - 1, 2):
+            prog.append({'name': 'CZ', 'qubit': [f'Q{a}', f'Q{a + 1}']})
+        prog.append({'name': 'barrier', 'qubit': qubits})
+    for q in qubits:
+        prog.append({'name': 'read', 'qubit': [q]})
+    return compile_to_machine(prog, qchip, n_qubits=n_qubits), qchip
 
 
 def pallas_compiled_parity() -> bool:
@@ -187,6 +212,7 @@ class _ModeStep:
     def __init__(self, mp, cfg, batch, sigma, chunk, mode,
                  device=None):
         self.mode = mode
+        self.mp, self.cfg = mp, cfg
         kw = {} if device is None else {'device': device}
         self.model = ReadoutPhysics(sigma=sigma, p1_init=0.15,
                                     resolve_chunk=chunk,
@@ -351,6 +377,62 @@ def utilization_accounting(mp, cfg, model, batch: int,
     }
 
 
+def statevec_utilization(step: _ModeStep, batch: int,
+                         t_batch: float) -> dict:
+    """Roofline position of the statevec trajectory step (round-4
+    review: 'the statevec step finally has real matmul-shaped work —
+    report where it sits').
+
+    The dominant traffic is the ``[B, 2^C]`` complex64 state itself:
+    every channel stage that touches psi streams it through HBM once
+    (read + write).  The touch count per interpreter step is derived
+    from the model's static channel flags (sim/device.py
+    ``statevec_static`` — zero-rate channels are dropped from the traced
+    body, so they cost nothing): detuning 1; decay 2 per core (jump +
+    dephase); 1q rotation 1 per core (+1 with leakage); measurement 2
+    per core (probability reduce + projection); couplings 1 per entry
+    (+1 with 2q depol).  FLOPs are the per-core einsums (~16*D per shot
+    per 1q op, 64*D per coupling) — orders of magnitude under the MXU
+    peak, so the step is HBM-bound by construction and the meaningful
+    ceiling is the bandwidth fraction.  ``t_batch`` is the probe's
+    interleaved MEDIAN batch time (the one variance-controlled number);
+    steps/epochs come from one extra settled batch.
+    """
+    dev = step.model.device
+    C = step.mp.n_cores
+    D = 1 << C
+    res = jax.block_until_ready(step(jax.random.PRNGKey(77)))
+    assert not int(res[1]) and not int(res[5]), \
+        'statevec utilization batch errored or ran out of steps'
+    steps_n, epochs = int(res[3]), int(res[4])
+    cps, has_det, has_decay, _dp1, has_dp2, has_leak, _ = \
+        dev.statevec_static()
+    touches = ((1 if has_det else 0)
+               + C * ((2 if has_decay else 0) + 1 + (1 if has_leak else 0)
+                      + 2)
+               + len(cps) * (1 + (1 if has_dp2 else 0)))
+    psi_bytes = batch * D * 8                     # complex64 state
+    traffic = 2.0 * touches * psi_bytes * steps_n
+    flops = float(steps_n) * batch * D * (16 * C + 64 * len(cps))
+    return {
+        'steps': steps_n, 'epochs': epochs,
+        'psi_bytes_per_shot': D * 8,
+        'psi_touches_per_step': touches,
+        'model_hbm_traffic_gb': round(traffic / 1e9, 1),
+        'implied_hbm_gbps': round(traffic / t_batch / 1e9, 1),
+        'implied_hbm_frac': round(traffic / t_batch / 1e9 / V5E_HBM_GBPS,
+                                  3),
+        'einsum_tflops_per_s': round(flops / t_batch / 1e12, 2),
+        'flops_frac_bf16_peak': round(flops / t_batch / V5E_BF16_FLOPS,
+                                      4),
+        'note': 'HBM-bound by construction: the [B, 2^C] complex carry '
+                'streams once per channel stage per step; einsum FLOPs '
+                'are negligible against the MXU peak.  Traffic is the '
+                'analytic touch model (not XLA cost_analysis — see '
+                'docs/PERF.md), time is the interleaved probe median.',
+    }
+
+
 def _preflight(timeout_s: float = 180.0):
     """Fail fast with a diagnostic JSON if the accelerator backend hangs
     (a dead axon tunnel blocks forever inside backend init, which would
@@ -434,20 +516,53 @@ def main():
         if kind == 'bloch':
             return DeviceModel('bloch', t1_s=80e-6, t2_s=40e-6,
                                depol_per_pulse=0.002)
+        if kind == 'statevec':
+            # full trajectory engine on the headline workload (same
+            # noise scales as the bloch probe, plus the 2q channel);
+            # couplings derived from the headline program + qchip — the
+            # 1q RB workload drives no cross-core frequencies, so the
+            # honest map here is empty and the event-ordering gate is
+            # structurally off; the statevec:cz probe measures the
+            # gated entangling workload
+            return DeviceModel('statevec', t1_s=80e-6, t2_s=40e-6,
+                               depol_per_pulse=0.002,
+                               depol2_per_pulse=0.002,
+                               couplings=couplings_from_qchip(
+                                   mp, make_default_qchip(n_qubits)))
         if kind != 'parity':
             raise SystemExit(
                 f'BENCH_DEVICE={kind!r}: unknown device model '
-                f"(one of 'parity', 'bloch')")
+                f"(one of 'parity', 'bloch', 'statevec')")
         return DeviceModel('parity')
 
     # one compiled step per mode, shared by race + headline + secondaries
     steps: dict = {}
 
+    cz_layers = int(os.environ.get('BENCH_CZ_LAYERS', 4))
+
     def mode_step(mode, device=bench_device) -> _ModeStep:
         key = (mode, device)
         if key not in steps:
-            steps[key] = _ModeStep(mp, cfg, batch, sigma, chunk, mode,
-                                   _device_model(device))
+            if device == 'statevec:cz':
+                from distributed_processor_tpu.sim.device import DeviceModel
+                mp2, qchip2 = build_entangling_program(n_qubits, cz_layers)
+                dev2 = DeviceModel(
+                    'statevec', t1_s=80e-6, t2_s=40e-6,
+                    depol_per_pulse=0.002, depol2_per_pulse=0.002,
+                    couplings=couplings_from_qchip(mp2, qchip2))
+                assert dev2.couplings, \
+                    'entangling probe derived an empty coupling map'
+                # the event gate can serialize cross-core triggers:
+                # budget steps at n_instr x (cores + slack)
+                cfg2 = InterpreterConfig(
+                    max_steps=2 * mp2.n_instr * (mp2.n_cores + 2) + 64,
+                    max_pulses=int(mp2.max_pulses_per_core(1)) + 4,
+                    max_meas=2, max_resets=2, record_pulses=False)
+                steps[key] = _ModeStep(mp2, cfg2, batch, sigma, chunk,
+                                       mode, dev2)
+            else:
+                steps[key] = _ModeStep(mp, cfg, batch, sigma, chunk, mode,
+                                       _device_model(device))
         return steps[key]
 
     if headline_mode == 'auto':
@@ -520,6 +635,17 @@ def main():
                     and not (m == 'fused' and not on_tpu)]
     probe_specs.append((f'device:{other_device}', headline_mode,
                         other_device))
+    # the statevec trajectory engine at the bench workload (round-4
+    # review missing #1): the same headline program on the [B, 2^C]
+    # entangling co-state, plus the brickwork-CZ workload with the
+    # coupling map + event-ordering gate live.  TPU-only: the bench
+    # batch through the trajectory step is hours on CPU.
+    from distributed_processor_tpu.sim.device import STATEVEC_MAX_CORES
+    if on_tpu and n_qubits <= STATEVEC_MAX_CORES:
+        if bench_device != 'statevec':
+            probe_specs.append(('device:statevec', headline_mode,
+                                'statevec'))
+        probe_specs.append(('statevec:cz', headline_mode, 'statevec:cz'))
     probe_rounds = int(os.environ.get('BENCH_PROBE_ROUNDS', 5))
     probe_times: dict = {}
     probe_keys: dict = {}
@@ -549,9 +675,11 @@ def main():
                 probe_keys[name], sub = jax.random.split(probe_keys[name])
                 t0 = time.perf_counter()
                 pres = jax.block_until_ready(pstep(sub))
-                ok = not int(pres[5])       # host sync inside the window
+                # host sync inside the window; err bits checked so a
+                # probe number never quietly includes errored shots
+                ok = not int(pres[5]) and not int(pres[1])
                 dt = time.perf_counter() - t0
-                assert ok, f'{name} batch did not complete'
+                assert ok, f'{name} batch incomplete or errored'
                 probe_times[name].append(dt)
             except Exception as e:  # pragma: no cover - defensive
                 # keep the rounds already collected: earlier samples are
@@ -620,6 +748,19 @@ def main():
             mp, cfg, model, batch, elapsed / n_batches, int(res[4]))
     except Exception as e:      # pragma: no cover - defensive
         utilization = {'error': f'{type(e).__name__}: {e}'[:200]}
+    # statevec roofline rows, from the interleaved probe medians
+    sv_utils = {}
+    for nm, dv in (('device:statevec', 'statevec'),
+                   ('statevec:cz', 'statevec:cz')):
+        p = probe_sps.get(nm)
+        if not (isinstance(p, dict) and 'error' not in p):
+            continue
+        try:
+            sv_utils[nm] = statevec_utilization(
+                steps[(headline_mode, dv)], batch,
+                batch / p['sps_median'])
+        except Exception as e:  # pragma: no cover - defensive
+            sv_utils[nm] = {'error': f'{type(e).__name__}: {e}'[:200]}
     try:
         scaling = large_program_scaling(n_qubits, small_depth=depth)
     except Exception as e:      # pragma: no cover - defensive
@@ -660,6 +801,8 @@ def main():
             # only when |ratio - 1| > spread)
             'probes_interleaved': probe_sps,
             'probe_ratios_vs_headline': probe_ratios,
+            'statevec_cz_layers': cz_layers,
+            'statevec_utilization': sv_utils or None,
             'scaling': scaling,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

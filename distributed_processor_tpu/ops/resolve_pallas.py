@@ -82,8 +82,12 @@ def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
         # single-envelope program, one broadcast row, zero MXU work
         e_i = jnp.broadcast_to(t_ref[0, 0, 0][None, :], (tb, ck))
         e_q = jnp.broadcast_to(t_ref[0, 1, 0][None, :], (tb, ck))
+        # minor-dim insertion must happen on the i32 vector, not the i1
+        # compare result (mosaic: "Insertion of minor dim that is not a
+        # no-op only supported for 32-bit types")
+        addr_col = addr[:, None]                              # [TB, 1] i32
         for ridx in range(1, len(rows)):
-            selr = (addr == rows[ridx])[:, None]
+            selr = addr_col == rows[ridx]
             e_i = jnp.where(selr, t_ref[0, 0, ridx][None, :], e_i)
             e_q = jnp.where(selr, t_ref[0, 1, ridx][None, :], e_q)
     else:
@@ -108,8 +112,9 @@ def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
     f_idx = fidx_ref[0, 0, :]                                 # [TB]
     bc = jnp.broadcast_to(bas_ref[0, 0, 0][None, :], (tb, ck))
     bs = jnp.broadcast_to(bas_ref[0, 1, 0][None, :], (tb, ck))
+    f_col = f_idx[:, None]             # i32 reshape BEFORE the compare
     for f in range(1, n_f):
-        sel = (f_idx == f)[:, None]
+        sel = f_col == f
         bc = jnp.where(sel, bas_ref[0, 0, f][None, :], bc)
         bs = jnp.where(sel, bas_ref[0, 1, f][None, :], bs)
     cosa = cosa_ref[0, 0, :][:, None]

@@ -71,6 +71,16 @@ ERR_CW_MEAS = 128        # physics mode: measurement pulse with a CW
                          # (hold-until-next) envelope — no defined window
                          # length, so the resolver cannot demodulate it
                          # (docs/PHYSICS.md "Known model limits")
+ERR_COFIRE_ORDER = 256   # statevec: an equal-trigger-time cross-core
+                         # co-fire where a coupling pulse's operator
+                         # does not commute with its partner's — the
+                         # engine's fixed stage order (1q, couplings,
+                         # measurements) would silently pick one of two
+                         # physically distinct outcomes, so it is
+                         # flagged instead (the hardware has no analog:
+                         # per-core sequential issue, and genuine RF
+                         # overlap is not a sequenced product either).
+                         # Separate the pulses with a barrier/delay.
 
 # program-fetch strategy crossover: one-hot multiply-reduce up to this
 # many instructions, per-lane gather beyond (see _step fetch comment)
@@ -683,6 +693,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     # parameters for the epoch resolver (sim/physics.py).
     phys_updates = {}
     cw_meas_err = 0
+    cofire_err = 0
     if cfg.physics:
         if cfg.cw_horizon > 0:
             # CW readout with a configured horizon: the bit exists once
@@ -774,7 +785,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             (det_cyc, inv_t1, inv_t2, depol1, depol2, zx90, zz90, leak,
              meas_u, traj_key) = dev['params']
             (couplings, has_det, has_decay, has_dp1, has_dp2,
-             has_leak, leak_bit) = dev['static']
+             has_leak, leak_bit, leak_iq) = dev['static']
             leaked = st['leaked']                             # [B, C]
             psi = st['psi']                                   # [B, 2^C] c64
             zsign = jnp.asarray(_sv_zsign(C))                 # [C, D]
@@ -793,6 +804,67 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                                  & (jnp.arange(C) == cc)[None, :])
             is_1q = is_drive & ~is_cr
             touch = is_drive | is_meas_pulse
+            # ---- equal-time co-fire ordering lint (review round 4
+            # weak #3): when cross-core pulses land on the same trigger
+            # time, the engine applies a fixed stage order (1q ->
+            # couplings -> measurements) — for non-commuting operator
+            # pairs that is a simulator-chosen ordering with no
+            # hardware analog, so it is FLAGGED (ERR_COFIRE_ORDER on
+            # the coupling's control core) instead of silently decided.
+            # Commuting overlaps stay clean: 1q||1q on distinct cores,
+            # Z legs vs Z measurement, zz||zz (both diagonal), and
+            # couplings sharing only control (Z) legs.  Under the event
+            # gate, cross-core pulses co-firing in one step always have
+            # EQUAL triggers (unequal ones are serialized), so the
+            # equal-trig test below is exactly the co-fire set.
+            if couplings:
+                eff = []
+                for mk, (c1, _fi, t1, _kd) in zip(cp_masks, couplings):
+                    if has_leak:
+                        # leaked legs no-op the interaction (stage 4):
+                        # no physics to mis-order
+                        mk = mk & ~leaked[:, c1] & ~leaked[:, t1]
+                    eff.append(mk)
+                cof_cols = [jnp.zeros((B,), bool)] * C
+                # equatorial axes agree mod pi <=> phase words agree
+                # mod a half turn (X^(phi+pi) = -X^phi: same rotation
+                # generator up to sign)
+                half = 1 << (PHASE_BITS - 1)
+                pw = pp[..., 1]
+                ax_ne = lambda a, b: ((pw[:, a] - pw[:, b]) % half) != 0
+                for i, (mi, (c1, _f1, t1, k1)) in enumerate(
+                        zip(eff, couplings)):
+                    tcc = trig[:, c1]
+                    same = lambda c: fire[:, c] & (trig[:, c] == tcc)
+                    # the coupling's target-leg clashes.  zx: the X leg
+                    # clashes with a DIFFERENT-axis 1q drive (same-axis
+                    # rotations commute) and with Z measurement; zz:
+                    # the Z leg clashes with any equatorial 1q drive
+                    # and commutes with measurement.
+                    bad = same(t1) & is_1q[:, t1]
+                    if k1 == 'zx':
+                        bad = bad & ax_ne(c1, t1)
+                        bad = bad | (same(t1) & is_meas_pulse[:, t1])
+                    for j in range(i + 1, len(couplings)):
+                        mj, (c2, _f2, t2, k2) = eff[j], couplings[j]
+                        if k1 == 'zz' and k2 == 'zz':
+                            continue          # both diagonal: commute
+                        if k1 == 'zx' and k2 == 'zx':
+                            hard = (t1 == c2) or (t2 == c1)  # X vs Z
+                            soft = t1 == t2                  # X vs X
+                        elif k1 == 'zx':
+                            hard, soft = t1 in (c2, t2), False
+                        else:
+                            hard, soft = t2 in (c1, t1), False
+                        if hard:
+                            bad = bad | (mj & same(c2))
+                        elif soft:
+                            # shared X target: commute iff same axis
+                            bad = bad | (mj & same(c2) & ax_ne(c1, c2))
+                    hit = mi & bad
+                    cof_cols[c1] = cof_cols[c1] | hit
+                cofire_err = jnp.where(jnp.stack(cof_cols, axis=-1),
+                                       ERR_COFIRE_ORDER, 0)
             dt = jnp.where(touch,
                            (trig - st['phys_t']).astype(jnp.float32), 0.0)
             if has_decay or has_dp1 or has_dp2 or has_leak:
@@ -938,15 +1010,22 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                 p1c = jnp.clip(jnp.sum(
                     bit1[c][None] * (psi.real**2 + psi.imag**2), -1),
                     0.0, 1.0)
-                if has_leak:
-                    # a leaked core discriminates as leak_readout_bit
-                    # (|2> sits near |1> in IQ space on most devices);
-                    # no collapse — its slot was projected at leak
-                    # time.  Forcing p1c to exactly 0/1 forces the
-                    # uniform comparison below to the leak bit.
+                if has_leak and not leak_iq:
+                    # fast path: a leaked core discriminates as
+                    # leak_readout_bit (|2> sits near |1> in IQ space
+                    # on most devices); no collapse — its slot was
+                    # projected at leak time.  Forcing p1c to exactly
+                    # 0/1 forces the uniform comparison below to the
+                    # leak bit.
                     p1c = jnp.where(leaked[:, c], float(leak_bit), p1c)
                 bitc = (u_sel[:, c] < p1c).astype(jnp.int32) \
                     * mc.astype(jnp.int32)
+                if has_leak and leak_iq:
+                    # IQ-level leakage readout: record device state 2 —
+                    # the resolver synthesizes the window with the g2
+                    # response and the read bit emerges from the demod
+                    # chain (sim/physics.py _gs3 / _classify3_acc)
+                    bitc = jnp.where(leaked[:, c] & mc, 2, bitc)
                 keep = jnp.where(bitc[:, None] == 1, bit1[c][None, :],
                                  1.0 - bit1[c][None, :])
                 p_sel = jnp.where(bitc == 1, p1c, 1.0 - p1c)
@@ -1035,7 +1114,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     if has_sync:
         offset_next = jnp.where(sync_adv, release, offset_next)
 
-    err = st['err'] | rec_of | meas_of | cw_meas_err \
+    err = st['err'] | rec_of | meas_of | cw_meas_err | cofire_err \
         | jnp.where(missed_trig | missed_idle, ERR_MISSED_TRIG, 0)
     if any_fproc:
         err = err \

@@ -92,6 +92,23 @@ class ReadoutPhysics:
     """
     g0: complex = 1.0 + 0.0j
     g1: complex = -0.6 + 0.8j
+    # |2> channel response (scalar or per-core), for IQ-LEVEL leakage
+    # readout: when set (statevec device with leak_per_pulse > 0), a
+    # leaked core's readout window is synthesized and demodulated
+    # through the REAL chain with this response — the leaked bit then
+    # EMERGES from where g2 projects on the g0/g1 discrimination axis
+    # (put it near g1 to model the usual |2>-reads-as-|1> geometry)
+    # instead of being forced (the ``leak_readout_bit`` shortcut, which
+    # remains the documented fast path when g2 is None).  This is the
+    # IQ-level element contract the rest of the loop implements
+    # (reference: python/distproc/asmparse.py:46-63).
+    g2: complex = None
+    # 3-class discrimination (needs g2): nearest-centroid in the IQ
+    # plane against {g0*E, g1*E, g2*E}.  The run output gains
+    # ``meas_class`` ([B, C, M] in {0, 1, 2}) — the observable a
+    # leakage-detection experiment reads; the fabric bit a branching
+    # program sees maps class 2 to ``leak_readout_bit``.
+    classify3: bool = False
     sigma: float = 0.05
     p1_init: float = 0.1
     x90_amp: int = X90_AMP_DEFAULT
@@ -447,7 +464,8 @@ def _ar1_tables(rho, chunk: int):
 
 def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
              W: int, chunk: int = None, interps=None, prebuilt=None,
-             ring: bool = False, cw: int = 0, colored=None):
+             ring: bool = False, cw: int = 0, colored=None,
+             iq3=None, cls=None):
     """Demodulate pending readout windows into bits — one slot per
     (shot, core) per call.  ``prebuilt``: optional ``(toeplitz, basis)``
     built once by the caller — pass it when calling from inside a loop
@@ -487,9 +505,11 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
     # must clip the integration the way the unchunked path's shape did)
     sc = dict(sc, n_samp=jnp.minimum(sc['n_samp'], W))
 
-    # state-dependent channel response for the chosen slot
+    # state-dependent channel response for the chosen slot (3-way when
+    # IQ-level leakage readout records state 2 for leaked cores)
     gs = jnp.where(state_sel[..., None] == 1,
                    g1[None, :, None, :], g0[None, :, None, :])   # [B,C,1,2]
+    gs = _gs3(gs, state_sel, iq3[0] if iq3 is not None else None)
     gs_i, gs_q = gs[..., 0], gs[..., 1]
 
     if prebuilt is not None:
@@ -549,14 +569,19 @@ def _resolve(st: dict, bits, valid, key, tables, env_pads, response,
             jax.random.fold_in(key, 0x41523149), (2, B, C, 1), jnp.float32),)
     (acc_i, acc_q, energy, *_), _ = jax.lax.scan(
         chunk_body, carry0, jnp.arange(n_chunks, dtype=jnp.int32))
-    new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)[..., 0]
-    return _scatter_slot_bit(bits, valid, new_bit, oh_slot, has_pending)
+    new_bit, new_cls = _acc_to_bit(acc_i, acc_q, energy, g0, g1, iq3)
+    if new_cls is not None:
+        cls, _ = _scatter_slot_bit(cls, valid, new_cls[..., 0], oh_slot,
+                                   has_pending)
+    bits, valid = _scatter_slot_bit(bits, valid, new_bit[..., 0], oh_slot,
+                                    has_pending)
+    return bits, valid, cls
 
 
 def _resolve_fused(st: dict, bits, valid, key, tables, fused_tables,
                    response, W: int, Lp: int, ck: int, ring: bool = False,
                    native_rng: bool = None, rows: tuple = None,
-                   cw: int = 0):
+                   cw: int = 0, iq3=None, cls=None):
     """Slot-compacted resolve through the fused Pallas kernel
     (:func:`..ops.resolve_pallas.resolve_windows_fused`): same
     per-sample chain as :func:`_resolve` with every intermediate in
@@ -572,12 +597,18 @@ def _resolve_fused(st: dict, bits, valid, key, tables, fused_tables,
     state_sel = state_sel[..., 0]                             # [B, C]
     gs = jnp.where(state_sel[..., None] == 1,
                    g1[None, :, :], g0[None, :, :])            # [B, C, 2]
+    gs = _gs3(gs, state_sel, iq3[0] if iq3 is not None else None)
     acc_i, acc_q, energy = resolve_windows_fused(
         sc, fused_tables, gs[..., 0], gs[..., 1], sigma, inv_ring, key,
         W, Lp, ck=ck, ring=ring, native_rng=native_rng, rows=rows,
         interpret=jax.default_backend() != 'tpu')
-    new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)[..., 0]
-    return _scatter_slot_bit(bits, valid, new_bit, oh_slot, has_pending)
+    new_bit, new_cls = _acc_to_bit(acc_i, acc_q, energy, g0, g1, iq3)
+    if new_cls is not None:
+        cls, _ = _scatter_slot_bit(cls, valid, new_cls[..., 0], oh_slot,
+                                   has_pending)
+    bits, valid = _scatter_slot_bit(bits, valid, new_bit[..., 0], oh_slot,
+                                    has_pending)
+    return bits, valid, cls
 
 
 def _discriminate_acc(acc_i, acc_q, energy, g0, g1):
@@ -592,8 +623,45 @@ def _discriminate_acc(acc_i, acc_q, energy, g0, g1):
     return (proj > 0).astype(jnp.int32)
 
 
+def _classify3_acc(acc_i, acc_q, energy, g0, g1, g2):
+    """Nearest-centroid 3-class discrimination in the IQ plane: the
+    accumulation's distance to each clean response ``g_s * E``
+    (maximum-likelihood under the isotropic matched-filter noise).
+    Returns classes in {0, 1, 2}."""
+    def dist2(g):
+        return (acc_i - g[None, :, None, 0] * energy) ** 2 \
+            + (acc_q - g[None, :, None, 1] * energy) ** 2
+    d0, d1, d2 = dist2(g0), dist2(g1), dist2(g2)
+    cls = jnp.where(d1 < d0, 1, 0)
+    cls = jnp.where(d2 < jnp.minimum(d0, d1), 2, cls)
+    return cls.astype(jnp.int32)
+
+
+def _acc_to_bit(acc_i, acc_q, energy, g0, g1, iq3):
+    """Shared tail of every resolve mode: discriminate the accumulation
+    into ``(bit, cls)`` — 2-class threshold by default, 3-class
+    nearest-centroid with the class-2 -> ``leak_readout_bit`` fabric
+    mapping when ``classify3`` is on.  ``cls`` is None when 2-class."""
+    g2, classify3, leak_bit = iq3 if iq3 is not None else (None, False, 1)
+    if not classify3:
+        return _discriminate_acc(acc_i, acc_q, energy, g0, g1), None
+    cls = _classify3_acc(acc_i, acc_q, energy, g0, g1, g2)
+    return jnp.where(cls == 2, leak_bit, cls), cls
+
+
+def _gs3(gs, state_sel, g2):
+    """Overlay the |2> response where the recorded device state is 2
+    (leaked core under IQ-level leakage readout).  ``gs`` is
+    ``[B, C, ..., 2]`` and ``state_sel`` matches it minus the I/Q
+    axis; ``g2`` is ``[C, 2]``."""
+    if g2 is None:
+        return gs
+    g2b = g2.reshape((1, -1) + (1,) * (gs.ndim - 3) + (2,))
+    return jnp.where(state_sel[..., None] == 2, g2b, gs)
+
+
 def _resolve_analytic(st: dict, bits, valid, key, tables, env_pads,
-                      response, W: int, cw: int = 0):
+                      response, W: int, cw: int = 0, iq3=None, cls=None):
     """Exact distributional shortcut of :func:`_resolve` for the
     white-noise matched-filter model.
 
@@ -645,6 +713,7 @@ def _resolve_analytic(st: dict, bits, valid, key, tables, env_pads,
 
     gs = jnp.where(st['meas_state'][..., None] == 1,
                    g1[None, :, None, :], g0[None, :, None, :])
+    gs = _gs3(gs, st['meas_state'], iq3[0] if iq3 is not None else None)
     root_e = jnp.sqrt(energy)
     k_i, k_q = jax.random.split(key)
     shape = (B, C, M)
@@ -652,9 +721,11 @@ def _resolve_analytic(st: dict, bits, valid, key, tables, env_pads,
         jax.random.normal(k_i, shape, jnp.float32)
     acc_q = gs[..., 1] * energy + sigma * root_e * \
         jax.random.normal(k_q, shape, jnp.float32)
-    new_bit = _discriminate_acc(acc_i, acc_q, energy, g0, g1)
+    new_bit, new_cls = _acc_to_bit(acc_i, acc_q, energy, g0, g1, iq3)
+    if new_cls is not None:
+        cls = jnp.where(pending, new_cls, cls)
     bits = jnp.where(pending, new_bit, bits)
-    return bits, valid | fired
+    return bits, valid | fired, cls
 
 
 def _static_meas_env_addrs(mp, max_rows: int = 8):
@@ -767,7 +838,7 @@ _build_tables_jit = functools.partial(
                                              'ring', 'traits',
                                              'native_rng', 'rows',
                                              'dev_static', 'cw',
-                                             'colored'))
+                                             'colored', 'classify3'))
 def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      tabs, freq_stack, g0, g1, sigma, inv_ring,
                      key, dev_params, meas_u,
@@ -779,7 +850,7 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      native_rng: bool = None, rows: tuple = None,
                      traj_key=None, dev_static: tuple = None,
                      cw: int = 0, colored: bool = False,
-                     rho=None) -> dict:
+                     rho=None, g2=None, classify3: bool = False) -> dict:
     B = init_states.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
@@ -794,8 +865,11 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
         idx = jnp.sum(init_states * weights[None, :], axis=-1)
         st0['psi'] = (idx[:, None]
                       == jnp.arange(1 << C)[None, :]).astype(jnp.complex64)
+        # trailing static: IQ-level leakage readout (g2 set) — leaked
+        # cores record state 2 for the resolver instead of forcing the
+        # discrimination bit (interpreter measurement block)
         dev = {'params': dev_params + (meas_u, traj_key),
-               'static': dev_static}
+               'static': dev_static + (g2 is not None,)}
     else:
         zf = jnp.zeros((B, C), jnp.float32)
         st0['bloch'] = jnp.stack(
@@ -805,6 +879,11 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
     st0['paused'] = jnp.zeros((B,), bool)
     bits0 = jnp.zeros((B, C, M), jnp.int32)
     valid0 = jnp.zeros((B, C, M), bool)
+    # 3-class discrimination record (a scalar placeholder keeps the
+    # carry pytree fixed when the classifier is off)
+    cls0 = jnp.zeros((B, C, M) if classify3 else (1, 1, 1), jnp.int32)
+    leak_bit = int(dev_static[6]) if dev_static is not None else 1
+    iq3 = (g2, classify3, leak_bit) if g2 is not None else None
     # tables arrive prebuilt (tabs) — _window_scalars only needs the
     # frequency table and element geometry from this tuple
     tables = (None, freq_stack,
@@ -822,7 +901,7 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
         rho, _aligned_chunk(chunk, W, interps)) if colored else None
 
     def cond(carry):
-        st, bits, valid, ep = carry
+        st, bits, valid, _cls, ep = carry
         # run while execution can still progress (not done, step budget
         # left — a shot that ran out of steps can never finish, so don't
         # burn further full-batch passes on it) OR fired windows remain
@@ -836,31 +915,34 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
         return (can_exec | unresolved) & (ep < max_epochs)
 
     def body(carry):
-        st, bits, valid, ep = carry
+        st, bits, valid, cls, ep = carry
         st = _exec_loop(st, soa, spc, interp, sync_part, bits, valid, cfg,
                         dev, traits)
         if mode == 'analytic':
-            bits, valid = _resolve_analytic(st, bits, valid, key, tables,
-                                            env_pads, response, W, cw)
+            bits, valid, cls = _resolve_analytic(
+                st, bits, valid, key, tables, env_pads, response, W, cw,
+                iq3, cls)
         elif mode == 'fused':
-            bits, valid = _resolve_fused(
+            bits, valid, cls = _resolve_fused(
                 st, bits, valid, jax.random.fold_in(key, ep), tables,
                 fused_tables, response, W, lp, ck, ring, native_rng, rows,
-                cw)
+                cw, iq3, cls)
         else:
-            bits, valid = _resolve(st, bits, valid, jax.random.fold_in(
+            bits, valid, cls = _resolve(st, bits, valid, jax.random.fold_in(
                 key, ep), tables, env_pads, response, W, chunk, interps,
-                prebuilt, ring, cw, colored_tabs)
+                prebuilt, ring, cw, colored_tabs, iq3, cls)
         st = dict(st, paused=jnp.zeros_like(st['paused']))
-        return st, bits, valid, ep + 1
+        return st, bits, valid, cls, ep + 1
 
-    st, bits, valid, ep = jax.lax.while_loop(
-        cond, body, (st0, bits0, valid0, jnp.int32(0)))
+    st, bits, valid, cls, ep = jax.lax.while_loop(
+        cond, body, (st0, bits0, valid0, cls0, jnp.int32(0)))
     st.pop('paused')
     out = _finalize(st, cfg)
     out['meas_bits'] = bits
     out['meas_bits_valid'] = valid
     out['epochs'] = ep
+    if classify3:
+        out['meas_class'] = cls
     return out
 
 
@@ -1132,6 +1214,17 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
             f'window_samples to integrate longer CW windows')
     if not 0.0 <= model.noise_ar1 < 1.0:
         raise ValueError(f'noise_ar1={model.noise_ar1} must be in [0, 1)')
+    if model.g2 is not None and (
+            model.device.kind != 'statevec'
+            or not np.any(np.asarray(model.device.leak_per_pulse,
+                                     np.float64))):
+        raise ValueError(
+            'g2 (the |2> IQ response) needs device=statevec with '
+            'leak_per_pulse > 0 — no leakage channel, no |2> population')
+    if model.classify3 and model.g2 is None:
+        raise ValueError(
+            'classify3 (3-class discrimination) needs g2 (the |2> '
+            'response) set')
     if model.noise_ar1 > 0 and model.resolve_mode != 'persample':
         raise ValueError(
             f"resolve_mode={model.resolve_mode!r} generates white ADC "
@@ -1170,4 +1263,6 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         model.resolve_mode, model.ring_tau > 0, program_traits(mp),
         model.fused_native_rng, rows, traj_key, dev_static,
         int(model.cw_horizon), model.noise_ar1 > 0,
-        jnp.float32(model.noise_ar1))
+        jnp.float32(model.noise_ar1),
+        g2=as_iq(model.g2) if model.g2 is not None else None,
+        classify3=bool(model.classify3))

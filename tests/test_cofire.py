@@ -1,0 +1,163 @@
+"""Equal-time co-fire ordering lint (statevec device).
+
+When cross-core pulses land on the same trigger time, the statevec
+engine applies a fixed stage order (1q rotations -> couplings ->
+measurements).  For non-commuting operator pairs that is a
+simulator-chosen ordering with no hardware analog (the FPGA issues
+per-core sequentially — reference: hdl/ctrl.v one instruction at a
+time — and genuine RF overlap is not a sequenced product either), so
+the engine flags it (``ERR_COFIRE_ORDER``) instead of silently picking
+an outcome.  Commuting overlaps stay clean: 1q||1q on distinct cores,
+Z legs against Z measurement, zz||zz (both diagonal).
+"""
+
+import numpy as np
+
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.decoder import machine_program_from_cmds
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.models.coupling import couplings_from_qchip
+from distributed_processor_tpu.models.default_qchip import make_default_qchip
+from distributed_processor_tpu.sim.device import DeviceModel
+from distributed_processor_tpu.sim.interpreter import ERR_COFIRE_ORDER
+from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                   run_physics_batch)
+
+
+def _run_pair(c0_t, c1_t, kind='zx', c1_meas=False, c1_phase=40000):
+    """Two cores, one coupling (0 -> 1): core 0 fires a coupling pulse
+    at ``c0_t``; core 1 fires a 1q drive (or measurement) at ``c1_t``
+    (``c1_phase`` defaults to a DIFFERENT equatorial axis than the
+    coupling's — same-axis zx overlaps commute and stay clean)."""
+    c1_cfg = 2 if c1_meas else 0
+    mp = machine_program_from_cmds([
+        [isa.pulse_cmd(cmd_time=c0_t, cfg_word=0, env_word=4096,
+                       amp_word=20000, phase_word=0),
+         isa.done_cmd()],
+        [isa.pulse_cmd(cmd_time=c1_t, cfg_word=c1_cfg,
+                       env_word=(8 << 12) if c1_meas else 4096,
+                       amp_word=30000, phase_word=c1_phase),
+         isa.done_cmd()],
+    ])
+    if c1_meas:
+        for t in mp.tables:
+            t.envs[2] = np.ones(32, complex)
+            t.freqs[2] = {'freq': np.array([0.0]),
+                          'iq15': np.zeros((1, 15))}
+    model = ReadoutPhysics(sigma=0.0, device=DeviceModel(
+        'statevec', couplings=((0, 0, 1, kind),)))
+    out = run_physics_batch(mp, model, 0, 4, max_steps=256)
+    assert not bool(out['incomplete'])
+    return np.asarray(out['err'])
+
+
+def test_zx_collides_with_target_drive():
+    err = _run_pair(100, 100, kind='zx')
+    assert np.all(err[:, 0] & ERR_COFIRE_ORDER)
+
+
+def test_zx_same_axis_target_drive_commutes():
+    """A same-axis (phase word equal mod half-turn) 1q drive on the zx
+    target commutes with the coupling's X leg: clean."""
+    for ph in (0, 1 << 16):          # phi and phi + pi: same generator
+        err = _run_pair(100, 100, kind='zx', c1_phase=ph)
+        assert not np.any(err & ERR_COFIRE_ORDER), ph
+
+
+def test_zz_collides_with_target_drive():
+    err = _run_pair(100, 100, kind='zz')
+    assert np.all(err[:, 0] & ERR_COFIRE_ORDER)
+
+
+def test_separated_triggers_are_clean():
+    """The event gate serializes unequal triggers: no co-fire, no flag."""
+    for kind in ('zx', 'zz'):
+        assert not np.any(_run_pair(100, 200, kind=kind))
+        assert not np.any(_run_pair(200, 100, kind=kind))
+
+
+def test_zx_collides_with_target_measurement():
+    """The zx target leg is X: non-commuting with the Z measurement."""
+    err = _run_pair(100, 100, kind='zx', c1_meas=True)
+    assert np.all(err[:, 0] & ERR_COFIRE_ORDER)
+
+
+def test_zz_commutes_with_measurement():
+    """zz is diagonal: a same-time Z measurement commutes — clean."""
+    err = _run_pair(100, 100, kind='zz', c1_meas=True)
+    assert not np.any(err & ERR_COFIRE_ORDER)
+
+
+def test_shared_target_zx_pair_axis_dependent():
+    """Two CR tones converging on one target: same drive axis commutes
+    (clean), different axes do not (flagged on the first coupling's
+    control core)."""
+    def run(ph1):
+        mp = machine_program_from_cmds([
+            [isa.pulse_cmd(cmd_time=100, cfg_word=0, env_word=4096,
+                           amp_word=20000, phase_word=0),
+             isa.done_cmd()],
+            [isa.pulse_cmd(cmd_time=100, cfg_word=0, env_word=4096,
+                           amp_word=20000, phase_word=ph1),
+             isa.done_cmd()],
+            [isa.done_cmd()],
+        ])
+        model = ReadoutPhysics(sigma=0.0, device=DeviceModel(
+            'statevec', couplings=((0, 0, 2, 'zx'), (1, 0, 2, 'zx'))))
+        out = run_physics_batch(mp, model, 0, 4, max_steps=256)
+        assert not bool(out['incomplete'])
+        return np.asarray(out['err'])
+
+    assert not np.any(run(0) & ERR_COFIRE_ORDER)        # same axis
+    err = run(40000)                                    # different axis
+    assert np.all(err[:, 0] & ERR_COFIRE_ORDER)
+
+
+def test_compiled_cz_x90_collision_and_barrier_fix():
+    """Compiled path: a user program playing CZ(Q0,Q1) and X90 on Q1 in
+    the same schedule layer collides (flagged); the barrier-separated
+    variant is clean — the lint tells the user exactly which fix the
+    stack's scheduling model expects (the fence every calibrated 2q
+    gate and rb2q already carry)."""
+    sim = Simulator(n_qubits=2)
+    qchip = make_default_qchip(2)
+    reads = [{'name': 'read', 'qubit': ['Q0']},
+             {'name': 'read', 'qubit': ['Q1']}]
+
+    def run(prog):
+        mp = sim.compile(prog)
+        model = ReadoutPhysics(sigma=0.0, device=DeviceModel(
+            'statevec', couplings=couplings_from_qchip(mp, qchip)))
+        out = run_physics_batch(mp, model, 0, 4, max_steps=4000,
+                                max_pulses=64, max_meas=4)
+        assert not bool(out['incomplete'])
+        return np.asarray(out['err'])
+
+    err = run([{'name': 'CZ', 'qubit': ['Q0', 'Q1']},
+               {'name': 'X90', 'qubit': ['Q1']}] + reads)
+    assert np.all(err[:, 0] & ERR_COFIRE_ORDER), \
+        'unfenced CZ || X90 must be flagged'
+    err = run([{'name': 'CZ', 'qubit': ['Q0', 'Q1']},
+               {'name': 'barrier', 'qubit': ['Q0', 'Q1']},
+               {'name': 'X90', 'qubit': ['Q1']}] + reads)
+    assert not np.any(err), 'barrier-separated variant must be clean'
+
+
+def test_brickwork_cz_layers_are_clean():
+    """Parallel CZs on disjoint pairs co-fire zz||zz (both diagonal):
+    the bench's entangling workload shape must stay clean."""
+    sim = Simulator(n_qubits=4)
+    qchip = make_default_qchip(4)
+    qubits = ['Q0', 'Q1', 'Q2', 'Q3']
+    prog = [{'name': 'barrier', 'qubit': qubits},
+            {'name': 'CZ', 'qubit': ['Q0', 'Q1']},
+            {'name': 'CZ', 'qubit': ['Q2', 'Q3']},
+            {'name': 'barrier', 'qubit': qubits}] \
+        + [{'name': 'read', 'qubit': [q]} for q in qubits]
+    mp = sim.compile(prog)
+    model = ReadoutPhysics(sigma=0.0, device=DeviceModel(
+        'statevec', couplings=couplings_from_qchip(mp, qchip)))
+    out = run_physics_batch(mp, model, 0, 4, max_steps=8000,
+                            max_pulses=64, max_meas=4)
+    assert not bool(out['incomplete'])
+    assert not np.any(np.asarray(out['err']))
