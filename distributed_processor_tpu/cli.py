@@ -160,16 +160,29 @@ def cmd_run(args):
         if args.device != 'statevec' and args.leak:
             raise SystemExit('--leak (computational-subspace leakage) '
                              'needs --device statevec')
-        if args.leak_bit != 1 and not (args.device == 'statevec'
-                                       and args.leak):
-            raise SystemExit('--leak-bit has no effect without '
-                             '--device statevec and --leak > 0')
         if args.device == 'parity' and (args.detuning_hz or args.t1_us
                                         or args.t2_us or args.depol):
             raise SystemExit(
                 '--detuning-hz/--t1-us/--t2-us/--depol need '
                 '--device bloch or statevec (the parity counter has no '
                 'such physics)')
+        any_leak = args.leak or args.leak2
+        if args.leak_bit != 1 and not (args.device == 'statevec'
+                                       and any_leak):
+            raise SystemExit('--leak-bit has no effect without '
+                             '--device statevec and a leakage channel '
+                             '(--leak or --leak2)')
+        if args.device != 'statevec' and (args.leak2 or args.seep):
+            raise SystemExit('--leak2/--seep need --device statevec')
+        if args.seep and not any_leak:
+            raise SystemExit('--seep needs a leakage channel '
+                             '(--leak or --leak2)')
+        if args.leak_iq is not None and not (args.device == 'statevec'
+                                             and any_leak):
+            raise SystemExit('--leak-iq needs --device statevec with '
+                             '--leak or --leak2 > 0')
+        if args.classify3 and args.leak_iq is None:
+            raise SystemExit('--classify3 needs --leak-iq')
         dev = DeviceModel(args.device,
                           detuning_hz=args.detuning_hz,
                           t1_s=args.t1_us * 1e-6 if args.t1_us else
@@ -179,9 +192,14 @@ def cmd_run(args):
                           depol_per_pulse=args.depol,
                           depol2_per_pulse=args.depol2,
                           leak_per_pulse=args.leak,
-                          leak_readout_bit=args.leak_bit)
-        kw['physics'] = ReadoutPhysics(sigma=args.sigma,
-                                       p1_init=args.p1_init, device=dev)
+                          leak_readout_bit=args.leak_bit,
+                          leak2_per_pulse=args.leak2,
+                          seep_per_pulse=args.seep)
+        kw['physics'] = ReadoutPhysics(
+            sigma=args.sigma, p1_init=args.p1_init, device=dev,
+            g2=(complex(args.leak_iq[0], args.leak_iq[1])
+                if args.leak_iq is not None else None),
+            classify3=args.classify3)
     else:
         kw['p1'] = args.p1
     out = sim.run(_load_program(args.program, args.qasm), shots=args.shots,
@@ -204,6 +222,11 @@ def cmd_run(args):
             # leaked shots in at --leak-bit)
             result['leaked_rate_per_core'] = \
                 np.atleast_2d(np.asarray(out['leaked'])).mean(0).tolist()
+        if 'meas_class' in out:
+            # 3-class discrimination: first-slot class-2 rate per core
+            cls = np.atleast_3d(np.asarray(out['meas_class']))
+            result['class2_rate_per_core'] = \
+                (cls[..., 0] == 2).mean(0).tolist()
     print(json.dumps(result, indent=2))
 
 
@@ -295,7 +318,25 @@ def main(argv=None):
                    help='statevec: leakage probability per 1q drive '
                         'pulse (x P(|1>); CPTP trajectory unraveling)')
     p.add_argument('--leak-bit', type=int, default=1, choices=(0, 1),
-                   help='statevec: bit a leaked core reads out as')
+                   help='statevec: bit a leaked core reads out as '
+                        '(the fast path; see --leak-iq for the IQ-level '
+                        'alternative)')
+    p.add_argument('--leak2', type=float, default=0.0,
+                   help='statevec: coupling-pulse-induced control '
+                        'leakage probability (x P(|1>) per coupling '
+                        'pulse — the dominant 2q-gate mechanism)')
+    p.add_argument('--seep', type=float, default=0.0,
+                   help='statevec: |2>->|1> seepage probability per '
+                        'drive pulse on a leaked core (0 = absorbing)')
+    p.add_argument('--leak-iq', type=float, nargs=2, default=None,
+                   metavar=('RE', 'IM'),
+                   help='statevec: |2> IQ channel response g2 — leaked '
+                        'cores traverse the real demod chain instead of '
+                        'the forced --leak-bit (docs/PHYSICS.md '
+                        '"Leakage readout")')
+    p.add_argument('--classify3', action='store_true',
+                   help='statevec + --leak-iq: 3-class nearest-centroid '
+                        'discrimination; reports per-core class-2 rates')
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')

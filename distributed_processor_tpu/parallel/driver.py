@@ -24,7 +24,9 @@ from ..utils.results import SweepAccumulator
 from .sweep import physics_batch_stats
 
 
-FINGERPRINT_VERSION = 2
+# v3: batch stats gained `allzero_sum` (joint RB survival) — older
+# checkpoints' accumulator states lack the key and must not resume
+FINGERPRINT_VERSION = 3
 
 
 def _jsonable(v):
@@ -109,7 +111,8 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     register-parameterized programs (see ``decoder.make_init_regs``).
 
     Returns ``{'shots', 'mean_pulses' [C], 'meas1_rate' [C],
-    'err_shots', 'incomplete_batches'}``.
+    'survival00_rate' (joint P(every first-slot bit reads 0) — the
+    multi-qubit RB survival), 'err_shots', 'incomplete_batches'}``.
     """
     from ..sim.physics import (run_physics_batch, prepare_physics_tables,
                                validate_physics_tables)
@@ -207,6 +210,7 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
         'shots': shots_done,
         'mean_pulses': acc.state['pulse_sum'] / shots_done,
         'meas1_rate': acc.state['meas1_sum'] / shots_done,
+        'survival00_rate': float(acc.state['allzero_sum'] / shots_done),
         'err_shots': int(acc.state['err_shots']),
         'incomplete_batches': incomplete,
     }

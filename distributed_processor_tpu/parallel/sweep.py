@@ -111,10 +111,25 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
 
 def physics_batch_stats(out: dict) -> dict:
     """The per-batch reductions every physics-stats path shares:
-    per-core pulse sums, first-slot measured-1 sums, errored shots."""
+    per-core pulse sums, first-slot measured-1 sums, errored shots, and
+    the JOINT all-zeros count (``allzero_sum`` — the survival
+    numerator of multi-qubit RB, which per-core marginals cannot
+    express).
+
+    ``allzero_sum`` counts only CLEAN, fully-measured shots: a shot
+    with any error bit, or with any core's first slot never resolved
+    (its bit would sit at the 0 default), must not inflate an RB
+    survival estimate — so the statistic implies the every-core-reads
+    program shape, and a program with spectator cores reads 0 here.
+    """
+    first = out['meas_bits'][:, :, 0]
+    clean = ~jnp.any(out['err'] != 0, axis=1) \
+        & jnp.all(out['meas_bits_valid'][:, :, 0], axis=1)
     return dict(
         pulse_sum=jnp.sum(out['n_pulses'], axis=0),
-        meas1_sum=jnp.sum(out['meas_bits'][:, :, 0], axis=0),
+        meas1_sum=jnp.sum(first, axis=0),
+        allzero_sum=jnp.sum((jnp.all(first == 0, axis=1)
+                             & clean).astype(jnp.int32)),
         err_shots=jnp.sum(jnp.any(out['err'] != 0, axis=1)),
     )
 

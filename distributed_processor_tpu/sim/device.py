@@ -173,6 +173,21 @@ class DeviceModel:
     # known omission).  The run output gains a ``leaked`` [B, C] flag.
     leak_per_pulse: float = 0.0
     leak_readout_bit: int = 1
+    # Coupling-pulse-induced leakage (round 5): after each coupling
+    # pulse, the CONTROL core (the strongly-driven one — the dominant
+    # hardware mechanism for 2q gates) leaks with probability
+    # ``leak2_per_pulse * P(|1>_ctrl)``, with the same CPTP unraveling
+    # (jump -> project + mark leaked; no-jump -> damp |1| amplitude) as
+    # the 1q channel.  Interleaved 2q RB sees it as CZ error
+    # (tests/test_leakage.py).
+    leak2_per_pulse: float = 0.0
+    # Seepage |2> -> |1| (round 5): a drive pulse (1q or coupling) on a
+    # LEAKED core returns it to the computational subspace with this
+    # probability — the core re-enters in |1> (its psi slot is exactly
+    # the frozen |1> bookkeeping state) starting from the NEXT
+    # instruction step; the seeping pulse itself still no-ops
+    # (documented simplification).  0 keeps leakage absorbing.
+    seep_per_pulse: float = 0.0
 
     def __post_init__(self):
         if self.kind not in DEVICE_KINDS:
@@ -187,26 +202,39 @@ class DeviceModel:
                 raise ValueError(f'coupling {cp!r} pairs a core with itself')
         if self.leak_readout_bit not in (0, 1):
             raise ValueError('leak_readout_bit must be 0 or 1')
-        leak = np.asarray(self.leak_per_pulse, np.float64)
-        if leak.ndim != 0:
+        for name in ('leak_per_pulse', 'leak2_per_pulse',
+                     'seep_per_pulse'):
+            v = np.asarray(getattr(self, name), np.float64)
+            if v.ndim != 0:
+                raise ValueError(
+                    f'{name} must be a scalar (per-core rates are not '
+                    f'supported yet)')
+            if not 0.0 <= float(v) <= 1.0:
+                raise ValueError(f'{name} must be in [0, 1]')
+        if np.asarray(self.seep_per_pulse, np.float64) > 0 and not (
+                np.asarray(self.leak_per_pulse, np.float64) > 0
+                or np.asarray(self.leak2_per_pulse, np.float64) > 0):
             raise ValueError(
-                'leak_per_pulse must be a scalar (per-core leak rates '
-                'are not supported yet)')
-        if not 0.0 <= float(leak) <= 1.0:
-            raise ValueError('leak_per_pulse must be in [0, 1]')
+                'seep_per_pulse needs a leakage channel (leak_per_pulse '
+                'or leak2_per_pulse > 0) — nothing can seep back')
 
     def statevec_static(self) -> tuple:
         """Hashable compile-time facts for the statevec step body:
         ``(couplings, has_detuning, has_decay, has_depol1, has_depol2,
-        has_leak, leak_readout_bit)`` — zero-rate channels are dropped
-        from the traced step entirely (changing a rate between zero and
-        nonzero recompiles; sweeping nonzero values does not, since the
-        rates themselves are traced arrays)."""
+        has_leak, leak_readout_bit, has_leak1, has_leak2, has_seep)`` —
+        zero-rate channels are dropped from the traced step entirely
+        (changing a rate between zero and nonzero recompiles; sweeping
+        nonzero values does not, since the rates themselves are traced
+        arrays).  ``has_leak`` is the any-leakage flag (freeze/readout
+        logic); ``has_leak1``/``has_leak2`` gate the 1q- and
+        coupling-induced exposure blocks separately."""
         def nz(v):
             return bool(np.any(np.asarray(v, np.float64) != 0.0))
         def finite(v):
             return bool(np.any(np.isfinite(np.asarray(v, np.float64))))
-        has_leak = nz(self.leak_per_pulse)
+        has_leak1 = nz(self.leak_per_pulse)
+        has_leak2 = nz(self.leak2_per_pulse)
+        has_leak = has_leak1 or has_leak2
         return (tuple(tuple(cp) for cp in self.couplings),
                 nz(self.detuning_hz),
                 finite(self.t1_s) or finite(self.t2_s),
@@ -214,7 +242,8 @@ class DeviceModel:
                 # leak_readout_bit is dead without leakage: pin it so a
                 # bit-only model change can't force a spurious recompile
                 has_leak,
-                int(self.leak_readout_bit) if has_leak else 1)
+                int(self.leak_readout_bit) if has_leak else 1,
+                has_leak1, has_leak2, nz(self.seep_per_pulse))
 
     def per_clock_rates(self, n_cores: int):
         """Per-core per-clock rate arrays ``(det_cyc, inv_t1, inv_t2)``:

@@ -157,6 +157,27 @@ class InterpreterConfig:
     # overhead — docs/PERF.md "the measured overhead budget"); kept as
     # an exact, tested knob for different devices/programs.
     steps_per_iter: int = 1
+    # pack every [B, C] int32/bool control-state carry (pc, time,
+    # offset, done, err, counters, ...) into ONE [K, B, C] array across
+    # the while_loop boundary (K-major — a trailing K would lane-pad
+    # ~14x, the measured fetch-merge failure mode).  Hypothesis under
+    # test (docs/PERF.md "the measured overhead budget"): fewer carried
+    # buffers -> fewer per-iteration store kernels -> lower per-step
+    # fixed cost.  Semantically exact (unpack/repack at the loop edge).
+    packed_ctrl: bool = False
+    # emitted straight-line execution (:func:`_exec_straightline`):
+    # False (default) = the generic fetch-dispatch engine; True =
+    # require straight-line (raises with the ineligibility reason
+    # otherwise); None = AUTO — use it whenever the program is
+    # eligible (:func:`straightline_ineligible`) and small enough to
+    # unroll (n_instr <= SL_AUTO_MAX_INSTR).  Not auto by default
+    # because the specialization trades COMPILE time for RUN time and
+    # keys the jit cache on program CONTENT — the generic engine shares
+    # one compiled executable across same-shape programs, which is the
+    # right default for compile-bound workloads (test suites, per-point
+    # program sweeps); run-heavy single-program workloads (the bench)
+    # opt in.
+    straightline: bool = False
     alu_instr_clks: int = 5
     jump_cond_clks: int = 5
     jump_fproc_clks: int = 8
@@ -240,6 +261,81 @@ def _sv_rot_zx(theta, phi):
     z = jnp.zeros_like(up)
     return jnp.concatenate(
         [jnp.concatenate([up, z], -1), jnp.concatenate([z, dn], -1)], -2)
+
+
+def _device_1q_pulse(st, cfg: InterpreterConfig, dev, fire, elem, pp,
+                     trig, oh_mslot, is_meas_pulse):
+    """Per-pulse parity/bloch device co-state evolution, SHARED by the
+    generic (:func:`_step`) and straight-line
+    (:func:`_exec_straightline`) engines so the physics cannot drift
+    between them.  Returns ``(updates, state_bit)``: the device-array
+    updates (parity: ``qturns``; bloch: ``bloch``/``phys_t``/
+    ``meas_p1``) and the sampled state bit per (shot, core)."""
+    mwr = (oh_mslot == 1) & is_meas_pulse[..., None]
+    if cfg.device == 'parity':
+        qturns = st['qturns']
+        if cfg.x90_amp > 0:
+            x90 = jnp.int32(cfg.x90_amp)
+            dq = (2 * pp[..., 3] + x90) // (2 * x90)
+            is_drive = fire & (elem == cfg.drive_elem)
+            qturns = qturns + jnp.where(is_drive, dq, 0)
+        state_bit = (qturns >> 1) & 1
+        return dict(qturns=qturns), state_bit
+    if dev is None:
+        raise ValueError(
+            "device='bloch' needs device-model parameter arrays; "
+            "run it via sim.physics.run_physics_batch (the "
+            "injected-bits simulate/simulate_batch path has no "
+            "device co-state to evolve)")
+    det_cyc, inv_t1, inv_t2, depol, meas_u = dev
+    r = st['bloch']
+    x, y, z = r[..., 0], r[..., 1], r[..., 2]
+    is_drive = fire & (elem == cfg.drive_elem)
+    touch = is_drive | is_meas_pulse
+    # free evolution over the gap since this lane's previous
+    # drive/readout pulse: detuning precession about z, T2 on
+    # the transverse components, T1 relaxation toward |0> (+z)
+    dt = (trig - st['phys_t']).astype(jnp.float32)
+    alpha = (2 * np.pi) * det_cyc[None, :] * dt
+    ca, sa = jnp.cos(alpha), jnp.sin(alpha)
+    e2 = jnp.exp(-dt * inv_t2[None, :])
+    e1 = jnp.exp(-dt * inv_t1[None, :])
+    xf = e2 * (x * ca - y * sa)
+    yf = e2 * (x * sa + y * ca)
+    zf = 1.0 + (z - 1.0) * e1
+    # drive rotation: Rodrigues about the equatorial axis
+    # n = (cos phi, sin phi, 0) by theta = (pi/2) * amp / x90
+    # (U = exp(-i theta/2 n.sigma), right-handed on the Bloch
+    # sphere — the models/rb.py X90 at phi = 0); then the
+    # per-pulse depolarizing contraction
+    phi = (2 * np.pi / (1 << PHASE_BITS)) \
+        * pp[..., 1].astype(jnp.float32)
+    theta = ((np.pi / 2) / cfg.x90_amp if cfg.x90_amp > 0 else 0.0) \
+        * pp[..., 3].astype(jnp.float32)
+    nx, ny = jnp.cos(phi), jnp.sin(phi)
+    cth, sth = jnp.cos(theta), jnp.sin(theta)
+    ndot = nx * xf + ny * yf
+    k1 = 1.0 - cth
+    keep = jnp.float32(1.0) - depol
+    rx = keep * (xf * cth + ny * zf * sth + nx * ndot * k1)
+    ry = keep * (yf * cth - nx * zf * sth + ny * ndot * k1)
+    rz = keep * (zf * cth + (nx * yf - ny * xf) * sth)
+    # projective measurement: sample the evolved (pre-readout)
+    # state with this slot's pre-drawn uniform, collapse to the
+    # outcome pole; record P(1) for expectation-value readout
+    p1 = jnp.clip((1.0 - zf) * 0.5, 0.0, 1.0)
+    u_sel = jnp.sum(meas_u * oh_mslot.astype(jnp.float32), axis=-1)
+    state_bit = (u_sel < p1).astype(jnp.int32) \
+        * is_meas_pulse.astype(jnp.int32)
+    zc = 1.0 - 2.0 * state_bit.astype(jnp.float32)
+    x1 = jnp.where(is_meas_pulse, 0.0, jnp.where(is_drive, rx, x))
+    y1 = jnp.where(is_meas_pulse, 0.0, jnp.where(is_drive, ry, y))
+    z1 = jnp.where(is_meas_pulse, zc, jnp.where(is_drive, rz, z))
+    return dict(
+        bloch=jnp.stack([x1, y1, z1], axis=-1),
+        phys_t=jnp.where(touch, trig, st['phys_t']),
+        meas_p1=jnp.where(mwr, p1[..., None], st['meas_p1']),
+    ), state_bit
 
 
 def _alu_vec(op, in0, in1):
@@ -712,80 +808,20 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             cw_meas_err = jnp.where(is_meas_pulse & (env_len == 0xfff),
                                     ERR_CW_MEAS, 0)
         mwr = (oh_mslot == 1) & is_meas_pulse[..., None]
-        if cfg.device == 'parity':
-            qturns = st['qturns']
-            if cfg.x90_amp > 0:
-                x90 = jnp.int32(cfg.x90_amp)
-                dq = (2 * pp[..., 3] + x90) // (2 * x90)
-                is_drive = fire & (elem == cfg.drive_elem)
-                qturns = qturns + jnp.where(is_drive, dq, 0)
-            state_bit = (qturns >> 1) & 1
-            phys_updates = dict(qturns=qturns)
-        elif cfg.device == 'bloch':
-            if dev is None:
-                raise ValueError(
-                    "device='bloch' needs device-model parameter arrays; "
-                    "run it via sim.physics.run_physics_batch (the "
-                    "injected-bits simulate/simulate_batch path has no "
-                    "device co-state to evolve)")
-            det_cyc, inv_t1, inv_t2, depol, meas_u = dev
-            r = st['bloch']
-            x, y, z = r[..., 0], r[..., 1], r[..., 2]
-            is_drive = fire & (elem == cfg.drive_elem)
-            touch = is_drive | is_meas_pulse
-            # free evolution over the gap since this lane's previous
-            # drive/readout pulse: detuning precession about z, T2 on
-            # the transverse components, T1 relaxation toward |0> (+z)
-            dt = (trig - st['phys_t']).astype(jnp.float32)
-            alpha = (2 * np.pi) * det_cyc[None, :] * dt
-            ca, sa = jnp.cos(alpha), jnp.sin(alpha)
-            e2 = jnp.exp(-dt * inv_t2[None, :])
-            e1 = jnp.exp(-dt * inv_t1[None, :])
-            xf = e2 * (x * ca - y * sa)
-            yf = e2 * (x * sa + y * ca)
-            zf = 1.0 + (z - 1.0) * e1
-            # drive rotation: Rodrigues about the equatorial axis
-            # n = (cos phi, sin phi, 0) by theta = (pi/2) * amp / x90
-            # (U = exp(-i theta/2 n.sigma), right-handed on the Bloch
-            # sphere — the models/rb.py X90 at phi = 0); then the
-            # per-pulse depolarizing contraction
-            phi = (2 * np.pi / (1 << PHASE_BITS)) \
-                * pp[..., 1].astype(jnp.float32)
-            theta = ((np.pi / 2) / cfg.x90_amp if cfg.x90_amp > 0 else 0.0) \
-                * pp[..., 3].astype(jnp.float32)
-            nx, ny = jnp.cos(phi), jnp.sin(phi)
-            cth, sth = jnp.cos(theta), jnp.sin(theta)
-            ndot = nx * xf + ny * yf
-            k1 = 1.0 - cth
-            keep = jnp.float32(1.0) - depol
-            rx = keep * (xf * cth + ny * zf * sth + nx * ndot * k1)
-            ry = keep * (yf * cth - nx * zf * sth + ny * ndot * k1)
-            rz = keep * (zf * cth + (nx * yf - ny * xf) * sth)
-            # projective measurement: sample the evolved (pre-readout)
-            # state with this slot's pre-drawn uniform, collapse to the
-            # outcome pole; record P(1) for expectation-value readout
-            p1 = jnp.clip((1.0 - zf) * 0.5, 0.0, 1.0)
-            u_sel = jnp.sum(meas_u * oh_mslot.astype(jnp.float32), axis=-1)
-            state_bit = (u_sel < p1).astype(jnp.int32) \
-                * is_meas_pulse.astype(jnp.int32)
-            zc = 1.0 - 2.0 * state_bit.astype(jnp.float32)
-            x1 = jnp.where(is_meas_pulse, 0.0, jnp.where(is_drive, rx, x))
-            y1 = jnp.where(is_meas_pulse, 0.0, jnp.where(is_drive, ry, y))
-            z1 = jnp.where(is_meas_pulse, zc, jnp.where(is_drive, rz, z))
-            phys_updates = dict(
-                bloch=jnp.stack([x1, y1, z1], axis=-1),
-                phys_t=jnp.where(touch, trig, st['phys_t']),
-                meas_p1=jnp.where(mwr, p1[..., None], st['meas_p1']),
-            )
+        if cfg.device in ('parity', 'bloch'):
+            phys_updates, state_bit = _device_1q_pulse(
+                st, cfg, dev, fire, elem, pp, trig, oh_mslot,
+                is_meas_pulse)
         else:  # 'statevec' — entangling full-state trajectory model
             if dev is None:
                 raise ValueError(
                     "device='statevec' needs device-model parameters; "
                     "run it via sim.physics.run_physics_batch")
             (det_cyc, inv_t1, inv_t2, depol1, depol2, zx90, zz90, leak,
-             meas_u, traj_key) = dev['params']
+             leak2, seep, meas_u, traj_key) = dev['params']
             (couplings, has_det, has_decay, has_dp1, has_dp2,
-             has_leak, leak_bit, leak_iq) = dev['static']
+             has_leak, leak_bit, has_leak1, has_leak2, has_seep,
+             leak_iq) = dev['static']
             leaked = st['leaked']                             # [B, C]
             psi = st['psi']                                   # [B, 2^C] c64
             zsign = jnp.asarray(_sv_zsign(C))                 # [C, D]
@@ -869,12 +905,16 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                            (trig - st['phys_t']).astype(jnp.float32), 0.0)
             if has_decay or has_dp1 or has_dp2 or has_leak:
                 # per-step trajectory uniforms, deterministic per
-                # (shot, core, step) given the run key.  The leak
-                # column only exists when leakage is on, so non-leak
-                # models keep their exact draw streams (and results)
+                # (shot, core, step) given the run key.  Column 6 (the
+                # leak-jump draw — shared by the 1q and coupling
+                # exposures, which are mutually exclusive per core per
+                # step) and column 7 (seepage) only exist when their
+                # channels are on, so existing models keep their exact
+                # draw streams (and results)
                 traj_u = jax.random.uniform(
                     jax.random.fold_in(traj_key, step_i),
-                    (B, C, 7 if has_leak else 6), jnp.float32)
+                    (B, C, 6 + (1 if has_leak else 0)
+                     + (1 if has_seep else 0)), jnp.float32)
             # (1) free evolution: detuning precession, one exact
             # diagonal Rz over all touched cores (a [B,C]x[C,D] matmul)
             if has_det:
@@ -943,7 +983,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                         pauli1)
                     U = jnp.einsum('bxy,byu->bxu', N, U)
                 psi = _sv_apply_1q(psi, U, c, C)
-                if has_leak:
+                if has_leak1:
                     # leakage channel after the rotation, the full CPTP
                     # unraveling of L = sqrt(p)|2><1| (excited
                     # population drives the 1->2 transition): with
@@ -1000,6 +1040,26 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                         jax.nn.one_hot(sel, 16, dtype=jnp.complex64),
                         pauli2)
                     psi = _sv_apply_pair(psi, P4, cc, tt, C)
+                if has_leak2:
+                    # coupling-induced leakage of the CONTROL (the
+                    # strongly-driven core — the dominant 2q-gate
+                    # mechanism on hardware): same CPTP unraveling as
+                    # the 1q channel, drawing the shared leak column
+                    # (1q and coupling exposures are exclusive per core
+                    # per step — one instruction each)
+                    p_eff = jnp.where(mk, leak2, 0.0)
+                    p1c = jnp.sum(bit1[cc][None]
+                                  * (psi.real**2 + psi.imag**2), -1)
+                    occ = traj_u[:, cc, 6] < p_eff * p1c
+                    proj = psi * (bit1[cc][None, :]
+                                  / jnp.sqrt(jnp.maximum(p1c,
+                                                         1e-12))[:, None])
+                    damp = 1.0 - (1.0 - jnp.sqrt(1.0 - p_eff))[:, None] \
+                        * bit1[cc][None, :]
+                    nrm = jnp.sqrt(jnp.maximum(1.0 - p_eff * p1c, 1e-12))
+                    psi_nj = psi * (damp / nrm[:, None])
+                    psi = jnp.where(occ[:, None], proj, psi_nj)
+                    leaked = leaked.at[:, cc].set(leaked[:, cc] | occ)
             # (5) measurement: joint projective collapse, sequential
             # conditioning across cores (exact joint distribution for
             # the commuting Z measurements of a step)
@@ -1037,6 +1097,15 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                 bit_cols.append(bitc)
             p1 = jnp.stack(p1_cols, axis=-1)                  # [B, C]
             state_bit = jnp.stack(bit_cols, axis=-1)
+            if has_seep:
+                # seepage |2> -> |1>: a drive pulse on a PRE-STEP-leaked
+                # core un-leaks it with probability `seep` — it re-enters
+                # in |1> (its psi slot is exactly the frozen |1>
+                # bookkeeping state) from the next step; the seeping
+                # pulse itself still no-ops (sim/device.py docstring)
+                seep_occ = is_drive & st['leaked'] \
+                    & (traj_u[..., 7] < seep)
+                leaked = leaked & ~seep_occ
             phys_updates = dict(
                 psi=psi, leaked=leaked,
                 phys_t=jnp.where(touch, trig, st['phys_t']),
@@ -1166,7 +1235,36 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
     (``(det_cyc[C], inv_t1[C], inv_t2[C], depol, meas_u[B,C,M])``) —
     step-body closure constants, not loop-carried.
     """
-    def cond(st):
+    # packed-control carry (cfg.packed_ctrl): every [B, C] int32/bool
+    # leaf rides the loop as one [K, B, C] stack — K-major so no lane
+    # padding — unpacked at the body edge (slices fuse into consumers)
+    B_, C_ = st0['pc'].shape
+    pack_keys = tuple(sorted(
+        k for k, v in st0.items()
+        if getattr(v, 'ndim', None) == 2 and v.shape == (B_, C_)
+        and v.dtype in (jnp.dtype('int32'), jnp.dtype('bool')))) \
+        if cfg.packed_ctrl else ()
+    bool_keys = frozenset(k for k in pack_keys
+                          if st0[k].dtype == jnp.dtype('bool'))
+
+    def pack(st):
+        if not pack_keys:
+            return st
+        ctrl = jnp.stack([st[k].astype(jnp.int32) for k in pack_keys], 0)
+        rest = {k: v for k, v in st.items() if k not in pack_keys}
+        return dict(rest, _ctrl=ctrl)
+
+    def unpack(st):
+        if not pack_keys:
+            return st
+        st = dict(st)
+        ctrl = st.pop('_ctrl')
+        for idx, k in enumerate(pack_keys):
+            st[k] = ctrl[idx].astype(bool) if k in bool_keys else ctrl[idx]
+        return st
+
+    def cond(carry):
+        st = unpack(carry)
         settled = jnp.all(st['done'], axis=-1)
         if cfg.physics:
             settled = settled | st['paused']
@@ -1211,14 +1309,348 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
             st2['_steps'] = steps + 1
         return st2
 
-    def body(st):
+    def body(carry):
         # static unroll: k sub-steps per while iteration (see
         # InterpreterConfig.steps_per_iter)
+        st = unpack(carry)
         for _ in range(max(1, cfg.steps_per_iter)):
             st = one(st)
-        return st
+        return pack(st)
 
-    return jax.lax.while_loop(cond, body, st0)
+    return unpack(jax.lax.while_loop(cond, body, pack(st0)))
+
+
+# AUTO straight-line cap: unrolling emits O(n_instr) specialized step
+# bodies into one XLA module — past this, compile time outgrows the
+# run-time win (depth-100 RB stays on the generic engine)
+SL_AUTO_MAX_INSTR = 256
+
+
+def _soa_static(mp) -> tuple:
+    """The decoded program as a hashable jit-static value: the
+    straight-line executor specializes per instruction at trace time,
+    so the program must be a compile-time constant (bytes hash the
+    content, so identical programs share the jit cache entry)."""
+    arr = np.stack([np.asarray(getattr(mp.soa, f)) for f in _FIELDS],
+                   axis=-1).astype(np.int32)
+    return (arr.tobytes(), arr.shape)
+
+
+def _soa_from_static(sl: tuple) -> np.ndarray:
+    buf, shape = sl
+    return np.frombuffer(buf, np.int32).reshape(shape)
+
+
+def use_straightline(mp, cfg: InterpreterConfig) -> bool:
+    """Resolve the tri-state ``cfg.straightline`` against ``mp``."""
+    if cfg.straightline is False:
+        return False
+    reason = straightline_ineligible(mp, cfg)
+    if cfg.straightline is True:
+        if reason:
+            raise ValueError(f'straightline=True but the program is '
+                             f'ineligible: {reason}')
+        return True
+    return reason is None and mp.n_instr <= SL_AUTO_MAX_INSTR
+
+
+def straightline_ineligible(mp, cfg: InterpreterConfig) -> str:
+    """Why ``(mp, cfg)`` cannot run on the emitted straight-line
+    executor (:func:`_exec_straightline`) — ``None`` when it can.
+
+    Eligible programs are forward-jump-only (no loops), SYNC-free,
+    DONE-terminated, with fproc reads only of the core's own sticky
+    channel — the compiled active-reset + RB shape.  Everything else
+    (loops, LUT/fresh fabrics, cross-core feedback, the statevec event
+    gate, trace mode) runs on the generic fetch-dispatch engine.
+    """
+    kind = np.asarray(mp.soa.kind)
+    C, N = kind.shape
+    if cfg.trace:
+        return 'trace mode records per-step state'
+    if cfg.physics and cfg.device == 'statevec':
+        return 'statevec device (event-ordering gate needs the ' \
+               'generic engine)'
+    if np.any(kind == isa.K_SYNC):
+        return 'SYNC barrier'
+    idx = np.arange(N)[None, :]
+    jmask = (kind == isa.K_JUMP_I) | (kind == isa.K_JUMP_COND) \
+        | (kind == isa.K_JUMP_FPROC)
+    if np.any(jmask & (np.asarray(mp.soa.jump_addr) <= idx)):
+        return 'backward jump (loop)'
+    fmask = (kind == isa.K_ALU_FPROC) | (kind == isa.K_JUMP_FPROC)
+    if np.any(fmask):
+        if cfg.fabric != 'sticky':
+            return f'fabric {cfg.fabric!r} with fproc reads'
+        if np.any(fmask
+                  & (np.asarray(mp.soa.func_id)
+                     != np.arange(C)[:, None])):
+            return 'cross-core fproc read'
+    if np.any(kind[:, -1] != isa.K_DONE):
+        return 'program not DONE-terminated'
+    return None
+
+
+def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
+                       meas_valid, cfg: InterpreterConfig,
+                       dev=None) -> dict:
+    """One emitted pass over a forward-jump-only program (round-5 exec
+    lever (b), docs/PERF.md "the measured overhead budget").
+
+    The program is unrolled at TRACE time: per instruction index the
+    step body is specialized against the instruction's static fields
+    (numpy constants), so the generic engine's per-step program fetch
+    (one-hot/gather over N), opcode dispatch (select chains over every
+    kind), and while-loop carry round-trips through HBM all vanish
+    from the compiled module.  Kinds absent at an index emit NOTHING —
+    an RB-body pulse instruction compiles to just the pulse block.
+
+    Control flow: each lane carries ``pc`` = next instruction index;
+    a lane executes index ``i`` iff ``pc == i`` (forward jumps skip by
+    setting ``pc`` past the skipped range — every index is visited at
+    most once, so one pass retires every lane).  A physics-mode fproc
+    read whose own bit is still invalid stalls the lane for this pass
+    (``phys_wait``): the epoch resolver validates the bit and the next
+    pass resumes from the same index.  Timing, error-bit, record, and
+    device-co-state semantics match :func:`_step` exactly — pinned by
+    tests/test_straightline.py engine-equality on shared programs.
+    """
+    B, C = st0['pc'].shape
+    N = soa_np.shape[1]
+    st = dict(st0)
+    stalled = jnp.zeros((B, C), bool)
+    pmask_np = _PMASKS
+
+    for i in range(N):
+        f = {name: np.asarray(soa_np[:, i, _F[name]])
+             for name in _FIELDS}
+        kind = f['kind']
+        m_pw, m_pt = kind == isa.K_PULSE_WRITE, kind == isa.K_PULSE_TRIG
+        m_rst, m_idle = kind == isa.K_PULSE_RESET, kind == isa.K_IDLE
+        m_regalu, m_incq = kind == isa.K_REG_ALU, kind == isa.K_INC_QCLK
+        m_jmpi, m_jcond = kind == isa.K_JUMP_I, kind == isa.K_JUMP_COND
+        m_jfp, m_afp = kind == isa.K_JUMP_FPROC, kind == isa.K_ALU_FPROC
+        m_done = kind == isa.K_DONE
+        m_fproc = m_jfp | m_afp
+        m_alu = m_regalu | m_incq | m_jcond | m_jfp | m_afp
+        has = lambda m: bool(np.any(m))
+        j = lambda a: jnp.asarray(np.asarray(a))[None]       # [1, C]
+
+        active = (st['pc'] == i) & ~st['done'] & ~stalled
+        time, offset, regs = st['time'], st['offset'], st['regs']
+        err_i = jnp.zeros((B, C), jnp.int32)
+
+        def reg_read_static(addr_c):
+            oh = (np.asarray(addr_c)[:, None]
+                  == np.arange(isa.N_REGS)[None, :]).astype(np.int32)
+            return jnp.sum(regs * jnp.asarray(oh)[None], axis=-1)
+
+        # ---- fproc: own-core sticky read (eligibility guarantees) ---
+        if has(m_fproc):
+            req = time
+            mavail, bitsq = st['meas_avail'], meas_bits
+            m_cnt = jnp.sum((mavail <= req[..., None]).astype(jnp.int32),
+                            -1)
+            oh_latest = _onehot(jnp.maximum(m_cnt - 1, 0), cfg.max_meas)
+            latest_valid = (m_cnt == 0) | (_ohsel(
+                meas_valid.astype(jnp.int32), oh_latest) == 1)
+            f_data = jnp.where(m_cnt > 0, _ohsel(bitsq, oh_latest), 0)
+            f_race = jnp.any(
+                (mavail > (req - STICKY_RACE_MARGIN)[..., None])
+                & (mavail <= (req + STICKY_RACE_MARGIN)[..., None]), -1)
+            f_ready = latest_valid
+            stall_i = active & j(m_fproc) & ~f_ready
+            stalled = stalled | stall_i
+            active = active & ~stall_i
+        else:
+            f_data = jnp.int32(0)
+
+        # ---- ALU -----------------------------------------------------
+        if has(m_alu):
+            in0 = jnp.where(j(f['in0_is_reg'] == 1),
+                            reg_read_static(f['in0_reg']), j(f['imm'])) \
+                if np.any(f['in0_is_reg'][m_alu]) else j(f['imm'])
+            in1 = jnp.int32(0)
+            if np.any(m_regalu | m_jcond):
+                in1 = reg_read_static(f['in1_reg'])
+            if has(m_incq):
+                in1 = jnp.where(j(m_incq), time - offset, in1)
+            if has(m_fproc):
+                in1 = jnp.where(j(m_fproc), f_data, in1)
+            alu_res = _alu_vec(j(f['alu_op']), in0, in1)
+            if np.any(m_regalu | m_afp):
+                wr = active & j(m_regalu | m_afp)
+                wr_oh = (np.asarray(f['out_reg'])[:, None]
+                         == np.arange(isa.N_REGS)[None, :])
+                regs = jnp.where(wr[..., None] & jnp.asarray(wr_oh)[None],
+                                 alu_res[..., None], regs)
+                st['regs'] = regs
+        else:
+            alu_res = jnp.int32(0)
+
+        # ---- pulse latch + trigger ----------------------------------
+        pp = st['pp']
+        if has(m_pw | m_pt):
+            is_pulse = active & j(m_pw | m_pt)
+            imm_vals = np.stack([f['p_env'], f['p_phase'], f['p_freq'],
+                                 f['p_amp'], f['p_cfg']], -1)   # [C, 5]
+            wen = ((f['p_wen'][:, None] >> np.arange(5)) & 1) == 1
+            if np.any(f['p_regsel']):
+                rsel = ((f['p_regsel'][:, None] >> np.arange(5)) & 1)
+                regval = reg_read_static(f['p_reg'])
+                cand = jnp.where(jnp.asarray(rsel == 1)[None],
+                                 regval[..., None],
+                                 jnp.asarray(imm_vals)[None]) \
+                    & jnp.asarray(pmask_np)
+            else:
+                cand = jnp.asarray((imm_vals & pmask_np))[None]
+            pp = jnp.where(is_pulse[..., None] & jnp.asarray(wen)[None],
+                           cand, pp)
+            st['pp'] = pp
+
+        trig = offset + j(f['cmd_time'])
+        if has(m_pt):
+            fire = active & j(m_pt)
+            err_i = err_i | jnp.where(fire & (trig < time),
+                                      ERR_MISSED_TRIG, 0)
+            trig = jnp.maximum(trig, time)
+            elem = pp[..., 4] & 0b11
+            oh_elem = _onehot(jnp.minimum(elem, spc.shape[1] - 1),
+                              spc.shape[1])
+            spc_e = _ohsel(spc[None], oh_elem)
+            interp_e = _ohsel(interp[None], oh_elem)
+            env_len = (pp[..., 0] >> 12) & 0xfff
+            nsamp = env_len * 4 * interp_e
+            dur = jnp.where(env_len == 0xfff, 0,
+                            (nsamp + spc_e - 1) // spc_e)
+            err_i = err_i | jnp.where(
+                fire & (st['n_pulses'] >= cfg.max_pulses),
+                ERR_PULSE_OVERFLOW, 0)
+            if cfg.record_pulses:
+                rec_vals = jnp.stack(
+                    [j(f['cmd_time']) * jnp.ones_like(trig), trig,
+                     pp[..., 0], pp[..., 1], pp[..., 2], pp[..., 3],
+                     pp[..., 4], elem, dur], axis=-1)
+                oh_pslot = _onehot(
+                    jnp.minimum(st['n_pulses'], cfg.max_pulses - 1),
+                    cfg.max_pulses)
+                pwrite = (oh_pslot == 1) \
+                    & (fire & (st['n_pulses'] < cfg.max_pulses))[..., None]
+                FR, P = len(_REC_FIELDS), cfg.max_pulses
+                st['rec'] = jnp.where(
+                    pwrite[:, :, None, :], rec_vals[:, :, :, None],
+                    st['rec'].reshape(B, C, FR, P)).reshape(B, C, FR * P)
+            st['n_pulses'] = st['n_pulses'] + fire.astype(jnp.int32)
+
+            is_meas_pulse = fire & (elem == cfg.meas_elem)
+            err_i = err_i | jnp.where(
+                is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
+                ERR_MEAS_OVERFLOW, 0)
+            oh_mslot = _onehot(jnp.minimum(st['n_meas'],
+                                           cfg.max_meas - 1), cfg.max_meas)
+            meas_avail = jnp.where(
+                (oh_mslot == 1) & is_meas_pulse[..., None],
+                (trig + dur + cfg.meas_latency)[..., None],
+                st['meas_avail'])
+            cw_clks = 0
+            if cfg.physics and cfg.cw_horizon > 0:
+                cw_clks = (cfg.cw_horizon + spc_e - 1) // spc_e
+                meas_avail = jnp.where(
+                    (oh_mslot == 1) & (is_meas_pulse
+                                       & (env_len == 0xfff))[..., None],
+                    (trig + cw_clks + cfg.meas_latency)[..., None],
+                    meas_avail)
+            elif cfg.physics:
+                err_i = err_i | jnp.where(
+                    is_meas_pulse & (env_len == 0xfff), ERR_CW_MEAS, 0)
+            st['meas_avail'] = meas_avail
+            st['n_meas'] = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
+
+            # ---- physics co-state (parity / bloch; statevec is
+            # ineligible for this executor) — the SAME helper the
+            # generic engine uses, so the physics cannot drift --------
+            if cfg.physics:
+                mwr = (oh_mslot == 1) & is_meas_pulse[..., None]
+                dev_updates, state_bit = _device_1q_pulse(
+                    st, cfg, dev, fire, elem, pp, trig, oh_mslot,
+                    is_meas_pulse)
+                st.update(dev_updates)
+                st['meas_state'] = jnp.where(mwr, state_bit[..., None],
+                                             st['meas_state'])
+                st['meas_amp'] = jnp.where(mwr, pp[..., 3:4],
+                                           st['meas_amp'])
+                st['meas_phase'] = jnp.where(mwr, pp[..., 1:2],
+                                             st['meas_phase'])
+                st['meas_freq'] = jnp.where(mwr, pp[..., 2:3],
+                                            st['meas_freq'])
+                st['meas_env'] = jnp.where(mwr, pp[..., 0:1],
+                                           st['meas_env'])
+                st['meas_gtime'] = jnp.where(mwr, trig[..., None],
+                                             st['meas_gtime'])
+
+        # ---- phase reset / idle -------------------------------------
+        if has(m_rst):
+            is_rst = active & j(m_rst)
+            oh_rslot = _onehot(jnp.minimum(st['n_resets'],
+                                           cfg.max_resets - 1),
+                               cfg.max_resets)
+            st['rst_time'] = jnp.where((oh_rslot == 1) & is_rst[..., None],
+                                       time[..., None], st['rst_time'])
+            st['n_resets'] = st['n_resets'] + is_rst.astype(jnp.int32)
+        if has(m_idle):
+            is_idle = active & j(m_idle)
+            idle_end = offset + j(f['cmd_time'])
+            err_i = err_i | jnp.where(is_idle & (time > idle_end),
+                                      ERR_MISSED_TRIG, 0)
+            idle_end = jnp.maximum(idle_end, time)
+
+        # ---- race flag on the proceeding read -----------------------
+        if has(m_fproc):
+            err_i = err_i | jnp.where(active & j(m_fproc) & f_race,
+                                      ERR_STICKY_RACE, 0)
+
+        # ---- next pc / time / offset / done -------------------------
+        pc_next = jnp.int32(i + 1)
+        if has(m_jmpi | m_jcond | m_jfp):
+            branch = (alu_res & 1) == 1
+            pc_next = jnp.where(j(m_jmpi), j(f['jump_addr']), pc_next)
+            pc_next = jnp.where(j(m_jcond | m_jfp)
+                                & branch, j(f['jump_addr']), pc_next)
+        st['pc'] = jnp.where(active & ~j(m_done), pc_next, st['pc'])
+        time_next = time
+        if has(m_pt):
+            time_next = jnp.where(j(m_pt), trig + cfg.pulse_load_clks,
+                                  time_next)
+        if has(m_pw | m_rst):
+            time_next = jnp.where(j(m_pw | m_rst),
+                                  time + cfg.pulse_regwrite_clks,
+                                  time_next)
+        if has(m_idle):
+            time_next = jnp.where(j(m_idle),
+                                  idle_end + cfg.pulse_load_clks,
+                                  time_next)
+        if has(m_regalu | m_incq):
+            time_next = jnp.where(j(m_regalu | m_incq),
+                                  time + cfg.alu_instr_clks, time_next)
+        if has(m_jmpi | m_jcond):
+            time_next = jnp.where(j(m_jmpi | m_jcond),
+                                  time + cfg.jump_cond_clks, time_next)
+        if has(m_fproc):
+            time_next = jnp.where(j(m_fproc),
+                                  time + cfg.jump_fproc_clks, time_next)
+        st['time'] = jnp.where(active, time_next, time)
+        if has(m_incq):
+            st['offset'] = jnp.where(active & j(m_incq), time - alu_res,
+                                     offset)
+        st['err'] = st['err'] | jnp.where(active, err_i, 0)
+        st['done'] = st['done'] | (active & j(m_done))
+
+    # every non-stalled lane retired at its DONE (forward-only, DONE-
+    # terminated by eligibility)
+    if cfg.physics:
+        st['phys_wait'] = stalled
+    st['_steps'] = st['_steps'] + N
+    return st
 
 
 def _finalize(st: dict, cfg: InterpreterConfig) -> dict:
@@ -1280,6 +1712,22 @@ def _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg, n_cores,
                       init_regs, traits)
 
 
+@functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'sl'))
+def _run_batch_sl_jit(spc, interp, meas_bits, cfg, n_cores, init_regs,
+                      sl=None):
+    """Injected-bits batch on the straight-line executor (one pass —
+    with every bit valid a lane can never stall)."""
+    _check_fabric(cfg, n_cores)
+    B = meas_bits.shape[0]
+    st0 = _init_state(B, n_cores, cfg, init_regs)
+    st0['_steps'] = jnp.int32(0)
+    meas_valid = jnp.ones(meas_bits.shape, bool)
+    st = _exec_straightline(st0, _soa_from_static(sl), spc, interp,
+                            meas_bits, meas_valid, cfg)
+    st.pop('phys_wait', None)
+    return _finalize(st, cfg)
+
+
 def _pad_meas(meas_bits, max_meas: int):
     meas_bits = jnp.asarray(meas_bits, jnp.int32)
     if meas_bits.shape[-1] > max_meas:
@@ -1311,6 +1759,12 @@ def simulate(mp, meas_bits=None, init_regs=None,
     if init_regs is None:
         init_regs = jnp.zeros((mp.n_cores, isa.N_REGS), jnp.int32)
     init_regs = jnp.asarray(init_regs, jnp.int32)
+    if use_straightline(mp, cfg):
+        out = _run_batch_sl_jit(spc, interp, meas_bits[None], cfg,
+                                mp.n_cores, init_regs[None],
+                                sl=_soa_static(mp))
+        return {k: (v if k in ('steps', 'incomplete') else v[0])
+                for k, v in out.items()}
     return _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, mp.n_cores,
                     init_regs, program_traits(mp))
 
@@ -1330,5 +1784,8 @@ def simulate_batch(mp, meas_bits, init_regs=None,
         init_regs = jnp.broadcast_to(
             init_regs[None],
             (meas_bits.shape[0],) + tuple(init_regs.shape))
+    if use_straightline(mp, cfg):
+        return _run_batch_sl_jit(spc, interp, meas_bits, cfg, mp.n_cores,
+                                 init_regs, sl=_soa_static(mp))
     return _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
                           mp.n_cores, init_regs, program_traits(mp))

@@ -65,7 +65,13 @@ from ..ops.waveform import (PHASE_BITS, AMP_SCALE, complex_to_iq,
 from .device import DeviceModel, STATEVEC_MAX_CORES
 from .interpreter import (InterpreterConfig, _program_constants, _init_state,
                           _exec_loop, _finalize, _check_fabric,
-                          program_traits)
+                          program_traits, use_straightline, _soa_static)
+
+
+def _sl_static(mp, cfg: InterpreterConfig):
+    """Static straight-line program for the physics epoch loop, or
+    ``None`` to use the generic engine (interpreter.use_straightline)."""
+    return _soa_static(mp) if use_straightline(mp, cfg) else None
 
 # default-qchip X90 amplitude word: round(0.48 * (2^16 - 1))
 X90_AMP_DEFAULT = 31457
@@ -838,7 +844,8 @@ _build_tables_jit = functools.partial(
                                              'ring', 'traits',
                                              'native_rng', 'rows',
                                              'dev_static', 'cw',
-                                             'colored', 'classify3'))
+                                             'colored', 'classify3',
+                                             'sl'))
 def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      tabs, freq_stack, g0, g1, sigma, inv_ring,
                      key, dev_params, meas_u,
@@ -850,7 +857,8 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      native_rng: bool = None, rows: tuple = None,
                      traj_key=None, dev_static: tuple = None,
                      cw: int = 0, colored: bool = False,
-                     rho=None, g2=None, classify3: bool = False) -> dict:
+                     rho=None, g2=None, classify3: bool = False,
+                     sl: tuple = None) -> dict:
     B = init_states.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
@@ -907,8 +915,12 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
         # burn further full-batch passes on it) OR fired windows remain
         # unresolved (the slot-compacted resolver handles one slot per
         # lane per epoch; trailing unread measurements still must end up
-        # in meas_bits), within the epoch bound either way
-        can_exec = (~jnp.all(st['done'])) & (st['_steps'] < cfg.max_steps)
+        # in meas_bits), within the epoch bound either way.  The
+        # straight-line executor terminates structurally (forward-only,
+        # one visit per instruction) so only the epoch bound applies.
+        budget_ok = True if sl is not None \
+            else (st['_steps'] < cfg.max_steps)
+        can_exec = (~jnp.all(st['done'])) & budget_ok
         fired = jnp.arange(cfg.max_meas)[None, None, :] \
             < st['n_meas'][..., None]
         unresolved = jnp.any(fired & ~valid)
@@ -916,8 +928,14 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
 
     def body(carry):
         st, bits, valid, cls, ep = carry
-        st = _exec_loop(st, soa, spc, interp, sync_part, bits, valid, cfg,
-                        dev, traits)
+        if sl is not None:
+            from .interpreter import _exec_straightline, _soa_from_static
+            st = _exec_straightline(st, _soa_from_static(sl), spc, interp,
+                                    bits, valid, cfg, dev)
+            st['paused'] = jnp.any(st['phys_wait'] & ~st['done'], -1)
+        else:
+            st = _exec_loop(st, soa, spc, interp, sync_part, bits, valid,
+                            cfg, dev, traits)
         if mode == 'analytic':
             bits, valid, cls = _resolve_analytic(
                 st, bits, valid, key, tables, env_pads, response, W, cw,
@@ -1185,7 +1203,9 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
                 jnp.float32(model.device.depol2_per_pulse),
                 jnp.float32(model.device.zx90_amp),
                 jnp.float32(model.device.zz90_amp),
-                jnp.float32(model.device.leak_per_pulse))
+                jnp.float32(model.device.leak_per_pulse),
+                jnp.float32(model.device.leak2_per_pulse),
+                jnp.float32(model.device.seep_per_pulse))
             if model.device.couplings and not explicit_steps:
                 # the event-ordering gate's serialization can exhaust a
                 # generic budget and flag shots incomplete (advisor
@@ -1265,4 +1285,5 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         int(model.cw_horizon), model.noise_ar1 > 0,
         jnp.float32(model.noise_ar1),
         g2=as_iq(model.g2) if model.g2 is not None else None,
-        classify3=bool(model.classify3))
+        classify3=bool(model.classify3),
+        sl=_sl_static(mp, cfg))

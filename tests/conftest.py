@@ -36,6 +36,21 @@ import pytest
 REFERENCE_ROOT = os.environ.get('DPROC_REFERENCE_ROOT', '/root/reference')
 
 
+@pytest.fixture(autouse=True, scope='module')
+def _clear_jax_caches_between_modules():
+    """Free compiled executables between test FILES.
+
+    The full suite compiles hundreds of XLA modules into one process;
+    past ~4-500 of them XLA's CPU compile has been observed segfaulting
+    non-deterministically on whichever large module comes late (the
+    same modules compile cleanly in a fresh process).  Dropping the
+    executable caches at file boundaries keeps the per-process compiler
+    footprint bounded; within-file sharing (where almost all reuse
+    lives) is untouched."""
+    yield
+    jax.clear_caches()
+
+
 def pytest_collection_modifyitems(config, items):
     """Everything touching the reference checkout is an *optional* oracle
     comparison (marked ``reference_oracle``, auto-skipped when absent);

@@ -1,0 +1,112 @@
+"""Two-qubit RB through the NOISY readout channel at realistic scale
+(round-4 review weak #5: every 2q RB test ran sigma=0).
+
+The exact-closed-form 2q recoveries (tests/test_rb2q.py) re-run here
+the way a hardware calibration would: finite sigma (a few percent
+assignment error), thousands of sampled shots per point, every point
+executed by the dp-sharded sweep driver over the 8-device CPU mesh —
+the calibration workflow the reference ecosystem runs on hardware
+(reference: python/distproc/hwconfig.py:69-98).
+
+Symmetric per-qubit assignment error leaves the depolarizing-RB
+asymptote at exactly 1/4 (the fully-mixed state reads uniformly
+through any symmetric channel: A' = [(1-e)+e]^2/4 = 1/4) and only
+rescales the decay amplitude — so the count-exact two-depth estimators
+of test_rb2q.py stay unbiased and only their CI widens.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu.models.coupling import couplings_from_qchip
+from distributed_processor_tpu.models.default_qchip import make_default_qchip
+from distributed_processor_tpu.models.rb2q import (depol2_survival,
+                                                   rb2q_interleaved_program,
+                                                   rb2q_program)
+from distributed_processor_tpu.parallel import make_mesh, run_physics_sweep
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.sim.device import DeviceModel
+from distributed_processor_tpu.sim.physics import ReadoutPhysics
+
+KW = dict(max_steps=8000, max_pulses=192, max_meas=4)
+SHOTS, BATCH = 4096, 4096           # dp=8 -> 512 per shard per batch
+SIGMA = 15.0                        # a few % assignment error
+
+
+@pytest.fixture(scope='module')
+def setup():
+    return Simulator(n_qubits=2), make_default_qchip(2), make_mesh(n_dp=8)
+
+
+def _survival(setup, prog, key, p2):
+    """Joint P(00) through the sharded driver with the noisy channel."""
+    sim, qchip, mesh = setup
+    mp = sim.compile(prog)
+    model = ReadoutPhysics(
+        sigma=SIGMA, p1_init=0.0,
+        device=DeviceModel('statevec',
+                           couplings=couplings_from_qchip(mp, qchip),
+                           depol2_per_pulse=p2))
+    out = run_physics_sweep(mp, model, SHOTS, BATCH, key=key, mesh=mesh,
+                            **KW)
+    assert out['err_shots'] == 0 and out['incomplete_batches'] == 0
+    return out['survival00_rate']
+
+
+def test_assignment_error_is_really_there(setup):
+    """The channel is genuinely lossy at this sigma: |00> readout
+    misassigns a few percent of shots."""
+    prog = [{'name': 'read', 'qubit': ['Q0']},
+            {'name': 'read', 'qubit': ['Q1']}]
+    s00 = _survival(setup, prog, 3, p2=0.0)
+    assert 0.70 < s00 < 0.99, s00
+    assert s00 < 0.995                     # not a noise-free channel
+
+
+def test_depol2_recovered_through_noisy_channel(setup):
+    """Injected 2q depolarization recovered from sampled survival
+    through the noisy discriminator on the mesh: the two-depth alpha
+    estimate inverts to the injected p2 (asymptote stays exactly 1/4
+    under the symmetric channel; amplitude rescaling cancels in the
+    ratio)."""
+    p2 = 0.04
+    points = []
+    for depth, seed in ((2, 1), (6, 2)):
+        prog, info = rb2q_program('Q0', 'Q1', depth, seed=seed)
+        surv = _survival(setup, prog, seed, p2)
+        points.append((info['n_cz'], surv))
+        # the raw curve also tracks the closed form up to the readout
+        # contrast factor: bound it loosely
+        pred = depol2_survival(p2, info['n_cz'])
+        assert abs(surv - pred) < 0.10, (depth, surv, pred)
+    (n1, s1), (n2, s2) = points
+    assert n2 > n1
+    alpha = ((s2 - 0.25) / (s1 - 0.25)) ** (1.0 / (n2 - n1))
+    p2_hat = 15.0 * (1.0 - alpha) / 16.0
+    np.testing.assert_allclose(p2_hat, p2, rtol=0.35)
+
+
+def test_interleaved_cz_error_through_noisy_channel(setup):
+    """Interleaved-CZ isolation at realistic scale: reference and
+    interleaved survivals sampled through the noisy channel, the
+    count-exact alpha ratio inverts to the per-CZ depolarization within
+    CI of the injection."""
+    p2 = 0.04
+    ref, intl = {}, {}
+    for depth, seed in ((2, 21), (6, 22)):
+        prog_r, info_r = rb2q_program('Q0', 'Q1', depth, seed=seed)
+        ref[depth] = (info_r['n_cz'],
+                      _survival(setup, prog_r, seed, p2))
+        prog_i, info_i = rb2q_interleaved_program('Q0', 'Q1', depth,
+                                                  seed=seed)
+        intl[depth] = (info_i['n_cz'],
+                       _survival(setup, prog_i, seed + 50, p2))
+    d1, d2 = 2, 6
+    steps = d2 - d1
+    a_ref = ((ref[d2][1] - 0.25) / (ref[d1][1] - 0.25)) ** (1 / steps)
+    a_int = ((intl[d2][1] - 0.25) / (intl[d1][1] - 0.25)) ** (1 / steps)
+    extra = (intl[d2][0] - intl[d1][0]) - (ref[d2][0] - ref[d1][0])
+    assert extra >= 1, (ref, intl)
+    alpha_cz = (a_int / a_ref) ** (steps / extra)
+    p2_hat = 15.0 * (1.0 - alpha_cz) / 16.0
+    np.testing.assert_allclose(p2_hat, p2, rtol=0.5)

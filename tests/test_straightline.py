@@ -1,0 +1,167 @@
+"""Emitted straight-line executor vs the generic fetch-dispatch engine.
+
+Round-5 exec lever (b) (docs/PERF.md "the measured overhead budget"):
+forward-jump-only programs unroll at trace time into per-instruction
+specialized step bodies — no program fetch, no opcode dispatch, no
+while-loop carry.  The contract is EXACT equality with the generic
+engine on every output (bits, records, timing, error bits, device
+co-state), pinned here on the bench-shaped program through the
+injected-bits path and the physics-closed path on both 1q devices.
+"""
+
+import numpy as np
+import pytest
+
+from bench import build_machine_program
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.decoder import machine_program_from_cmds
+from distributed_processor_tpu.sim.interpreter import (
+    InterpreterConfig, simulate_batch, straightline_ineligible,
+    use_straightline)
+
+
+@pytest.fixture(scope='module')
+def bench_mp():
+    return build_machine_program(4, 3)
+
+
+def _cfg(mp, **kw):
+    return InterpreterConfig(
+        max_steps=2 * mp.n_instr + 64,
+        max_pulses=int(mp.max_pulses_per_core(1)) + 4,
+        max_meas=2, max_resets=2, **kw)
+
+
+def test_bench_program_is_eligible(bench_mp):
+    assert straightline_ineligible(bench_mp, _cfg(bench_mp)) is None
+    # default is the generic engine (compile-amortization: the jit
+    # cache keys on program content in straight-line mode); None = auto
+    assert not use_straightline(bench_mp, _cfg(bench_mp))
+    assert use_straightline(bench_mp, _cfg(bench_mp, straightline=None))
+
+
+def test_injected_bits_equality(bench_mp):
+    """Every output key identical (records, regs, qclk, err, meas
+    bookkeeping) on the active-reset + RB program with random bits."""
+    mp = bench_mp
+    rng = np.random.default_rng(0)
+    bits = rng.integers(0, 2, size=(64, mp.n_cores, 2))
+    gen = simulate_batch(mp, bits, cfg=_cfg(mp, straightline=False))
+    sl = simulate_batch(mp, bits, cfg=_cfg(mp, straightline=True))
+    assert set(gen) == set(sl)
+    for k in gen:
+        if k == 'steps':     # counts engine iterations, not semantics
+            continue
+        np.testing.assert_array_equal(np.asarray(gen[k]),
+                                      np.asarray(sl[k]), err_msg=k)
+
+
+_PHYSICS_EQ_BODY = '''
+import numpy as np
+import jax
+jax.config.update('jax_platforms', 'cpu')
+from bench import build_machine_program
+from distributed_processor_tpu.sim.device import DeviceModel
+from distributed_processor_tpu.sim.interpreter import InterpreterConfig
+from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                   run_physics_batch)
+mp = build_machine_program(4, 3)
+for devkind in ('parity', 'bloch'):
+    dev = DeviceModel(devkind,
+                      detuning_hz=0.3e6 if devkind == 'bloch' else 0.0,
+                      t1_s=50e-6 if devkind == 'bloch' else float('inf'))
+    model = ReadoutPhysics(sigma=0.05, p1_init=0.2, device=dev)
+    outs = {}
+    for slf in (False, True):
+        outs[slf] = run_physics_batch(
+            mp, model, 5, 128,
+            cfg=InterpreterConfig(
+                max_steps=2 * mp.n_instr + 64,
+                max_pulses=int(mp.max_pulses_per_core(1)) + 4,
+                max_meas=2, max_resets=2, straightline=slf))
+        assert not bool(outs[slf]['incomplete'])
+    for k in outs[False]:
+        if k == 'steps':
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(outs[False][k]), np.asarray(outs[True][k]),
+            err_msg=devkind + ':' + k)
+print('EQUAL')
+'''
+
+
+def test_physics_closed_equality_subprocess():
+    """Physics-closed epoch loop: the straight-line pass pauses lanes
+    at unresolved readouts and resumes exactly like the generic engine
+    — meas_bits, device co-state, and error bits all bit-identical on
+    both 1q devices.
+
+    Runs in a fresh subprocess: the unrolled physics module is the
+    largest single CPU compile in the suite, and XLA has been seen
+    segfaulting on it inside the long-lived full-suite process (heap
+    state after ~350 tests) while compiling it cleanly in a fresh one.
+    """
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, '-c', _PHYSICS_EQ_BODY], env=env,
+                       cwd=repo, capture_output=True, text=True,
+                       timeout=900)
+    assert r.returncode == 0 and 'EQUAL' in r.stdout, \
+        (r.returncode, r.stderr[-2000:])
+
+
+def test_packed_ctrl_equivalent(bench_mp):
+    """The packed [K, B, C] control carry (round-5 lever (a), measured
+    negative but kept as an exact knob) produces identical outputs."""
+    mp = bench_mp
+    rng = np.random.default_rng(1)
+    bits = rng.integers(0, 2, size=(32, mp.n_cores, 2))
+    a = simulate_batch(mp, bits, cfg=_cfg(mp))
+    b = simulate_batch(mp, bits, cfg=_cfg(mp, packed_ctrl=True))
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=k)
+
+
+def test_loop_program_falls_back():
+    """A backward jump (on-device loop) is ineligible: auto mode runs
+    the generic engine, straightline=True raises with the reason."""
+    mp = machine_program_from_cmds([[
+        isa.pulse_cmd(cmd_time=100, cfg_word=0, env_word=4096),
+        isa.alu_cmd('reg_alu', 'i', 1, 'add', alu_in1=0,
+                    write_reg_addr=0),
+        isa.alu_cmd('jump_cond', 'i', 3, 'ge', alu_in1=0,
+                    jump_cmd_ptr=0),
+        isa.done_cmd(),
+    ]])
+    cfg = InterpreterConfig(max_steps=128, max_pulses=8, max_meas=2)
+    assert 'backward jump' in straightline_ineligible(mp, cfg)
+    assert not use_straightline(mp, cfg)
+    out = simulate_batch(mp, np.zeros((4, 1, 2), int), cfg=cfg)
+    assert not bool(out['incomplete'])
+    with pytest.raises(ValueError, match='backward jump'):
+        simulate_batch(mp, np.zeros((4, 1, 2), int),
+                       cfg=InterpreterConfig(max_steps=128, max_pulses=8,
+                                             max_meas=2,
+                                             straightline=True))
+
+
+def test_sticky_race_and_missed_trigger_flags_match(bench_mp):
+    """Error-bit semantics survive specialization: a deliberately
+    mis-scheduled program (trigger in the past after an idle) flags
+    ERR_MISSED_TRIG identically on both engines."""
+    from distributed_processor_tpu.sim.interpreter import ERR_MISSED_TRIG
+    mp = machine_program_from_cmds([[
+        isa.idle(500),
+        isa.pulse_cmd(cmd_time=100, cfg_word=0, env_word=4096),
+        isa.done_cmd(),
+    ]])
+    cfg = dict(max_steps=64, max_pulses=8, max_meas=2)
+    for slf in (False, True):
+        out = simulate_batch(mp, np.zeros((4, 1, 2), int),
+                             cfg=InterpreterConfig(straightline=slf,
+                                                   **cfg))
+        assert np.all(np.asarray(out['err']) & ERR_MISSED_TRIG), slf

@@ -35,9 +35,11 @@ import numpy as np
 
 def main():
     import jax
-    from bench import build_machine_program
+    from bench import build_machine_program, enable_compilation_cache
     from distributed_processor_tpu.sim.interpreter import (
         InterpreterConfig, simulate_batch)
+
+    enable_compilation_cache()
 
     n_qubits = int(os.environ.get('BENCH_QUBITS', 8))
     depth = int(os.environ.get('BENCH_DEPTH', 12))
@@ -45,7 +47,14 @@ def main():
     mp = build_machine_program(n_qubits, depth)
     base = dict(max_steps=2 * mp.n_instr + 64,
                 max_pulses=int(mp.max_pulses_per_core(1)) + 4,
-                max_meas=2, max_resets=2, record_pulses=False)
+                max_meas=2, max_resets=2, record_pulses=False,
+                # PROFILE_PACKED=1: packed [K, B, C] control carry
+                # (InterpreterConfig.packed_ctrl) — round-5 lever (a)
+                packed_ctrl=os.environ.get('PROFILE_PACKED') == '1',
+                # PROFILE_SL=1: emitted straight-line executor — round-5
+                # lever (b)
+                straightline=(None if os.environ.get('PROFILE_SL') == '1'
+                              else False))
     rng = np.random.default_rng(0)
 
     def timed(B, k):
