@@ -149,6 +149,109 @@ def test_loop_program_falls_back():
                                              straightline=True))
 
 
+@pytest.mark.parametrize('seed', range(8))
+def test_random_forward_programs_engine_equality(seed):
+    """Adversarial pin on the duplicated instruction semantics: random
+    forward-only programs (pulses with jittered trigger times incl.
+    deliberate misses, pulse_write/pulse_reset/idle, REG_ALU chains,
+    forward jump_i/jump_cond, INC_QCLK rewinds, self sticky fproc
+    reads, measurement pulses) must produce IDENTICAL outputs — state,
+    records, timing, error bits — on both engines with random injected
+    bits."""
+    rng = np.random.default_rng(100 + seed)
+    C = 2
+    cores = []
+    for c in range(C):
+        n_body = int(rng.integers(8, 14))
+        cmds = []
+        t = 20
+        for i in range(n_body):
+            kind = rng.choice(['pt', 'pw', 'alu', 'jc', 'ji', 'idle',
+                               'rst', 'fproc', 'incq'],
+                              p=[.3, .1, .15, .1, .05, .1, .05, .1, .05])
+            if kind == 'pt':
+                # occasionally schedule in the past: both engines must
+                # flag ERR_MISSED_TRIG identically
+                t += int(rng.integers(-5, 60))
+                cmds.append(isa.pulse_cmd(
+                    cmd_time=max(t, 0), cfg_word=int(rng.integers(0, 3)),
+                    env_word=int(rng.integers(0, 1 << 14)),
+                    amp_word=int(rng.integers(0, 1 << 16)),
+                    phase_word=int(rng.integers(0, 1 << 17)),
+                    freq_word=int(rng.integers(0, 4))))
+            elif kind == 'pw':
+                cmds.append(isa.pulse_cmd(
+                    amp_word=int(rng.integers(0, 1 << 16)),
+                    phase_word=int(rng.integers(0, 1 << 17))))
+            elif kind == 'alu':
+                cmds.append(isa.alu_cmd(
+                    'reg_alu', rng.choice(['i', 'r']),
+                    int(rng.integers(-50, 50)),
+                    rng.choice(['add', 'sub', 'eq', 'le', 'ge']),
+                    alu_in1=int(rng.integers(0, 4)),
+                    write_reg_addr=int(rng.integers(0, 4))))
+            elif kind == 'jc':
+                # forward target within the eventual body (clipped when
+                # the program is assembled below)
+                cmds.append(('jc', int(rng.integers(-20, 20)),
+                             rng.choice(['eq', 'le', 'ge'])))
+            elif kind == 'ji':
+                cmds.append(('ji',))
+            elif kind == 'idle':
+                t += int(rng.integers(0, 80))
+                cmds.append(isa.idle(t))
+            elif kind == 'rst':
+                cmds.append(isa.pulse_reset())
+            elif kind == 'fproc':
+                cmds.append(('fproc', int(rng.integers(0, 2))))
+            else:
+                cmds.append(isa.alu_cmd('inc_qclk', 'i',
+                                        int(rng.integers(-30, 30)),
+                                        'add'))
+        # resolve placeholder jumps now that the length is known: every
+        # target strictly forward, landing inside the body or on DONE
+        n = len(cmds) + 1                      # + trailing DONE
+        out = []
+        for i, cmd in enumerate(cmds):
+            if isinstance(cmd, tuple) and cmd[0] == 'jc':
+                tgt = int(rng.integers(i + 1, n))
+                out.append(isa.alu_cmd('jump_cond', 'i', cmd[1], cmd[2],
+                                       alu_in1=int(rng.integers(0, 4)),
+                                       jump_cmd_ptr=tgt))
+            elif isinstance(cmd, tuple) and cmd[0] == 'ji':
+                tgt = int(rng.integers(i + 1, n))
+                out.append(isa.jump_i(tgt))
+            elif isinstance(cmd, tuple) and cmd[0] == 'fproc':
+                tgt = int(rng.integers(i + 1, n))
+                op = 'jump_fproc' if cmd[1] else 'alu_fproc'
+                out.append(isa.alu_cmd(
+                    op, 'i', int(rng.integers(0, 2)), 'eq',
+                    write_reg_addr=int(rng.integers(0, 4)),
+                    jump_cmd_ptr=tgt, func_id=c))
+            else:
+                out.append(cmd)
+        out.append(isa.done_cmd())
+        cores.append(out)
+    mp = machine_program_from_cmds(cores)
+    cfg_kw = dict(max_steps=256, max_pulses=32, max_meas=8, max_resets=8)
+    assert straightline_ineligible(
+        mp, InterpreterConfig(**cfg_kw)) is None, 'generator bug'
+    bits = rng.integers(0, 2, size=(16, C, 8))
+    gen = simulate_batch(mp, bits,
+                         cfg=InterpreterConfig(straightline=False,
+                                               **cfg_kw))
+    sl = simulate_batch(mp, bits,
+                        cfg=InterpreterConfig(straightline=True,
+                                              **cfg_kw))
+    assert set(gen) == set(sl)
+    for k in gen:
+        if k == 'steps':
+            continue
+        np.testing.assert_array_equal(np.asarray(gen[k]),
+                                      np.asarray(sl[k]),
+                                      err_msg=f'seed {seed}: {k}')
+
+
 def test_sticky_race_and_missed_trigger_flags_match(bench_mp):
     """Error-bit semantics survive specialization: a deliberately
     mis-scheduled program (trigger in the past after an idle) flags
