@@ -299,7 +299,7 @@ def utilization_accounting(mp, cfg, model, batch: int,
     from dataclasses import replace
     from distributed_processor_tpu.sim.device import DeviceModel
     from distributed_processor_tpu.sim.interpreter import (
-        _run_batch, _program_constants, _init_state, program_traits)
+        _init_state)
     from distributed_processor_tpu.sim.physics import (physics_config,
                                                        _physics_tables)
     C = mp.n_cores
@@ -308,16 +308,15 @@ def utilization_accounting(mp, cfg, model, batch: int,
     # regardless of the headline's device model
     pcfg = physics_config(cfg, replace(model,
                                        device=DeviceModel('parity')))
-    soa, spc, interp, sync_part = _program_constants(mp, pcfg)
-    traits = program_traits(mp)
 
-    # measured exec phase: the same interpreter loop (physics-effective
-    # config, so the carry and co-state match the headline) with
+    # measured exec phase: the same ENGINE the headline runs (the
+    # simulate_batch routing honours cfg.straightline, so the probe
+    # times the straight-line executor when the headline uses it) with
     # injected bits standing in for the resolver
-    @jax.jit
+    from distributed_processor_tpu.sim.interpreter import simulate_batch
+
     def ex(bits):
-        out = _run_batch(soa, spc, interp, sync_part, bits, pcfg, C,
-                         None, traits)
+        out = simulate_batch(mp, bits, cfg=pcfg)
         return out['n_pulses'].sum(), out['err'].sum(), out['steps']
 
     bits = jnp.zeros((batch, C, cfg.max_meas), jnp.int32)
