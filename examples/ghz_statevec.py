@@ -30,7 +30,10 @@ from distributed_processor_tpu.simulator import Simulator
 from distributed_processor_tpu.sim.device import DeviceModel
 from distributed_processor_tpu.sim.physics import ReadoutPhysics
 
-N, SHOTS = 4, 1024
+# full system size: 8 qubits = a [shots, 256] state vector per shot,
+# the scale the reference ecosystem calibrates 2q gates at (round 5;
+# N=4 runs in a few seconds if you want a quicker demo)
+N, SHOTS = 8, 1024
 
 
 def main():
