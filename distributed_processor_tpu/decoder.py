@@ -191,6 +191,88 @@ class MachineProgram:
                 'max_pulses': max(worst_pulses, 1) + 2}
 
 
+@dataclass
+class MultiMachineProgram:
+    """A stacked ensemble of decoded machine programs — program-as-data.
+
+    ``soa`` carries ``[n_progs, n_cores, n_instr]`` field arrays
+    (DONE-padded into a shared shape bucket, see
+    :func:`~distributed_processor_tpu.isa.shape_bucket`); element tables
+    are validated identical across the ensemble so the interpreter's
+    per-core constants stay unbatched.  The attribute surface mirrors
+    :class:`MachineProgram` (``soa``/``tables``/``n_cores``/
+    ``sync_participants``) so the interpreter's constant/traits helpers
+    work on either.
+    """
+    soa: isa.SoAProgram          # [n_progs, n_cores, n_instr]
+    p_elem: np.ndarray           # [n_progs, n_cores, n_instr]
+    p_dur: np.ndarray            # [n_progs, n_cores, n_instr]
+    tables: list                 # CoreTables per core (ensemble-shared)
+    core_inds: list
+
+    @property
+    def n_progs(self) -> int:
+        return self.soa.kind.shape[0]
+
+    @property
+    def n_cores(self) -> int:
+        return self.soa.kind.shape[1]
+
+    @property
+    def n_instr(self) -> int:
+        return self.soa.kind.shape[2]
+
+    @property
+    def sync_participants(self) -> np.ndarray:
+        """Bool[n_progs, n_cores]: cores with a SYNC instruction."""
+        return np.any(self.soa.kind == isa.K_SYNC, axis=2)
+
+
+def stack_machine_programs(mps: list, pad_to: int = None,
+                           bucket: bool = True) -> MultiMachineProgram:
+    """Stack decoded :class:`MachineProgram`\\ s into one
+    :class:`MultiMachineProgram`.
+
+    ``bucket=True`` (default) pads ``n_instr`` up to the next power of
+    two — the shape-bucket policy that lets every same-band ensemble
+    share one compiled executable (``pad_to`` raises the floor further).
+    Programs must agree on core count and element geometry: the
+    ensemble shares one set of per-core sample-rate constants, and a
+    mismatch would silently mistime pulses.
+    """
+    if not mps:
+        raise ValueError('need at least one MachineProgram to stack')
+    first = mps[0]
+    geom = [(ec.samples_per_clk, ec.interp_ratio)
+            for t in first.tables for ec in t.elem_cfgs]
+    for mp in mps[1:]:
+        if mp.n_cores != first.n_cores:
+            raise ValueError(
+                f'core-count mismatch in ensemble: {mp.n_cores} != '
+                f'{first.n_cores}')
+        g = [(ec.samples_per_clk, ec.interp_ratio)
+             for t in mp.tables for ec in t.elem_cfgs]
+        if g != geom:
+            raise ValueError(
+                'element geometry differs across the ensemble — stacked '
+                'programs share per-core sample-rate constants')
+    n = max(mp.n_instr for mp in mps)
+    if pad_to is not None:
+        n = max(n, pad_to)
+    if bucket:
+        n = isa.shape_bucket(n)
+    soa = isa.stack_soa_multi([mp.soa for mp in mps], pad_to=n)
+    P, C, N = soa.kind.shape
+    p_elem = np.zeros((P, C, N), np.int32)
+    p_dur = np.zeros((P, C, N), np.int32)
+    for i, mp in enumerate(mps):
+        p_elem[i, :, :mp.n_instr] = mp.p_elem
+        p_dur[i, :, :mp.n_instr] = mp.p_dur
+    return MultiMachineProgram(soa=soa, p_elem=p_elem, p_dur=p_dur,
+                               tables=first.tables,
+                               core_inds=list(first.core_inds))
+
+
 def machine_program_from_cmds(cmds_per_core, elem_cfgs=None,
                               pad_to: int = None) -> MachineProgram:
     """Build a MachineProgram directly from per-core 128-bit command lists.

@@ -410,6 +410,51 @@ def stack_soa(programs: list[SoAProgram], pad_to: int = None) -> SoAProgram:
     return SoAProgram(**out)
 
 
+def shape_bucket(n_instr: int, min_size: int = 8) -> int:
+    """Pad target for the multi-program path: ``n_instr`` rounded up to
+    the next power of two (floored at ``min_size``).
+
+    The multi-program executor keys its jit cache on array SHAPES, so
+    every ensemble padded into the same bucket shares one compiled
+    executable — all RB sequences of a depth band, say — and fresh
+    random sequences of the same shape never retrace.
+    """
+    if n_instr <= 0:
+        raise ValueError(f'n_instr must be positive, got {n_instr}')
+    return max(min_size, 1 << (n_instr - 1).bit_length())
+
+
+def stack_soa_multi(programs: list[SoAProgram],
+                    pad_to: int = None) -> SoAProgram:
+    """Stack already-stacked ``[n_cores, n_instr]`` SoA programs into
+    ``[n_progs, n_cores, n_instr]`` arrays — the program-as-data tensor
+    the multi-program executor vmaps over.
+
+    Shorter programs pad with DONE exactly like :func:`stack_soa`: a
+    padded core halts at its original DONE and the trailing rows never
+    execute, so padding is semantically invisible.  Every program must
+    share one ``n_cores``.
+    """
+    if not programs:
+        raise ValueError('need at least one program to stack')
+    n_cores = programs[0].kind.shape[0]
+    for p in programs:
+        if p.kind.ndim != 2 or p.kind.shape[0] != n_cores:
+            raise ValueError(
+                f'every program must be [n_cores={n_cores}, n_instr]; '
+                f'got shape {p.kind.shape}')
+    n = max(p.n_instr for p in programs)
+    if pad_to is not None:
+        n = max(n, pad_to)
+    out = {f: np.zeros((len(programs), n_cores, n), dtype=np.int32)
+           for f in SOA_FIELDS}
+    out['kind'][:] = K_DONE
+    for i, prog in enumerate(programs):
+        for f in SOA_FIELDS:
+            out[f][i, :, :prog.n_instr] = getattr(prog, f)
+    return SoAProgram(**out)
+
+
 # ---------------------------------------------------------------------------
 # human-readable disassembly (debugging / golden tests)
 # ---------------------------------------------------------------------------

@@ -2,7 +2,7 @@ from .channels import make_channel_config, make_channel_configs
 from .experiments import (active_reset, rabi_program, t1_program,
                           ramsey_program, loop_shots_program, ghz_program,
                           t2_echo_program)
-from .rb import rb_program, rb_sequence, clifford_table
+from .rb import rb_program, rb_sequence, rb_ensemble, clifford_table
 from .rb2q import (rb2q_program, rb2q_sequence, clifford2_table,
                    rb2q_interleaved_program, element_index,
                    depol2_survival, count_cz)

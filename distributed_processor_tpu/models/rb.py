@@ -96,3 +96,26 @@ def rb_program(qubits, depth: int, rng=None, seed: int = 0,
     for q in qubits:
         program.append({'name': 'read', 'qubit': [q]})
     return program
+
+
+def rb_ensemble(qubits, depth: int, n_seqs: int, seed: int = 0,
+                delay_before: float = 500e-9) -> list[list[dict]]:
+    """``n_seqs`` independent random RB programs of one depth — the
+    multi-sequence ensemble an RB experiment actually averages over
+    (a single fixed sequence measures that sequence, not the gate set).
+
+    Every Clifford costs exactly two physical pulses regardless of the
+    random draw, so all members of an ensemble compile to the same
+    instruction-count band and share one shape bucket — execute them in
+    one compile via ``sim.interpreter.simulate_multi_batch``.
+
+    Sequence ``s`` seeds its own generator from ``(seed, s)``:
+    ensembles are reproducible, and growing ``n_seqs`` extends an
+    existing ensemble without re-randomizing the earlier members.
+    """
+    if n_seqs <= 0:
+        raise ValueError(f'need n_seqs >= 1, got {n_seqs}')
+    return [rb_program(qubits, depth,
+                       rng=np.random.default_rng([seed, s]),
+                       delay_before=delay_before)
+            for s in range(n_seqs)]

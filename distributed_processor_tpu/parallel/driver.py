@@ -19,6 +19,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import isa
 from ..sim.interpreter import InterpreterConfig
 from ..utils.results import SweepAccumulator
 from .sweep import physics_batch_stats
@@ -26,7 +27,10 @@ from .sweep import physics_batch_stats
 
 # v3: batch stats gained `allzero_sum` (joint RB survival) — older
 # checkpoints' accumulator states lack the key and must not resume
-FINGERPRINT_VERSION = 3
+# v4: batch stats gained `clean_shots` (the survival denominator —
+# dividing the clean-shot numerator by total shots biased survival low
+# by the errored/unresolved fraction); v3 states lack the key
+FINGERPRINT_VERSION = 4
 
 
 def _jsonable(v):
@@ -206,11 +210,188 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
             f'did not finish (step budget); mean_pulses/meas1_rate '
             f'include their partial counts — raise max_steps or treat '
             f'the means as lower bounds', stacklevel=2)
+    # survival over CLEAN shots only: allzero_sum already excludes
+    # errored/unresolved shots from the numerator, so dividing by
+    # shots_done would bias the rate low by exactly that fraction
+    clean = int(acc.state['clean_shots'])
     return {
         'shots': shots_done,
         'mean_pulses': acc.state['pulse_sum'] / shots_done,
         'meas1_rate': acc.state['meas1_sum'] / shots_done,
-        'survival00_rate': float(acc.state['allzero_sum'] / shots_done),
+        'survival00_rate': float(acc.state['allzero_sum'] / clean)
+        if clean else float('nan'),
+        'clean_shots': clean,
         'err_shots': int(acc.state['err_shots']),
+        'incomplete_batches': incomplete,
+    }
+
+
+def _ensemble_fingerprint(mmp, batch: int, key, cfg, init_regs, p1,
+                          n_dp: int = 0) -> dict:
+    """Sweep identity for the multi-program path: the CRC covers every
+    operand plane of the STACKED ``[n_progs, n_cores, n_instr]``
+    program tensor, so resuming with any member of the ensemble swapped
+    (or reordered, or a different count) is rejected — a per-program
+    fingerprint would accept a shuffled ensemble whose per-batch key
+    stream no longer lines up with the accumulated statistics."""
+    import dataclasses
+    crc = 0
+    for f in dataclasses.fields(mmp.soa):
+        crc = zlib.crc32(
+            np.ascontiguousarray(getattr(mmp.soa, f.name)).tobytes(), crc)
+    regs_crc = 0 if init_regs is None else zlib.crc32(
+        np.ascontiguousarray(np.asarray(init_regs)).tobytes())
+    return {
+        'fingerprint_version': FINGERPRINT_VERSION,
+        'multi': True,
+        'n_progs': int(mmp.n_progs),
+        'batch': int(batch),
+        'key': np.asarray(jax.random.key_data(key)).tolist(),
+        'program_crc': int(crc),
+        'p1': np.asarray(p1, np.float64).tolist(),
+        'cfg': _jsonable(cfg),
+        'init_regs_crc': int(regs_crc),
+        'n_dp': int(n_dp),
+    }
+
+
+def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
+                    key=0, cfg: InterpreterConfig = None,
+                    init_regs=None, checkpoint: str = None,
+                    checkpoint_every: int = 0, mesh=None,
+                    strict_resume: bool = False, **cfg_kw) -> dict:
+    """Injected-bits sweep over a PROGRAM ENSEMBLE: ``total_shots`` per
+    program in ``batch``-sized steps, every batch one execution of the
+    shape-bucketed multi-program executable (all ensemble members vmapped
+    inside one jit — the compile-amortization path, see
+    ``sim.interpreter.simulate_multi_batch``).
+
+    Measurement bits are Bernoulli(``p1``) per (program, shot, core,
+    slot) — ``p1`` a scalar or per-core array — exercising data-dependent
+    control flow (active-reset branches) the way ``sample_meas_bits``
+    feeds single programs.  The per-batch key folds the batch INDEX, so
+    a resumed sweep reproduces the identical stream; with ``mesh``, the
+    shot axis shards over ``dp`` and each shard folds its axis index.
+
+    The checkpoint fingerprint covers the ENTIRE stacked ensemble (every
+    operand plane of the ``[n_progs, n_cores, n_instr]`` tensor), so
+    resuming with a changed, reordered, or re-padded ensemble fails
+    loudly.
+
+    Returns per-program arrays: ``mean_pulses [n_progs, n_cores]``,
+    ``err_rate [n_progs]``, ``mean_qclk [n_progs, n_cores]``, plus
+    ``shots`` (per program) and ``incomplete_batches``.
+    """
+    from dataclasses import replace
+    from ..decoder import MultiMachineProgram, stack_machine_programs
+    from ..sim.interpreter import (_program_constants, _run_batch,
+                                   program_traits)
+    mmp = mps if isinstance(mps, MultiMachineProgram) \
+        else stack_machine_programs(mps)
+    if cfg is None:
+        cfg_kw.setdefault('max_steps', 2 * mmp.n_instr + 64)
+        cfg_kw.setdefault('max_pulses', mmp.n_instr + 2)
+        cfg = InterpreterConfig(**cfg_kw)
+    else:
+        cfg = replace(cfg, **cfg_kw)
+    cfg = replace(cfg, record_pulses=False, straightline=False)
+    if total_shots <= 0 or batch <= 0:
+        raise ValueError(f'need positive total_shots/batch, got '
+                         f'{total_shots}/{batch}')
+    if total_shots % batch:
+        raise ValueError(f'total_shots {total_shots} not divisible by '
+                         f'batch {batch}')
+    n_batches = total_shots // batch
+    if isinstance(key, int):
+        key = jax.random.PRNGKey(key)
+    soa, spc, interp, sync_part = _program_constants(mmp, cfg)
+    traits = program_traits(mmp)
+    n_progs, n_cores = mmp.n_progs, mmp.n_cores
+    p1 = jnp.broadcast_to(jnp.asarray(p1, jnp.float32), (n_cores,))
+    if init_regs is not None:
+        init_regs = np.asarray(init_regs, np.int32)
+        if init_regs.ndim == 2:
+            init_regs = np.broadcast_to(
+                init_regs[None], (n_progs,) + init_regs.shape)
+        if init_regs.shape[0] != n_progs:
+            raise ValueError(
+                f'init_regs leading axis {init_regs.shape[0]} != '
+                f'n_progs {n_progs}')
+    regs_dev = jnp.zeros((n_progs, n_cores, isa.N_REGS), jnp.int32) \
+        if init_regs is None else jnp.asarray(init_regs)
+
+    def local_stats(k, shots_here):
+        bits = (jax.random.uniform(
+            k, (n_progs, shots_here, n_cores, cfg.max_meas))
+            < p1[None, None, :, None]).astype(jnp.int32)
+
+        def one(s, sy, b, r):
+            out = _run_batch(s, spc, interp, sy, b, cfg, n_cores,
+                             jnp.broadcast_to(r[None],
+                                              (shots_here,) + r.shape),
+                             traits)
+            return dict(pulse_sum=jnp.sum(out['n_pulses'], axis=0),
+                        err_shots=jnp.sum(jnp.any(out['err'] != 0,
+                                                  axis=1)),
+                        qclk_sum=jnp.sum(out['qclk'], axis=0),
+                        incomplete=out['incomplete'].astype(jnp.int32))
+        return jax.vmap(one)(soa, sync_part, bits, regs_dev)
+
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        from .sweep import shard_map
+        n_dp = mesh.shape['dp']
+        if batch % n_dp:
+            raise ValueError(f'batch {batch} not divisible by mesh '
+                             f'dp={n_dp}')
+        local_shots = batch // n_dp
+
+        def local(k):
+            k_local = jax.random.fold_in(k, jax.lax.axis_index('dp'))
+            stats = local_stats(k_local, local_shots)
+            stats = jax.tree.map(lambda x: jax.lax.psum(x, 'dp'), stats)
+            # a program's batch is incomplete if ANY shard was
+            stats['incomplete'] = jnp.minimum(stats['incomplete'], 1)
+            return stats
+
+        step = jax.jit(shard_map(local, mesh=mesh, in_specs=(P(),),
+                                 out_specs=P(), check_vma=False))
+    else:
+        step = jax.jit(lambda k: local_stats(k, batch))
+
+    meta = _ensemble_fingerprint(
+        mmp, batch, key, cfg, init_regs, p1,
+        mesh.shape['dp'] if mesh is not None else 0)
+    if checkpoint and checkpoint_every <= 0:
+        checkpoint_every = 1
+    acc = SweepAccumulator.resume(checkpoint, checkpoint_every, meta=meta,
+                                  strict=strict_resume) \
+        if checkpoint else SweepAccumulator(meta=meta)
+    if acc.n_batches > n_batches:
+        raise ValueError(
+            f'checkpoint already holds {acc.n_batches} batches '
+            f'({acc.n_batches * batch} shots/program) > requested '
+            f'{total_shots}')
+    for i in range(acc.n_batches, n_batches):
+        stats = step(jax.random.fold_in(key, i))
+        acc.add({k: np.asarray(v) for k, v in stats.items()})
+    if checkpoint:
+        acc.save()
+
+    shots_done = acc.n_batches * batch
+    incomplete = int(np.sum(acc.state['incomplete']))
+    if incomplete:
+        import warnings
+        warnings.warn(
+            f'{incomplete} (program, batch) pairs contain shots that '
+            f'did not finish (step budget); means include their partial '
+            f'counts — raise max_steps or treat them as lower bounds',
+            stacklevel=2)
+    return {
+        'shots': shots_done,
+        'n_progs': n_progs,
+        'mean_pulses': acc.state['pulse_sum'] / shots_done,
+        'err_rate': acc.state['err_shots'] / shots_done,
+        'mean_qclk': acc.state['qclk_sum'] / shots_done,
         'incomplete_batches': incomplete,
     }

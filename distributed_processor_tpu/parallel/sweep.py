@@ -121,6 +121,9 @@ def physics_batch_stats(out: dict) -> dict:
     (its bit would sit at the 0 default), must not inflate an RB
     survival estimate — so the statistic implies the every-core-reads
     program shape, and a program with spectator cores reads 0 here.
+    ``clean_shots`` is the matching DENOMINATOR: a survival rate of
+    clean numerator over total shots would bias low by exactly the
+    errored/unresolved fraction.
     """
     first = out['meas_bits'][:, :, 0]
     clean = ~jnp.any(out['err'] != 0, axis=1) \
@@ -130,8 +133,75 @@ def physics_batch_stats(out: dict) -> dict:
         meas1_sum=jnp.sum(first, axis=0),
         allzero_sum=jnp.sum((jnp.all(first == 0, axis=1)
                              & clean).astype(jnp.int32)),
+        clean_shots=jnp.sum(clean.astype(jnp.int32)),
         err_shots=jnp.sum(jnp.any(out['err'] != 0, axis=1)),
     )
+
+
+def sharded_multi_stats(mps, meas_bits, mesh, init_regs=None,
+                        cfg: InterpreterConfig = None, **kw):
+    """Multi-program ensemble reduced to per-program statistics on the
+    mesh: programs ride a vmapped leading axis inside ONE compiled
+    executable (same shape-bucketed program-as-data tensor as
+    ``simulate_multi_batch``), shots shard over the ``dp`` axis, and
+    only psum-reduced sums reach the host.
+
+    ``mps``: list of MachinePrograms or a stacked MultiMachineProgram.
+    ``meas_bits``: ``[n_progs, n_shots, n_cores, n_meas]`` with
+    ``n_shots`` divisible by the dp axis size.  ``init_regs``: optional
+    ``[n_progs, n_cores, 16]`` per-program register file.
+
+    Returns ``mean_pulses [n_progs, n_cores]``, ``err_rate [n_progs]``,
+    ``mean_qclk [n_progs, n_cores]``.
+    """
+    from dataclasses import replace
+    from ..decoder import MultiMachineProgram, stack_machine_programs
+    from ..sim.interpreter import _program_constants, program_traits
+    mmp = mps if isinstance(mps, MultiMachineProgram) \
+        else stack_machine_programs(mps)
+    if cfg is None:
+        kw.setdefault('max_steps', 2 * mmp.n_instr + 64)
+        kw.setdefault('max_pulses', mmp.n_instr + 2)
+        cfg = InterpreterConfig(**kw)
+    else:
+        cfg = replace(cfg, **kw)
+    cfg = replace(cfg, record_pulses=False, straightline=False)
+    soa, spc, interp, sync_part = _program_constants(mmp, cfg)
+    traits = program_traits(mmp)
+    n_progs, n_cores = mmp.n_progs, mmp.n_cores
+    meas_bits = _pad_meas(meas_bits, cfg.max_meas)
+    if meas_bits.ndim != 4 or meas_bits.shape[0] != n_progs:
+        raise ValueError(
+            f'meas_bits must be [n_progs={n_progs}, n_shots, n_cores, '
+            f'n_meas]; got {tuple(meas_bits.shape)}')
+    n_shots = meas_bits.shape[1]
+    n_dp = mesh.shape['dp']
+    if n_shots % n_dp:
+        raise ValueError(f'{n_shots} shots not divisible by dp={n_dp}')
+    if init_regs is None:
+        init_regs = jnp.zeros((n_progs, n_cores, isa.N_REGS), jnp.int32)
+    init_regs = jnp.asarray(init_regs, jnp.int32)
+
+    def local(mb, ir):
+        def one(s, sy, b, r):
+            out = _run_batch(s, spc, interp, sy, b, cfg, n_cores,
+                             jnp.broadcast_to(r[None],
+                                              (b.shape[0],) + r.shape),
+                             traits)
+            return dict(pulse_sum=jnp.sum(out['n_pulses'], axis=0),
+                        err_shots=jnp.sum(jnp.any(out['err'] != 0,
+                                                  axis=1)),
+                        qclk_sum=jnp.sum(out['qclk'], axis=0))
+        stats = jax.vmap(one)(soa, sync_part, mb, ir)
+        return jax.tree.map(lambda x: jax.lax.psum(x, 'dp'), stats)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(None, 'dp'), P()), out_specs=P(),
+                   check_vma=False)
+    out = jax.jit(fn)(meas_bits, init_regs)
+    return dict(mean_pulses=out['pulse_sum'] / n_shots,
+                err_rate=out['err_shots'] / n_shots,
+                mean_qclk=out['qclk_sum'] / n_shots)
 
 
 def sharded_physics_stats(mp, model, key, shots: int, mesh,

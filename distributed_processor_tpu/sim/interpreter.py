@@ -1291,22 +1291,23 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
         st2['err'] = jnp.where(hard[:, None] & ~st2['done'],
                                st2['err'] | ERR_FPROC_DEADLOCK, st2['err'])
         st2['done'] = st2['done'] | hard[:, None]
-        if cfg.steps_per_iter > 1:
-            # exactness vs k=1: the while condition is only evaluated
-            # between k-step bodies, so sub-steps past the max_steps
-            # budget OR after the batch settles (k=1 would have exited
-            # the loop there, freezing the step budget for later
-            # physics epochs) must be true no-ops — a scalar-predicate
-            # select per carry leaf
-            settled_in = jnp.all(st_in['done'], axis=-1)
-            if cfg.physics:
-                st_in = dict(st_in, paused=paused)
-                settled_in = settled_in | paused
-            ok = (steps < cfg.max_steps) & ~jnp.all(settled_in)
-            st2 = {k: jnp.where(ok, v, st_in[k]) for k, v in st2.items()}
-            st2['_steps'] = jnp.where(ok, steps + 1, steps)
-        else:
-            st2['_steps'] = steps + 1
+        # exactness select: steps applied past the max_steps budget or
+        # after the batch settles must be true no-ops — a scalar-
+        # predicate select per carry leaf.  With steps_per_iter=1 the
+        # while condition would have exited exactly there, so the select
+        # is an identity; it is load-bearing for (a) sub-steps inside a
+        # k>1 unrolled body (the condition is only evaluated between
+        # k-step bodies) and (b) the multi-program path, where vmap
+        # lifts the while condition to an OR over program lanes and
+        # settled programs keep receiving the body until the slowest
+        # lane finishes.
+        settled_in = jnp.all(st_in['done'], axis=-1)
+        if cfg.physics:
+            st_in = dict(st_in, paused=paused)
+            settled_in = settled_in | paused
+        ok = (steps < cfg.max_steps) & ~jnp.all(settled_in)
+        st2 = {k: jnp.where(ok, v, st_in[k]) for k, v in st2.items()}
+        st2['_steps'] = jnp.where(ok, steps + 1, steps)
         return st2
 
     def body(carry):
@@ -1684,6 +1685,11 @@ def _run_batch(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
     st = _exec_loop(st0, soa, spc, interp, sync_part, meas_bits, meas_valid,
                     cfg, traits=traits)
     st.pop('paused', None)
+    # engine-independent output schema: the straight-line executor pops
+    # its internal stall carry too (_run_batch_sl_jit) — with every bit
+    # injected valid a lane can never wait, so the key carries no
+    # information on this path either way
+    st.pop('phys_wait', None)
     return _finalize(st, cfg)
 
 
@@ -1726,6 +1732,120 @@ def _run_batch_sl_jit(spc, interp, meas_bits, cfg, n_cores, init_regs,
                             meas_bits, meas_valid, cfg)
     st.pop('phys_wait', None)
     return _finalize(st, cfg)
+
+
+# trace probe for the shape-bucket contract (tests assert EXACTLY one
+# retrace per bucket): incremented at trace time, i.e. once per jit
+# cache miss of the multi-program executor
+_MULTI_TRACE_COUNT = 0
+
+
+def multi_trace_count() -> int:
+    """How many times the multi-program executor has been traced in
+    this process — a second same-shape ensemble must not move it."""
+    return _MULTI_TRACE_COUNT
+
+
+@functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'traits'))
+def _run_multi_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
+                         n_cores, init_regs, traits=None):
+    """Program-as-data ensemble execution: vmap the generic engine over
+    a leading program axis inside ONE jit.
+
+    ``soa`` ``[n_progs, n_cores, n_instr, F]`` and ``sync_part`` /
+    ``meas_bits`` / ``init_regs`` carry the program axis; ``spc`` /
+    ``interp`` are ensemble-shared per-core constants.  The program
+    tensor is a TRACED argument, so the jit cache keys on its SHAPE
+    (the bucket), not its content — an entire RB ensemble compiles
+    once, and fresh random sequences of the same shape are free.
+    ``traits`` must be the UNION over the ensemble
+    (:func:`program_traits` of the stacked program) so the shared step
+    body covers every member.
+    """
+    global _MULTI_TRACE_COUNT
+    _MULTI_TRACE_COUNT += 1
+
+    def one_program(s, sy, mb, ir):
+        return _run_batch(s, spc, interp, sy, mb, cfg, n_cores, ir,
+                          traits)
+
+    return jax.vmap(one_program)(soa, sync_part, meas_bits, init_regs)
+
+
+def simulate_multi_batch(mps, meas_bits, init_regs=None,
+                         cfg: InterpreterConfig = None, pad_to: int = None,
+                         **kw) -> dict:
+    """Execute N programs x B shots in one compiled call.
+
+    ``mps``: a list of :class:`~..decoder.MachineProgram` (stacked here
+    with shape-bucketed DONE padding — see ``decoder.
+    stack_machine_programs``) or an already-stacked
+    ``MultiMachineProgram``.  ``meas_bits``: ``[n_progs, n_shots,
+    n_cores, n_meas]``, or ``[n_shots, n_cores, n_meas]`` broadcast to
+    every program.  ``init_regs``: ``None``, ``[n_cores, 16]`` (shared),
+    ``[n_progs, n_cores, 16]`` (per program), or the full
+    ``[n_progs, n_shots, n_cores, 16]``.
+
+    When ``cfg`` is omitted, the execution budget derives from the
+    BUCKET shape (``max_steps = 2 * n_instr + 64``, ``max_pulses =
+    n_instr + 2``), never from per-program content — content-derived
+    budgets would retrace on every new ensemble and defeat the
+    amortization this path exists for.
+
+    Returns the :func:`simulate_batch` pytree with a leading program
+    axis on every leaf (``steps`` and ``incomplete`` become ``[n_progs]``).
+    Runs the generic engine only: the straight-line executor specializes
+    on program content, which is exactly the compile-per-sequence cost
+    being amortized away (``straightline=True`` raises).
+    """
+    from ..decoder import MultiMachineProgram, stack_machine_programs
+    mmp = mps if isinstance(mps, MultiMachineProgram) \
+        else stack_machine_programs(mps, pad_to=pad_to)
+    if cfg is None:
+        kw.setdefault('max_steps', 2 * mmp.n_instr + 64)
+        kw.setdefault('max_pulses', mmp.n_instr + 2)
+        cfg = InterpreterConfig(**kw)
+    else:
+        cfg = replace(cfg, **kw)
+    if cfg.straightline:
+        raise ValueError(
+            'simulate_multi_batch runs the generic engine only: the '
+            'straight-line executor keys its cache on program content, '
+            'the per-sequence compile this path amortizes away')
+    if cfg.straightline is None:
+        cfg = replace(cfg, straightline=False)
+    # _program_constants/program_traits consume the soa/tables attribute
+    # surface, which MultiMachineProgram mirrors with a program axis;
+    # traits become the UNION of instruction kinds over the ensemble
+    soa, spc, interp, sync_part = _program_constants(mmp, cfg)
+    P, C = mmp.n_progs, mmp.n_cores
+    meas_bits = _pad_meas(meas_bits, cfg.max_meas)
+    if meas_bits.ndim == 3:
+        meas_bits = jnp.broadcast_to(meas_bits[None],
+                                     (P,) + tuple(meas_bits.shape))
+    if meas_bits.ndim != 4 or meas_bits.shape[0] != P \
+            or meas_bits.shape[2] != C:
+        raise ValueError(
+            f'meas_bits must be [n_progs={P}, n_shots, n_cores={C}, '
+            f'n_meas]; got {tuple(meas_bits.shape)}')
+    B = meas_bits.shape[1]
+    if init_regs is None:
+        init_regs = jnp.zeros((P, B, C, isa.N_REGS), jnp.int32)
+    else:
+        init_regs = jnp.asarray(init_regs, jnp.int32)
+        if init_regs.ndim == 2:          # [C, R] shared by everything
+            init_regs = jnp.broadcast_to(init_regs[None, None],
+                                         (P, B) + tuple(init_regs.shape))
+        elif init_regs.ndim == 3:        # [P, C, R] per program
+            if init_regs.shape[0] != P:
+                raise ValueError(
+                    f'3-D init_regs must be [n_progs={P}, n_cores, '
+                    f'n_regs] (per-shot registers need the full 4-D '
+                    f'form); got {tuple(init_regs.shape)}')
+            init_regs = jnp.broadcast_to(
+                init_regs[:, None], (P, B) + tuple(init_regs.shape[1:]))
+    return _run_multi_batch_jit(soa, spc, interp, sync_part, meas_bits,
+                                cfg, C, init_regs, program_traits(mmp))
 
 
 def _pad_meas(meas_bits, max_meas: int):

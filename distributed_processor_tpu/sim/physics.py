@@ -1236,11 +1236,14 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         raise ValueError(f'noise_ar1={model.noise_ar1} must be in [0, 1)')
     if model.g2 is not None and (
             model.device.kind != 'statevec'
-            or not np.any(np.asarray(model.device.leak_per_pulse,
-                                     np.float64))):
+            or not (np.any(np.asarray(model.device.leak_per_pulse,
+                                      np.float64))
+                    or np.any(np.asarray(model.device.leak2_per_pulse,
+                                         np.float64)))):
         raise ValueError(
             'g2 (the |2> IQ response) needs device=statevec with '
-            'leak_per_pulse > 0 — no leakage channel, no |2> population')
+            'leak_per_pulse > 0 or leak2_per_pulse > 0 — no leakage '
+            'channel, no |2> population')
     if model.classify3 and model.g2 is None:
         raise ValueError(
             'classify3 (3-class discrimination) needs g2 (the |2> '

@@ -211,6 +211,12 @@ def test_iq_leakage_validation(sim2):
             classify3=True,
             device=DeviceModel('statevec', leak_per_pulse=0.1)),
             0, 1, **KW)
+    # a leak2-only device still has a |2> population channel (the
+    # coupling-pulse mechanism) — g2 must be accepted, not rejected
+    out = run_physics_batch(mp, ReadoutPhysics(
+        g2=1.0j, device=DeviceModel('statevec', leak2_per_pulse=0.1)),
+        0, 1, **KW)
+    assert not bool(out['incomplete'])
 
 
 def test_cr_leak_accumulates_exactly(sim2):

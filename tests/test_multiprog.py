@@ -1,0 +1,212 @@
+"""Program-as-data multi-sequence execution (``simulate_multi_batch``).
+
+The contract under test: N compiled programs stacked into one
+``[n_progs, n_cores, n_instr]`` SoA tensor, DONE-padded into a shape
+bucket and vmapped over the generic engine inside ONE jit, produce
+bit-identical results to running each program alone — and the jit cache
+keys on the BUCKET SHAPE, not program content, so a second ensemble of
+fresh random sequences in the same bucket triggers no retrace.
+"""
+
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.decoder import (MultiMachineProgram,
+                                               stack_machine_programs)
+from distributed_processor_tpu.models import (active_reset,
+                                              make_default_qchip,
+                                              rb_ensemble)
+from distributed_processor_tpu.pipeline import compile_to_machine
+from distributed_processor_tpu.sim.interpreter import (
+    InterpreterConfig, multi_trace_count, simulate_batch,
+    simulate_multi_batch)
+
+
+def _ensemble(n_qubits, depth, n_seqs, seed):
+    qubits = [f'Q{i}' for i in range(n_qubits)]
+    qchip = make_default_qchip(n_qubits)
+    return [compile_to_machine(active_reset(qubits) + prog, qchip,
+                               n_qubits=n_qubits)
+            for prog in rb_ensemble(qubits, depth, n_seqs, seed=seed)]
+
+
+def _bucket_cfg(mmp, **kw):
+    return InterpreterConfig(max_steps=2 * mmp.n_instr + 64,
+                             max_pulses=mmp.n_instr + 2,
+                             max_meas=2, max_resets=2, **kw)
+
+
+def test_shape_bucket():
+    assert isa.shape_bucket(1) == 8
+    assert isa.shape_bucket(8) == 8
+    assert isa.shape_bucket(9) == 16
+    assert isa.shape_bucket(64) == 64
+    assert isa.shape_bucket(65) == 128
+    with pytest.raises(ValueError):
+        isa.shape_bucket(0)
+
+
+def test_stack_validates_core_count():
+    mps = _ensemble(2, 1, 1, seed=0) + _ensemble(3, 1, 1, seed=0)
+    with pytest.raises(ValueError, match='core-count'):
+        stack_machine_programs(mps)
+
+
+def test_stacked_ensemble_shape_and_padding():
+    # deliberately mixed depths: the shorter member must be DONE-padded
+    mps = _ensemble(2, 2, 2, seed=3) + _ensemble(2, 1, 1, seed=4)
+    mmp = stack_machine_programs(mps)
+    assert isinstance(mmp, MultiMachineProgram)
+    assert mmp.n_progs == 3
+    assert mmp.n_cores == mps[0].n_cores
+    assert mmp.n_instr == isa.shape_bucket(max(m.n_instr for m in mps))
+    kind = np.asarray(mmp.soa.kind)
+    for i, mp in enumerate(mps):
+        np.testing.assert_array_equal(kind[i, :, :mp.n_instr],
+                                      np.asarray(mp.soa.kind))
+        assert np.all(kind[i, :, mp.n_instr:] == isa.K_DONE)
+
+
+def test_multi_equals_per_program_both_engines():
+    """Bit-identity of the stacked ensemble against per-program runs on
+    BOTH engines — including a shorter DONE-padded member, whose padding
+    must be semantically invisible."""
+    mps = _ensemble(2, 2, 2, seed=5) + _ensemble(2, 1, 1, seed=6)
+    mmp = stack_machine_programs(mps)
+    cfg = _bucket_cfg(mmp)
+    rng = np.random.default_rng(7)
+    bits = rng.integers(0, 2,
+                        size=(3, 16, mmp.n_cores, 2)).astype(np.int32)
+    multi = simulate_multi_batch(mmp, bits, cfg=cfg)
+    for i, mp in enumerate(mps):
+        gen = simulate_batch(mp, bits[i],
+                             cfg=replace(cfg, straightline=False))
+        sl = simulate_batch(mp, bits[i],
+                            cfg=replace(cfg, straightline=True))
+        assert set(gen) == set(sl) == set(multi)
+        for k in gen:
+            got = np.asarray(multi[k])
+            got_i = got[i] if got.ndim else got
+            np.testing.assert_array_equal(
+                got_i, np.asarray(gen[k]), err_msg=f'prog {i} gen: {k}')
+            if k != 'steps':    # engine iteration count, not semantics
+                np.testing.assert_array_equal(
+                    got_i, np.asarray(sl[k]), err_msg=f'prog {i} sl: {k}')
+        assert not bool(np.asarray(multi['incomplete'])[i])
+
+
+def test_meas_bits_broadcast_and_init_regs_forms():
+    mps = _ensemble(2, 1, 2, seed=8)
+    mmp = stack_machine_programs(mps)
+    cfg = _bucket_cfg(mmp)
+    rng = np.random.default_rng(9)
+    shared = rng.integers(0, 2,
+                          size=(8, mmp.n_cores, 2)).astype(np.int32)
+    out = simulate_multi_batch(mmp, shared, cfg=cfg)
+    per = simulate_multi_batch(
+        mmp, np.broadcast_to(shared[None], (2,) + shared.shape), cfg=cfg)
+    for k in out:
+        np.testing.assert_array_equal(np.asarray(out[k]),
+                                      np.asarray(per[k]), err_msg=k)
+    # per-program [P, C, R] registers broadcast over shots
+    regs = np.zeros((2, mmp.n_cores, isa.N_REGS), np.int32)
+    out2 = simulate_multi_batch(mmp, shared, init_regs=regs, cfg=cfg)
+    np.testing.assert_array_equal(np.asarray(out2['regs']),
+                                  np.asarray(out['regs']))
+    with pytest.raises(ValueError, match='n_progs'):
+        simulate_multi_batch(
+            mmp, rng.integers(0, 2, size=(3, 8, mmp.n_cores, 2)),
+            cfg=cfg)
+
+
+def test_straightline_cfg_rejected():
+    mps = _ensemble(2, 1, 2, seed=10)
+    mmp = stack_machine_programs(mps)
+    with pytest.raises(ValueError, match='generic engine'):
+        simulate_multi_batch(
+            mmp, np.zeros((2, 4, mmp.n_cores, 2), np.int32),
+            cfg=_bucket_cfg(mmp, straightline=True))
+
+
+def test_same_shape_ensemble_no_retrace():
+    """The acceptance contract: EXACTLY one retrace per shape bucket.
+    A second ensemble of fresh random sequences in the same bucket must
+    reuse the compiled executable; a different bucket traces once."""
+    mps_a = _ensemble(2, 2, 3, seed=21)
+    mps_b = _ensemble(2, 2, 3, seed=99)      # fresh random content
+    mmp_a = stack_machine_programs(mps_a)
+    mmp_b = stack_machine_programs(mps_b)
+    assert mmp_a.n_instr == mmp_b.n_instr    # same depth -> same bucket
+    rng = np.random.default_rng(13)
+    bits = rng.integers(0, 2,
+                        size=(3, 8, mmp_a.n_cores, 2)).astype(np.int32)
+    cfg = _bucket_cfg(mmp_a)
+    c0 = multi_trace_count()
+    out_a = simulate_multi_batch(mmp_a, bits, cfg=cfg)
+    c1 = multi_trace_count()
+    out_b = simulate_multi_batch(mmp_b, bits, cfg=cfg)
+    c2 = multi_trace_count()
+    assert c1 - c0 <= 1                      # 1, or 0 if already warm
+    assert c2 == c1, 'same-shape ensemble retraced'
+    # fresh random content flows through the SHARED executable: the
+    # recorded pulse phases differ, while the structural outputs (every
+    # Clifford is exactly two pulses, bits are injected) coincide
+    assert not np.array_equal(np.asarray(out_a['rec_phase']),
+                              np.asarray(out_b['rec_phase']))
+    for k in ('n_pulses', 'incomplete'):
+        np.testing.assert_array_equal(np.asarray(out_a[k]),
+                                      np.asarray(out_b[k]), err_msg=k)
+    # a deeper ensemble lands in a different bucket: exactly one more
+    mps_c = _ensemble(2, 14, 3, seed=21)
+    mmp_c = stack_machine_programs(mps_c)
+    assert mmp_c.n_instr != mmp_a.n_instr, 'depths chose the same bucket'
+    simulate_multi_batch(mmp_c, bits, cfg=_bucket_cfg(mmp_c))
+    assert multi_trace_count() == c2 + 1
+
+
+def test_bucket_cfg_defaults_key_on_bucket_not_content():
+    """Omitting cfg derives the execution budget from the BUCKET, so two
+    same-bucket ensembles share the default cfg too (a content-derived
+    budget would silently retrace and defeat the amortization)."""
+    mps_a = _ensemble(2, 2, 2, seed=31)
+    mps_b = _ensemble(2, 2, 2, seed=32)
+    mmp_a = stack_machine_programs(mps_a)
+    mmp_b = stack_machine_programs(mps_b)
+    bits = np.zeros((2, 4, mmp_a.n_cores, 2), np.int32)
+    simulate_multi_batch(mmp_a, bits, max_meas=2, max_resets=2)
+    c = multi_trace_count()
+    simulate_multi_batch(mmp_b, bits, max_meas=2, max_resets=2)
+    assert multi_trace_count() == c
+
+
+def test_run_multi_sweep_resumes(tmp_path):
+    """Driver-level ensemble sweep: one-shot run == checkpointed
+    two-stage run, and a swapped ensemble is rejected on resume."""
+    from distributed_processor_tpu.parallel import run_multi_sweep
+    mps = _ensemble(2, 1, 2, seed=41)
+    full = run_multi_sweep(mps, total_shots=8, batch=4, p1=0.5, key=3,
+                           max_meas=2, max_resets=2)
+    assert full['mean_pulses'].shape == (2, mps[0].n_cores)
+    assert full['err_rate'].shape == (2,)
+    assert full['shots'] == 8 and full['incomplete_batches'] == 0
+    ckpt = str(tmp_path / 'multi.npz')
+    # stage 1: first batch only, then resume to the full shot count
+    run_multi_sweep(mps, total_shots=4, batch=4, p1=0.5, key=3,
+                    checkpoint=ckpt, max_meas=2, max_resets=2)
+    resumed = run_multi_sweep(mps, total_shots=8, batch=4, p1=0.5, key=3,
+                              checkpoint=ckpt, max_meas=2, max_resets=2)
+    for k in ('mean_pulses', 'err_rate', 'mean_qclk'):
+        np.testing.assert_allclose(resumed[k], full[k], err_msg=k)
+    # asking for LESS than the checkpoint holds is a caller error
+    with pytest.raises(ValueError, match='holds'):
+        run_multi_sweep(mps, total_shots=4, batch=4, p1=0.5, key=3,
+                        checkpoint=ckpt, max_meas=2, max_resets=2)
+    # a different ensemble must not resume this checkpoint
+    other = _ensemble(2, 1, 2, seed=55)
+    with pytest.raises(ValueError):
+        run_multi_sweep(other, total_shots=12, batch=4, p1=0.5, key=3,
+                        checkpoint=ckpt, strict_resume=True,
+                        max_meas=2, max_resets=2)

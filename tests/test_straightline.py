@@ -56,6 +56,31 @@ def test_injected_bits_equality(bench_mp):
                                       np.asarray(sl[k]), err_msg=k)
 
 
+def test_injected_bits_equality_physics_cfg(bench_mp):
+    """Engine-independent output SCHEMA under a physics cfg on the
+    injected-bits path: the generic engine used to leak its internal
+    ``phys_wait`` stall carry where the straight-line executor popped
+    it, so the key set depended on which engine ran.  Values must match
+    too — with every bit injected valid no lane ever stalls, so the
+    physics co-state evolves identically."""
+    mp = bench_mp
+    rng = np.random.default_rng(2)
+    bits = rng.integers(0, 2, size=(16, mp.n_cores, 2))
+    outs = {}
+    for slf in (False, True):
+        outs[slf] = simulate_batch(
+            mp, bits, cfg=_cfg(mp, physics=True, straightline=slf))
+    assert set(outs[False]) == set(outs[True])
+    assert 'phys_wait' not in outs[False]
+    assert 'paused' not in outs[False]
+    for k in outs[False]:
+        if k == 'steps':
+            continue
+        np.testing.assert_array_equal(np.asarray(outs[False][k]),
+                                      np.asarray(outs[True][k]),
+                                      err_msg=k)
+
+
 _PHYSICS_EQ_BODY = '''
 import numpy as np
 import jax
