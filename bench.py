@@ -33,7 +33,13 @@ batch, default 262144; 524288 also fits HBM with the stats-only carry —
 see docs/PERF.md for the budget), BENCH_DEPTH (RB depth, default 12),
 BENCH_SIGMA (ADC noise, default 0.05), BENCH_CHUNK (matched-filter
 resolve chunk in samples, default 256 — smaller trades speed for peak
-memory).
+memory), BENCH_SWEEP_SHOTS/BENCH_SWEEP_BATCH/BENCH_SWEEP_SPAN (the
+dispatch-amortization row's sweep shape, defaults 131072/2048/16).
+
+Besides the final stdout line, every completed row is written
+incrementally and atomically to BENCH_ARTIFACT (default
+bench_partial.json next to this script; set empty to disable), so a
+killed or hung row cannot erase the rows already measured.
 
 The detail dict also reports `fused_pallas_shots_per_sec` (the same
 chain hand-fused into one Pallas kernel with in-kernel counter-based
@@ -110,6 +116,36 @@ def _cache_state() -> str:
 def _fmt_sps(v):
     """Secondary shots/s: number, error string, or None (not measured)."""
     return round(v, 1) if isinstance(v, float) else v
+
+
+class _ArtifactWriter:
+    """Incremental bench evidence: every completed row atomically
+    rewrites the artifact JSON (tmp + os.replace, the ``save_results``
+    discipline from utils/results.py), so a later row that hangs or is
+    killed can never erase what already finished — BENCH_r05 shipped
+    ``rc=2, value=0`` after one tunnel blip wiped the whole round.
+
+    ``BENCH_ARTIFACT`` names the file (default ``bench_partial.json``
+    next to this script); set it empty to disable.  A write failure is
+    reported on stderr but never kills the bench: the stdout JSON line
+    stays the primary output.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.doc: dict = {}
+
+    def row(self, name: str, value) -> None:
+        self.doc[name] = value
+        if not self.path:
+            return
+        try:
+            tmp = self.path + '.tmp'
+            with open(tmp, 'w') as f:
+                json.dump(self.doc, f, indent=1)
+            os.replace(tmp, self.path)
+        except OSError as e:        # pragma: no cover - defensive
+            print(f'artifact write failed: {e}', file=sys.stderr)
 
 
 def build_machine_program(n_qubits: int, depth: int):
@@ -307,6 +343,85 @@ def multi_sequence_rb(n_qubits: int, depth: int, n_seqs: int = 16,
         'note': 'wall-clock including compile; baseline re-jits per '
                 'sequence (content-keyed), multi compiles once per '
                 'shape bucket and fresh same-shape ensembles are free',
+    }
+
+
+def sweep_span_amortization(n_qubits: int, shots: int, batch: int,
+                            span: int, sigma: float):
+    """Dispatch-amortization row: the SAME physics-closed sweep through
+    ``run_physics_sweep`` twice — per-batch host loop (``span=1``: one
+    dispatch + one stats transfer per batch) vs spanned (``span=K``
+    batches per ``lax.scan`` dispatch with a donated on-device carry,
+    pipelined 1 deep) — on a deliberately dispatch-bound shape (small
+    batch, many batches).  ``DispatchTimer`` splits the per-batch hot
+    path's wall time into dispatch / device / transfer, making the
+    round-5 "the fixed cost is dispatch/tunnel latency, not device
+    time" diagnosis reproducible with one call.  The two executions'
+    statistics are asserted bit-identical.
+
+    Each sweep is timed twice: cold includes the trace+compile the
+    drivers pay per call, warm (second call, persistent compilation
+    cache hot) isolates the dispatch economics being measured.
+    """
+    from distributed_processor_tpu.parallel import run_physics_sweep
+    from distributed_processor_tpu.parallel.sweep import physics_batch_stats
+    from distributed_processor_tpu.utils.profiling import DispatchTimer
+    if shots % batch:
+        shots = (shots // batch) * batch
+    n_batches = shots // batch
+    mp = build_machine_program(n_qubits, 2)     # shallow: dispatch-bound
+    cfg = InterpreterConfig(
+        max_steps=2 * mp.n_instr + 64,
+        max_pulses=int(mp.max_pulses_per_core(1)) + 4,
+        max_meas=2, max_resets=2, record_pulses=False)
+    model = ReadoutPhysics(sigma=sigma, p1_init=0.1)
+
+    # instrument the exact per-batch step the span amortizes (the
+    # driver's own construction: prepared tables passed as device args)
+    tables = prepare_physics_tables(mp, model)
+
+    @jax.jit
+    def step(k, tabs):
+        out = run_physics_batch(mp, model, k, batch, cfg=cfg,
+                                tables=tabs)
+        return dict(physics_batch_stats(out),
+                    incomplete=out['incomplete'].astype(jnp.int32))
+
+    key = jax.random.PRNGKey(7)
+    jax.block_until_ready(step(key, tables))    # compile outside timing
+    timer = DispatchTimer()
+    for i in range(min(n_batches, 32)):
+        timer.step(lambda: step(jax.random.fold_in(key, i), tables))
+
+    def timed(**kw):
+        t0 = time.perf_counter()
+        out = run_physics_sweep(mp, model, shots, batch, key=7, cfg=cfg,
+                                **kw)
+        return out, time.perf_counter() - t0
+
+    loop, t_loop = timed()
+    spanned, t_span = timed(span=span)
+    _, t_loop_warm = timed()
+    _, t_span_warm = timed(span=span)
+    for k in loop:
+        assert np.array_equal(np.asarray(loop[k]),
+                              np.asarray(spanned[k])), \
+            f'spanned sweep diverged from the per-batch loop on {k!r}'
+
+    return {
+        'n_qubits': n_qubits, 'shots': shots, 'batch': batch,
+        'n_batches': n_batches, 'span': span,
+        'dispatches_loop': n_batches,
+        'dispatches_span': -(-n_batches // span),
+        'loop_s': round(t_loop, 3), 'span_s': round(t_span, 3),
+        'speedup': round(t_loop / t_span, 2),
+        'loop_warm_s': round(t_loop_warm, 3),
+        'span_warm_s': round(t_span_warm, 3),
+        'warm_speedup': round(t_loop_warm / t_span_warm, 2),
+        'per_batch_breakdown': timer.breakdown(),
+        'stats_identical': True,
+        'note': 'same fold_in(key, i) stream both ways; spanned stats '
+                'asserted bit-identical to the host loop',
     }
 
 
@@ -600,7 +715,12 @@ def _preflight(timeouts=(30.0, 60.0, 120.0)):
 
 def main():
     enable_compilation_cache()
+    artifact = _ArtifactWriter(os.environ.get(
+        'BENCH_ARTIFACT',
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     'bench_partial.json')))
     preflight = _preflight()
+    artifact.row('preflight', preflight)
     n_qubits = int(os.environ.get('BENCH_QUBITS', 8))
     depth = int(os.environ.get('BENCH_DEPTH', 12))
     total_shots = int(os.environ.get('BENCH_SHOTS', 1048576))
@@ -765,6 +885,10 @@ def main():
     elapsed = time.perf_counter() - t0
     assert not incomplete, \
         f'{incomplete} batches did not complete within max_steps'
+    artifact.row('headline', {
+        'shots_per_sec': round(total_shots / elapsed, 1),
+        'run_s': round(elapsed, 3), 'total_shots': total_shots,
+        'batch': batch, 'mode': headline_mode, 'device': bench_device})
 
     # Cross-mode/device comparisons, VARIANCE-CONTROLLED (round-3 weak
     # #1): the tunneled device times +-30% run-to-run, so sequential
@@ -877,6 +1001,7 @@ def main():
     ref = 'headline:' + headline_mode
     probe_ratios = {f'{n}/{headline_mode}': _ratio(n, ref)
                     for n, _m, _d in probe_specs[1:]}
+    artifact.row('probes_interleaved', probe_sps)
 
     # legacy secondary keys, fed from the interleaved medians; a probe
     # that errored mid-run surfaces its error here (its partial median
@@ -910,10 +1035,12 @@ def main():
                 batch / p['sps_median'])
         except Exception as e:  # pragma: no cover - defensive
             sv_utils[nm] = {'error': f'{type(e).__name__}: {e}'[:200]}
+    artifact.row('utilization', utilization)
     try:
         scaling = large_program_scaling(n_qubits, small_depth=depth)
     except Exception as e:      # pragma: no cover - defensive
         scaling = {'error': f'{type(e).__name__}: {e}'[:200]}
+    artifact.row('scaling', scaling)
     # multi-sequence RB: the compile-amortization row (program-as-data
     # ensemble in one shape-bucketed jit vs per-sequence content-keyed
     # compiles) — guarded like every secondary
@@ -924,6 +1051,19 @@ def main():
             shots=int(os.environ.get('BENCH_MULTI_SHOTS', 4096)))
     except Exception as e:      # pragma: no cover - defensive
         multi_rb = {'error': f'{type(e).__name__}: {e}'[:200]}
+    artifact.row('multi_sequence_rb', multi_rb)
+    # dispatch-amortization row: host loop vs device-resident span on a
+    # dispatch-bound sweep shape — guarded like every secondary
+    try:
+        sweep_span = sweep_span_amortization(
+            n_qubits,
+            shots=int(os.environ.get('BENCH_SWEEP_SHOTS', 131072)),
+            batch=int(os.environ.get('BENCH_SWEEP_BATCH', 2048)),
+            span=int(os.environ.get('BENCH_SWEEP_SPAN', 16)),
+            sigma=sigma)
+    except Exception as e:      # pragma: no cover - defensive
+        sweep_span = {'error': f'{type(e).__name__}: {e}'[:200]}
+    artifact.row('sweep_span', sweep_span)
 
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
@@ -964,6 +1104,7 @@ def main():
             'statevec_utilization': sv_utils or None,
             'scaling': scaling,
             'multi_sequence_rb': multi_rb,
+            'sweep_span': sweep_span,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,
@@ -971,6 +1112,7 @@ def main():
             'device': str(jax.devices()[0]),
         },
     }
+    artifact.row('result', result)
     print(json.dumps(result))
 
 

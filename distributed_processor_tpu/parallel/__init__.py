@@ -1,7 +1,8 @@
 from .mesh import make_mesh, shot_sharding
 from .driver import run_physics_sweep, run_multi_sweep
 from .sweep import (sharded_simulate, sweep_stats, sharded_demod,
-                    sharded_physics_stats, sharded_multi_stats)
+                    sharded_physics_stats, sharded_multi_stats,
+                    run_spanned)
 from .param_sweep import (swept_pulse_machine_program, grid_init_regs,
                           sweep_cfg, AMP_REG, FREQ_REG)
 from .multihost import (initialize_multihost, make_global_mesh,

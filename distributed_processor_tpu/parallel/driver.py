@@ -90,8 +90,8 @@ def _sweep_fingerprint(mp, model, batch: int, key, cfg,
 def run_physics_sweep(mp, model, total_shots: int, batch: int,
                       key=0, cfg: InterpreterConfig = None,
                       init_regs=None, checkpoint: str = None,
-                      checkpoint_every: int = 0, mesh=None,
-                      strict_resume: bool = False,
+                      checkpoint_every: int = 0, span: int = 1,
+                      mesh=None, strict_resume: bool = False,
                       **cfg_kw) -> dict:
     """Physics-closed sweep: ``total_shots`` in ``batch``-sized steps.
 
@@ -114,6 +114,19 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     (``[n_cores, 16]``) — sweep axes inside a batch come from
     register-parameterized programs (see ``decoder.make_init_regs``).
 
+    ``span``: batches folded into ONE device dispatch (a ``lax.scan``
+    over batch indices with an on-device donated stats carry — see
+    ``sim.interpreter.make_span_runner``), amortizing per-call
+    dispatch/tunnel latency; spans are pipelined 1 deep so host
+    checkpoint writes overlap device compute.  Bit-identical to the
+    per-batch loop (``span=1``, the default): the same ``fold_in(key,
+    i)`` stream folds into the same int32 sums.  Span is an execution
+    strategy, not sweep identity — it does not enter the checkpoint
+    fingerprint, so checkpoints are interchangeable across span
+    choices; ``checkpoint_every`` stays in BATCH units, with writes
+    snapping to span edges (grid-aligned, so a resume landing mid-span
+    first completes its span cell).
+
     Returns ``{'shots', 'mean_pulses' [C], 'meas1_rate' [C],
     'survival00_rate' (joint P(every first-slot bit reads 0) — the
     multi-qubit RB survival), 'err_shots', 'incomplete_batches'}``.
@@ -129,6 +142,8 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     if total_shots % batch:
         raise ValueError(f'total_shots {total_shots} not divisible by '
                          f'batch {batch}')
+    if span < 1:
+        raise ValueError(f'span must be >= 1, got {span}')
     n_batches = total_shots // batch
     if isinstance(key, int):
         key = jax.random.PRNGKey(key)
@@ -190,11 +205,18 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
         raise ValueError(
             f'checkpoint already holds {acc.n_batches} batches '
             f'({acc.n_batches * batch} shots) > requested {total_shots}')
-    for i in range(acc.n_batches, n_batches):
-        # key derived from the batch INDEX, not a split chain: resuming
-        # from batch i reproduces the same stream
-        stats = step(jax.random.fold_in(key, i))
-        acc.add({k: np.asarray(v) for k, v in stats.items()})
+    if span > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .sweep import run_spanned
+        run_spanned(step, acc, key, n_batches, span,
+                    out_sharding=(NamedSharding(mesh, P())
+                                  if mesh is not None else None))
+    else:
+        for i in range(acc.n_batches, n_batches):
+            # key derived from the batch INDEX, not a split chain:
+            # resuming from batch i reproduces the same stream
+            stats = step(jax.random.fold_in(key, i))
+            acc.add({k: np.asarray(v) for k, v in stats.items()})
     if checkpoint:
         acc.save()
 
@@ -258,8 +280,9 @@ def _ensemble_fingerprint(mmp, batch: int, key, cfg, init_regs, p1,
 def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
                     key=0, cfg: InterpreterConfig = None,
                     init_regs=None, checkpoint: str = None,
-                    checkpoint_every: int = 0, mesh=None,
-                    strict_resume: bool = False, **cfg_kw) -> dict:
+                    checkpoint_every: int = 0, span: int = 1,
+                    mesh=None, strict_resume: bool = False,
+                    **cfg_kw) -> dict:
     """Injected-bits sweep over a PROGRAM ENSEMBLE: ``total_shots`` per
     program in ``batch``-sized steps, every batch one execution of the
     shape-bucketed multi-program executable (all ensemble members vmapped
@@ -278,8 +301,14 @@ def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
     resuming with a changed, reordered, or re-padded ensemble fails
     loudly.
 
+    ``span`` folds that many batches into one device dispatch exactly
+    as in :func:`run_physics_sweep` — bit-identical stats, checkpoint
+    writes snapping to span edges, span absent from the fingerprint.
+
     Returns per-program arrays: ``mean_pulses [n_progs, n_cores]``,
-    ``err_rate [n_progs]``, ``mean_qclk [n_progs, n_cores]``, plus
+    ``err_rate [n_progs]``, ``err_shots [n_progs]`` (the summed int
+    numerator behind ``err_rate`` — clean accounting matching
+    ``run_physics_sweep``), ``mean_qclk [n_progs, n_cores]``, plus
     ``shots`` (per program) and ``incomplete_batches``.
     """
     from dataclasses import replace
@@ -301,6 +330,8 @@ def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
     if total_shots % batch:
         raise ValueError(f'total_shots {total_shots} not divisible by '
                          f'batch {batch}')
+    if span < 1:
+        raise ValueError(f'span must be >= 1, got {span}')
     n_batches = total_shots // batch
     if isinstance(key, int):
         key = jax.random.PRNGKey(key)
@@ -372,9 +403,16 @@ def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
             f'checkpoint already holds {acc.n_batches} batches '
             f'({acc.n_batches * batch} shots/program) > requested '
             f'{total_shots}')
-    for i in range(acc.n_batches, n_batches):
-        stats = step(jax.random.fold_in(key, i))
-        acc.add({k: np.asarray(v) for k, v in stats.items()})
+    if span > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .sweep import run_spanned
+        run_spanned(step, acc, key, n_batches, span,
+                    out_sharding=(NamedSharding(mesh, P())
+                                  if mesh is not None else None))
+    else:
+        for i in range(acc.n_batches, n_batches):
+            stats = step(jax.random.fold_in(key, i))
+            acc.add({k: np.asarray(v) for k, v in stats.items()})
     if checkpoint:
         acc.save()
 
@@ -392,6 +430,9 @@ def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
         'n_progs': n_progs,
         'mean_pulses': acc.state['pulse_sum'] / shots_done,
         'err_rate': acc.state['err_shots'] / shots_done,
+        # the integer numerator behind err_rate, per program — exact
+        # accounting a rate cannot carry (run_physics_sweep parity)
+        'err_shots': np.asarray(acc.state['err_shots']).copy(),
         'mean_qclk': acc.state['qclk_sum'] / shots_done,
         'incomplete_batches': incomplete,
     }

@@ -10,14 +10,28 @@ shots, and summary statistics come back through ``psum`` over ICI.
 from __future__ import annotations
 
 import functools
+import inspect
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 try:
-    from jax import shard_map
+    from jax import shard_map as _shard_map
 except ImportError:      # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check kwarg was renamed check_rep -> check_vma across
+# jax versions; every caller here uses the new name, so translate it
+# when running on a jax that only knows the old one
+if 'check_vma' in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:                    # pragma: no cover - depends on jax version
+    @functools.wraps(_shard_map)
+    def shard_map(f, *args, check_vma=None, **kw):
+        if check_vma is not None:
+            kw['check_rep'] = check_vma
+        return _shard_map(f, *args, **kw)
 
 from .. import isa
 from ..sim.interpreter import (InterpreterConfig, _program_constants,
@@ -260,3 +274,55 @@ def sharded_demod(adc, weights, mesh):
                    out_specs=P('dp'), check_vma=False)
     return jax.jit(fn)(jnp.asarray(adc, jnp.float32),
                        jnp.asarray(weights, jnp.float32))
+
+
+def run_spanned(step, acc, key, n_batches: int, span: int,
+                out_sharding=None) -> None:
+    """Drive a per-batch stats ``step`` (``key -> pytree of int32
+    sums``) from ``acc.n_batches`` up to ``n_batches`` with ``span``
+    batches folded into each dispatch
+    (:func:`..sim.interpreter.make_span_runner`), pipelined 1 deep:
+    span ``j+1`` is dispatched BEFORE span ``j``'s sums are fetched, so
+    the host-side fold and checkpoint write of span ``j`` overlap span
+    ``j+1``'s device execution.
+
+    Span starts stay on the ABSOLUTE batch grid (indices that are
+    multiples of ``span``): a resume landing mid-span first runs the
+    partial span completing its grid cell, so checkpoint boundaries —
+    and the set of compiled span sizes (at most full + leading partial
+    + trailing partial) — are independent of where a previous run
+    stopped.
+
+    Two carry buffers ping-pong through the runner: each is donated to
+    a dispatch, fetched to host numpy only after the NEXT dispatch is
+    in flight, and re-donated only after that fetch — no buffer is read
+    after donation.  ``out_sharding`` (e.g. ``NamedSharding(mesh,
+    P())`` for a psum-reduced mesh step) places the initial carries
+    where the step's outputs live, so donation can alias them.
+    """
+    from ..sim.interpreter import make_span_runner
+    runner = make_span_runner(step)
+    shapes = jax.eval_shape(step, key)
+
+    def make_carry():
+        zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             shapes)
+        return zeros if out_sharding is None \
+            else jax.device_put(zeros, out_sharding)
+
+    donors = [make_carry(), make_carry()]
+    in_flight = None
+    i = acc.n_batches
+    while i < n_batches:
+        size = min(span - i % span, n_batches - i)
+        cur = runner(donors.pop(0), key, jnp.int32(i), span=size)
+        if in_flight is not None:
+            stats, n = in_flight
+            host = {k: np.asarray(v) for k, v in stats.items()}
+            donors.append(stats)          # re-donate AFTER the fetch
+            acc.add_span(host, n)         # overlaps `cur` on device
+        in_flight = (cur, size)
+        i += size
+    if in_flight is not None:
+        stats, n = in_flight
+        acc.add_span({k: np.asarray(v) for k, v in stats.items()}, n)

@@ -1772,6 +1772,61 @@ def _run_multi_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
     return jax.vmap(one_program)(soa, sync_part, meas_bits, init_regs)
 
 
+# trace probe for the span contract (tests assert every FULL span of a
+# sweep shares ONE compiled executable): incremented at trace time,
+# i.e. once per jit cache miss of a span runner
+_SPAN_TRACE_COUNT = 0
+
+
+def span_trace_count() -> int:
+    """How many times any span runner has been traced in this process —
+    a sweep whose span divides its batch count must move it by one."""
+    return _SPAN_TRACE_COUNT
+
+
+def make_span_runner(step):
+    """Wrap a per-batch stats step (``key -> pytree of sums``) into a
+    span runner: ONE dispatch executes ``span`` consecutive batches
+    inside a ``lax.scan`` whose body derives each batch's key from the
+    batch INDEX (``fold_in(key, start + j)`` computed in-carry — the
+    same per-index stream as the host loop, so spanning and resuming
+    reproduce it bit for bit) and folds the per-batch sums into an
+    on-device carry.  Only the folded sums reach the host: one dispatch
+    and one transfer per span instead of per batch.
+
+    The carry argument is DONATED: the runner writes its output into
+    the caller-provided stats buffers, so the accumulator never
+    reallocates across spans — callers ping-pong two buffers,
+    re-donating each only after fetching it to host
+    (``parallel.sweep.run_spanned``).  Its VALUES are ignored (the scan
+    starts from zeros built at trace time); only shapes/dtypes/sharding
+    matter.  Never read a buffer after donating it: CPU tolerates that,
+    TPU does not.
+
+    ``span`` is static, so every full span of a sweep shares one
+    compiled executable (``span_trace_count`` probes this) and a
+    partial span at a resume point or the tail costs at most one trace
+    each.  Bit-identity with the per-batch host loop holds because
+    every accumulated stat is int32, whose addition is associative.
+    """
+    @functools.partial(jax.jit, static_argnames=('span',),
+                       donate_argnums=(0,))
+    def run_span(carry_in, key, start, span: int):
+        global _SPAN_TRACE_COUNT
+        _SPAN_TRACE_COUNT += 1
+
+        def body(carry, i):
+            stats = step(jax.random.fold_in(key, i))
+            return jax.tree.map(jnp.add, carry, stats), None
+
+        init = jax.tree.map(jnp.zeros_like, carry_in)
+        out, _ = jax.lax.scan(
+            body, init, start + jnp.arange(span, dtype=jnp.int32))
+        return out
+
+    return run_span
+
+
 def simulate_multi_batch(mps, meas_bits, init_regs=None,
                          cfg: InterpreterConfig = None, pad_to: int = None,
                          **kw) -> dict:

@@ -10,6 +10,8 @@ from __future__ import annotations
 import contextlib
 import time
 
+import numpy as np
+
 import jax
 
 
@@ -49,3 +51,54 @@ class StageTimer:
                  for name, dt in sorted(self.times.items(),
                                         key=lambda kv: -kv[1])]
         return '\n'.join(lines)
+
+
+class DispatchTimer:
+    """Per-step wall-clock split into the three host-visible phases of
+    an asynchronously dispatched device step: DISPATCH (the traced call
+    returning its futures — trace/cache lookup + enqueue, where tunnel
+    round-trip latency lives), DEVICE (``block_until_ready`` on those
+    futures), TRANSFER (``np.asarray`` of every output leaf).  A
+    dispatch-bound loop shows the first segment dominating while the
+    device sits idle — the diagnosis that motivates folding batches
+    into one dispatch (``parallel.sweep.run_spanned``).
+
+    Example::
+
+        t = DispatchTimer()
+        for k in keys:
+            stats = t.step(lambda: jitted_step(k))
+        print(t.breakdown())
+    """
+
+    def __init__(self):
+        self.dispatch_s = 0.0
+        self.device_s = 0.0
+        self.transfer_s = 0.0
+        self.steps = 0
+
+    def step(self, fn):
+        """Run ``fn() -> pytree of device arrays``; returns the host
+        numpy pytree, charging each phase to its counter."""
+        t0 = time.perf_counter()
+        out = fn()
+        t1 = time.perf_counter()
+        out = jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        host = jax.tree.map(np.asarray, out)
+        t3 = time.perf_counter()
+        self.dispatch_s += t1 - t0
+        self.device_s += t2 - t1
+        self.transfer_s += t3 - t2
+        self.steps += 1
+        return host
+
+    def breakdown(self) -> dict:
+        """Totals + per-step means in ms, JSON-able for bench rows."""
+        n = max(self.steps, 1)
+        out = {'steps': self.steps}
+        for name in ('dispatch', 'device', 'transfer'):
+            s = getattr(self, name + '_s')
+            out[name + '_s'] = round(s, 6)
+            out[name + '_ms_per_step'] = round(1e3 * s / n, 4)
+        return out

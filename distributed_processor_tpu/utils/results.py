@@ -60,12 +60,27 @@ class SweepAccumulator:
         self.meta = dict(meta or {})
 
     def add(self, batch_stats: dict) -> None:
-        for k, v in batch_stats.items():
+        self.add_span(batch_stats, 1)
+
+    def add_span(self, span_stats: dict, n_batches: int) -> None:
+        """Fold an already-summed span of ``n_batches`` batches.
+
+        ``checkpoint_every`` stays in BATCH units; with spans the write
+        happens when the accumulated batch count CROSSES a multiple of
+        it (checkpoints snap to span edges).  For ``n_batches == 1``
+        this is exactly ``add``'s write-on-multiple behavior.
+        """
+        if n_batches < 1:
+            raise ValueError(f'span must cover >= 1 batches, '
+                             f'got {n_batches}')
+        for k, v in span_stats.items():
             v = np.asarray(v)
             self.state[k] = self.state.get(k, 0) + v
-        self.n_batches += 1
+        prev = self.n_batches
+        self.n_batches += n_batches
         if self.path and self.checkpoint_every and \
-                self.n_batches % self.checkpoint_every == 0:
+                self.n_batches // self.checkpoint_every \
+                > prev // self.checkpoint_every:
             self.save()
 
     def save(self) -> None:

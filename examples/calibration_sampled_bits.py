@@ -35,6 +35,9 @@ from distributed_processor_tpu.sim.physics import ReadoutPhysics
 
 KW = dict(max_steps=2000, max_pulses=32, max_meas=2)
 SHOTS, BATCH = 8192, 4096
+# fold every point's batches into ONE device dispatch (statistics are
+# bit-identical to the per-batch loop — parallel/driver.py span=)
+SPAN = SHOTS // BATCH
 
 
 def sweep(sim, progs, model, mesh, key0):
@@ -42,7 +45,7 @@ def sweep(sim, progs, model, mesh, key0):
     for i, prog in enumerate(progs):
         mp = sim.compile(prog)
         out = run_physics_sweep(mp, model, SHOTS, BATCH, key=key0 + i,
-                                mesh=mesh, **KW)
+                                mesh=mesh, span=SPAN, **KW)
         assert out['err_shots'] == 0
         curves.append(out['meas1_rate'])
     return np.stack(curves)

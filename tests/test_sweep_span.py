@@ -1,0 +1,184 @@
+"""Device-resident sweep spans (``span=`` on both sweep drivers).
+
+The contract under test: folding ``span`` batches into ONE dispatch —
+a ``lax.scan`` over batch indices with a donated on-device stats carry
+(``sim.interpreter.make_span_runner``, driven pipelined by
+``parallel.sweep.run_spanned``) — is BIT-IDENTICAL to the per-batch
+host loop: the same ``fold_in(key, i)`` stream folds into the same
+int32 sums, for spans that divide or straddle the batch count, across
+checkpoint resume points landing mid-span or on span edges, on both
+engines, and under a dp mesh.  Checkpoints carry no span: they are
+interchangeable across span choices.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu.models import (active_reset,
+                                              make_default_qchip,
+                                              rb_ensemble)
+from distributed_processor_tpu.parallel import (make_mesh,
+                                                run_multi_sweep,
+                                                run_physics_sweep)
+from distributed_processor_tpu.pipeline import compile_to_machine
+from distributed_processor_tpu.sim.interpreter import span_trace_count
+from distributed_processor_tpu.sim.physics import ReadoutPhysics
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.utils.results import (SweepAccumulator,
+                                                     load_results)
+
+
+def _physics():
+    sim = Simulator(n_qubits=2)
+    mp = sim.compile(active_reset(['Q0', 'Q1']))
+    model = ReadoutPhysics(sigma=0.01, p1_init=0.5)
+    kw = dict(max_steps=mp.n_instr * 4 + 64, max_pulses=8, max_meas=2)
+    return mp, model, kw
+
+
+def _ensemble(n_seqs, seed):
+    qchip = make_default_qchip(2)
+    return [compile_to_machine(active_reset(['Q0', 'Q1']) + prog, qchip,
+                               n_qubits=2)
+            for prog in rb_ensemble(['Q0', 'Q1'], 1, n_seqs, seed=seed)]
+
+
+def _assert_same(a: dict, b: dict, ctx=''):
+    assert set(a) == set(b), ctx
+    for k in a:
+        np.testing.assert_array_equal(
+            np.asarray(a[k]), np.asarray(b[k]), err_msg=f'{ctx}{k}')
+
+
+def test_physics_span_parity():
+    """Exact stats equality vs the host loop for a span that is 1, one
+    that straddles the batch count, and one equal to it (4 batches)."""
+    mp, model, kw = _physics()
+    loop = run_physics_sweep(mp, model, 64, 16, key=5, **kw)
+    for span in (1, 3, 4):
+        sp = run_physics_sweep(mp, model, 64, 16, key=5, span=span, **kw)
+        _assert_same(loop, sp, f'span={span}: ')
+
+
+def test_physics_span_parity_both_engines():
+    """The physics path honors cfg.straightline; spans must be
+    bit-identical to the loop on BOTH engines."""
+    mp, model, kw = _physics()
+    for sl in (False, True):
+        loop = run_physics_sweep(mp, model, 48, 16, key=9,
+                                 straightline=sl, **kw)
+        sp = run_physics_sweep(mp, model, 48, 16, key=9, span=2,
+                               straightline=sl, **kw)
+        _assert_same(loop, sp, f'straightline={sl}: ')
+
+
+def test_multi_span_parity_and_err_shots():
+    """Ensemble driver: spanned == loop exactly, and the result carries
+    the per-program err_shots numerator behind err_rate."""
+    mps = _ensemble(2, seed=41)
+    loop = run_multi_sweep(mps, total_shots=16, batch=4, p1=0.5, key=3,
+                           max_meas=2, max_resets=2)
+    assert loop['err_shots'].shape == (2,)
+    assert np.issubdtype(loop['err_shots'].dtype, np.integer)
+    np.testing.assert_array_equal(loop['err_shots'],
+                                  loop['err_rate'] * loop['shots'])
+    for span in (3, 4):
+        sp = run_multi_sweep(mps, total_shots=16, batch=4, p1=0.5,
+                             key=3, span=span, max_meas=2, max_resets=2)
+        _assert_same(loop, sp, f'span={span}: ')
+
+
+def test_span_checkpoint_resume(tmp_path):
+    """Resume landing mid-span and on a span edge both reproduce the
+    uncheckpointed loop exactly, and a span-written checkpoint resumes
+    under a different span (span is not sweep identity)."""
+    mp, model, kw = _physics()
+    full = run_physics_sweep(mp, model, 128, 16, key=7, **kw)
+    # 5 batches is mid-span for span=3 (grid cells [0,3) [3,6) [6,8))
+    ck = str(tmp_path / 'mid.npz')
+    run_physics_sweep(mp, model, 80, 16, key=7, span=3, checkpoint=ck,
+                      checkpoint_every=1, **kw)
+    resumed = run_physics_sweep(mp, model, 128, 16, key=7, span=3,
+                                checkpoint=ck, checkpoint_every=1, **kw)
+    _assert_same(full, resumed, 'mid-span resume: ')
+    # 6 batches is exactly a span edge
+    ck2 = str(tmp_path / 'edge.npz')
+    run_physics_sweep(mp, model, 96, 16, key=7, span=3, checkpoint=ck2,
+                      checkpoint_every=3, **kw)
+    assert int(load_results(ck2)[1]['n_batches']) == 6
+    resumed2 = run_physics_sweep(mp, model, 128, 16, key=7, span=3,
+                                 checkpoint=ck2, checkpoint_every=3,
+                                 **kw)
+    _assert_same(full, resumed2, 'span-edge resume: ')
+    # a checkpoint written WITH a span resumes WITHOUT one (and the
+    # other way around): the fingerprint carries no span
+    ck3 = str(tmp_path / 'cross.npz')
+    run_physics_sweep(mp, model, 80, 16, key=7, span=4, checkpoint=ck3,
+                      **kw)
+    crossed = run_physics_sweep(mp, model, 128, 16, key=7, checkpoint=ck3,
+                                **kw)
+    _assert_same(full, crossed, 'cross-span resume: ')
+
+
+def test_span_trace_counts():
+    """Every FULL span of a sweep shares one compiled executable; a
+    trailing partial span costs exactly one more."""
+    mp, model, kw = _physics()
+    c0 = span_trace_count()
+    run_physics_sweep(mp, model, 96, 16, key=11, span=3, **kw)
+    assert span_trace_count() - c0 == 1, \
+        'span dividing n_batches must compile exactly once'
+    c1 = span_trace_count()
+    run_physics_sweep(mp, model, 112, 16, key=11, span=3, **kw)
+    assert span_trace_count() - c1 == 2, \
+        'trailing partial span must add exactly one trace'
+
+
+def test_span_mesh_parity():
+    """dp=2 CPU mesh: the sharded per-batch loop and the sharded span
+    (shard_map inside the scan) fold identical stats."""
+    mp, model, kw = _physics()
+    mesh = make_mesh(n_dp=2)
+    loop = run_physics_sweep(mp, model, 96, 16, key=5, mesh=mesh, **kw)
+    sp = run_physics_sweep(mp, model, 96, 16, key=5, mesh=mesh, span=4,
+                           **kw)
+    _assert_same(loop, sp, 'mesh: ')
+
+
+def test_add_span_checkpoint_crossing(tmp_path):
+    """add_span writes when the batch count CROSSES a checkpoint_every
+    multiple (snap to span edges), and equals add for n=1."""
+    path = str(tmp_path / 'acc.npz')
+    acc = SweepAccumulator(path, checkpoint_every=4)
+    acc.add_span({'x': np.int32(1)}, 3)
+    assert not (tmp_path / 'acc.npz').exists()    # 3 < 4: no write yet
+    acc.add_span({'x': np.int32(1)}, 3)           # 6 crosses 4
+    assert int(load_results(path)[1]['n_batches']) == 6
+    acc.add_span({'x': np.int32(1)}, 3)           # 9 crosses 8
+    arrays, meta = load_results(path)
+    assert int(meta['n_batches']) == 9 and int(arrays['x']) == 3
+    with pytest.raises(ValueError, match='span'):
+        acc.add_span({'x': np.int32(1)}, 0)
+
+
+def test_cli_sweep_span(tmp_path, capsys):
+    """`sweep --span` passes through to the driver bit-identically, and
+    a checkpoint cadence that cannot snap to span edges is rejected."""
+    from distributed_processor_tpu.cli import main
+    prog = tmp_path / 'prog.json'
+    prog.write_text(json.dumps([{'name': 'X90', 'qubit': ['Q0']},
+                                {'name': 'read', 'qubit': ['Q0']},
+                                {'name': 'read', 'qubit': ['Q1']}]))
+    argv = ['--qubits', '2', 'sweep', str(prog), '--shots', '32',
+            '--batch', '8', '--sigma', '0.01', '--p1-init', '0.5']
+    main(argv)
+    base = json.loads(capsys.readouterr().out)
+    main(argv + ['--span', '2'])
+    spanned = json.loads(capsys.readouterr().out)
+    assert base == spanned and base['shots'] == 32
+    with pytest.raises(SystemExit, match='multiple'):
+        main(argv + ['--span', '4', '--checkpoint-every', '3'])
+    with pytest.raises(SystemExit, match='span'):
+        main(argv + ['--span', '0'])
