@@ -41,6 +41,13 @@ incrementally and atomically to BENCH_ARTIFACT (default
 bench_partial.json next to this script; set empty to disable), so a
 killed or hung row cannot erase the rows already measured.
 
+If the accelerator preflight fails all its backoff attempts, the bench
+reruns itself in a CPU child process (JAX_PLATFORMS=cpu, CPU-sized
+default shapes) and marks the artifact and the stdout JSON
+``"degraded": true`` — the round keeps a parseable artifact and exit
+code 0 instead of a zeroed value.  BENCH_LADDER_DEPTH sets the
+engine-ladder row's RB depth (default 100; 0 skips the row).
+
 The detail dict also reports `fused_pallas_shots_per_sec` (the same
 chain hand-fused into one Pallas kernel with in-kernel counter-based
 ADC noise, ops/resolve_pallas.py) and `analytic_shots_per_sec` (the
@@ -244,6 +251,55 @@ def large_program_scaling(n_qubits: int, small_depth: int,
     large = results['large']['instr_shots_per_sec']
     results['large_vs_small_per_instr'] = round(large / small, 3)
     return results
+
+
+def engine_ladder(n_qubits: int, depth: int, batch: int = 256):
+    """Engine-ladder row (docs/PERF.md "The engine ladder"): outer-loop
+    iteration counts and warm per-batch times for the generic
+    fetch-dispatch engine vs the block engine (CFG superinstructions
+    between branch points) on the depth-``depth`` active-reset RB
+    program — the workload whose active-reset feedback loop is
+    straight-line-INeligible but whose RB body is one giant block.
+    Iteration counts are exact ('steps' counts while_loop trips), so
+    the reduction ratio is backend-independent; times are medians of 3
+    warmed host-synced batches per engine."""
+    from distributed_processor_tpu.sim.interpreter import (
+        _block_plan, _soa_static, simulate_batch)
+    mp = build_machine_program(n_qubits, depth)
+    _, bodies = _block_plan(_soa_static(mp))
+    rng = np.random.default_rng(5)
+    bits = rng.integers(0, 2,
+                        size=(batch, mp.n_cores, 2)).astype(np.int32)
+    out = {'n_qubits': n_qubits, 'depth': depth, 'batch': batch,
+           'n_instr': mp.n_instr, 'n_blocks': len(bodies),
+           'unrolled_rows': sum(L for _, L in bodies)}
+    for eng in ('generic', 'block'):
+        cfg = InterpreterConfig(
+            max_steps=2 * mp.n_instr + 64,
+            max_pulses=int(mp.max_pulses_per_core(1)) + 4,
+            max_meas=2, max_resets=2, record_pulses=False, engine=eng)
+        t0 = time.perf_counter()
+        r = simulate_batch(mp, bits, cfg=cfg)
+        steps = int(jax.block_until_ready(r['steps']))
+        t_first = time.perf_counter() - t0
+        assert not bool(r['incomplete']), f'{eng} ladder run truncated'
+        assert int(np.asarray(r['err']).sum()) == 0, \
+            f'{eng} ladder run set error bits'
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            rr = simulate_batch(mp, bits, cfg=cfg)
+            jax.block_until_ready(rr['err'])
+            ts.append(time.perf_counter() - t0)
+        out[eng] = {'iterations': steps,
+                    'first_call_s': round(t_first, 3),
+                    'warm_batch_s': round(sorted(ts)[1], 4)}
+    out['iteration_reduction'] = round(
+        out['generic']['iterations'] / out['block']['iterations'], 1)
+    out['note'] = ('same injected-bits batch both engines; iterations '
+                   'are while_loop trips (exact), reduction holds on '
+                   'any backend')
+    return out
 
 
 def multi_sequence_rb(n_qubits: int, depth: int, n_seqs: int = 16,
@@ -679,6 +735,11 @@ def _preflight(timeouts=(30.0, 60.0, 120.0)):
 
         def probe():
             try:
+                if os.environ.get('BENCH_PREFLIGHT_FAIL'):
+                    # test hook: a dead backend is otherwise impossible
+                    # to provoke deterministically in CI
+                    raise RuntimeError(
+                        'forced preflight failure (BENCH_PREFLIGHT_FAIL)')
                 x = jnp.ones((8,))
                 float(x.sum())
             except Exception as e:      # fast failure: report, don't wait
@@ -703,6 +764,8 @@ def _preflight(timeouts=(30.0, 60.0, 120.0)):
                 f'(device init/compute hang — tunnel down?)')})
         print(f'preflight attempt {n}/{len(timeouts)} failed: '
               f'{attempts[-1]["error"]}', file=sys.stderr)
+    if not os.environ.get('BENCH_DEGRADED'):
+        _degraded_rerun(attempts)       # execs a CPU child; exits 0 on success
     print(json.dumps({
         'metric': 'shots/sec/chip, 8q active-reset+RB, physics-closed '
                   '(synth+demod+discriminate in-loop)',
@@ -713,12 +776,46 @@ def _preflight(timeouts=(30.0, 60.0, 120.0)):
     os._exit(2)
 
 
+def _degraded_rerun(attempts):
+    """Degraded-mode fallback: the accelerator backend is dead, but a
+    zeroed perf artifact still wipes a round's evidence (the BENCH_r05
+    failure class the artifact writer exists for).  Rerun the whole
+    bench in a CPU child process (the JAX backend is process-global, so
+    the rerun cannot happen in this process), with conservative default
+    shapes unless the caller pinned them, and mark every output
+    ``"degraded": true`` so a CPU number can never masquerade as a chip
+    number.  Exits 0 when the child succeeds; falls through (to the
+    error JSON + exit 2) when it does not."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu', BENCH_DEGRADED='1')
+    # the forced-failure test hook must not fail the CPU child too
+    env.pop('BENCH_PREFLIGHT_FAIL', None)
+    # CPU-sized defaults (only where the caller didn't pin a value):
+    # the accelerator shapes are hours on a CPU
+    for k, v in (('BENCH_SHOTS', '2048'), ('BENCH_BATCH', '1024'),
+                 ('BENCH_MODE', 'persample'), ('BENCH_PROBE_ROUNDS', '2'),
+                 ('BENCH_MULTI_SEQS', '4'), ('BENCH_MULTI_SHOTS', '256'),
+                 ('BENCH_SWEEP_SHOTS', '8192'), ('BENCH_SWEEP_BATCH', '1024'),
+                 ('BENCH_SWEEP_SPAN', '4'), ('BENCH_LADDER_DEPTH', '12')):
+        env.setdefault(k, v)
+    print('preflight failed on the accelerator backend; rerunning the '
+          'bench DEGRADED on CPU (JAX_PLATFORMS=cpu)', file=sys.stderr)
+    rc = subprocess.call([sys.executable,
+                          os.path.abspath(__file__)], env=env)
+    if rc == 0:
+        os._exit(0)
+    print(f'degraded CPU rerun failed (rc={rc})', file=sys.stderr)
+
+
 def main():
     enable_compilation_cache()
     artifact = _ArtifactWriter(os.environ.get(
         'BENCH_ARTIFACT',
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      'bench_partial.json')))
+    degraded = bool(os.environ.get('BENCH_DEGRADED'))
+    if degraded:
+        artifact.row('degraded', True)
     preflight = _preflight()
     artifact.row('preflight', preflight)
     n_qubits = int(os.environ.get('BENCH_QUBITS', 8))
@@ -919,7 +1016,15 @@ def main():
             probe_specs.append(('device:statevec', headline_mode,
                                 'statevec'))
         probe_specs.append(('statevec:cz', headline_mode, 'statevec:cz'))
-    probe_rounds = int(os.environ.get('BENCH_PROBE_ROUNDS', 5))
+    # BENCH_SECONDARIES=0: headline only — every comparison row (probes,
+    # utilization, scaling, multi-RB, sweep-span, engine ladder) is
+    # skipped.  For smoke runs and the degraded-fallback test, where the
+    # evidence wanted is "a parseable artifact with a headline", fast.
+    secondaries = os.environ.get('BENCH_SECONDARIES', '1') != '0'
+    if not secondaries:
+        probe_specs = probe_specs[:1]
+    probe_rounds = int(os.environ.get('BENCH_PROBE_ROUNDS', 5)) \
+        if secondaries else 0
     probe_times: dict = {}
     probe_keys: dict = {}
     probes = []
@@ -1019,7 +1124,8 @@ def main():
     # measurement already taken
     try:
         utilization = utilization_accounting(
-            mp, cfg, model, batch, elapsed / n_batches, int(res[4]))
+            mp, cfg, model, batch, elapsed / n_batches, int(res[4])) \
+            if secondaries else None
     except Exception as e:      # pragma: no cover - defensive
         utilization = {'error': f'{type(e).__name__}: {e}'[:200]}
     # statevec roofline rows, from the interleaved probe medians
@@ -1037,7 +1143,8 @@ def main():
             sv_utils[nm] = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('utilization', utilization)
     try:
-        scaling = large_program_scaling(n_qubits, small_depth=depth)
+        scaling = large_program_scaling(n_qubits, small_depth=depth) \
+            if secondaries else None
     except Exception as e:      # pragma: no cover - defensive
         scaling = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('scaling', scaling)
@@ -1048,7 +1155,8 @@ def main():
         multi_rb = multi_sequence_rb(
             n_qubits, depth,
             n_seqs=int(os.environ.get('BENCH_MULTI_SEQS', 16)),
-            shots=int(os.environ.get('BENCH_MULTI_SHOTS', 4096)))
+            shots=int(os.environ.get('BENCH_MULTI_SHOTS', 4096))) \
+            if secondaries else None
     except Exception as e:      # pragma: no cover - defensive
         multi_rb = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('multi_sequence_rb', multi_rb)
@@ -1060,10 +1168,24 @@ def main():
             shots=int(os.environ.get('BENCH_SWEEP_SHOTS', 131072)),
             batch=int(os.environ.get('BENCH_SWEEP_BATCH', 2048)),
             span=int(os.environ.get('BENCH_SWEEP_SPAN', 16)),
-            sigma=sigma)
+            sigma=sigma) if secondaries else None
     except Exception as e:      # pragma: no cover - defensive
         sweep_span = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('sweep_span', sweep_span)
+    # engine-ladder row: generic vs block iteration counts + warm batch
+    # times on deep active-reset RB — guarded like every secondary.
+    # BENCH_LADDER_DEPTH=0 skips it (the block compile is minutes on
+    # CPU at depth 100; the degraded rerun defaults it down to 12)
+    ladder_depth = int(os.environ.get('BENCH_LADDER_DEPTH', 100)) \
+        if secondaries else 0
+    if ladder_depth:
+        try:
+            ladder = engine_ladder(n_qubits, ladder_depth)
+        except Exception as e:  # pragma: no cover - defensive
+            ladder = {'error': f'{type(e).__name__}: {e}'[:200]}
+    else:
+        ladder = None
+    artifact.row('engine_ladder', ladder)
 
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
@@ -1072,6 +1194,10 @@ def main():
                   '(synth+demod+discriminate in-loop)',
         'value': round(shots_per_sec, 1),
         'unit': 'shots/s',
+        # degraded = the accelerator preflight failed and this is the
+        # CPU fallback run: the evidence survives, but the number must
+        # never be read as a chip number
+        'degraded': degraded,
         'vs_baseline': round(shots_per_sec / NORTH_STAR_SHOTS_PER_SEC, 3),
         'detail': {
             'n_qubits': n_qubits, 'rb_depth': depth,
@@ -1105,6 +1231,7 @@ def main():
             'scaling': scaling,
             'multi_sequence_rb': multi_rb,
             'sweep_span': sweep_span,
+            'engine_ladder': ladder,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

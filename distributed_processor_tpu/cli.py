@@ -204,12 +204,16 @@ def cmd_run(args):
             classify3=args.classify3)
     else:
         kw['p1'] = args.p1
+    if args.engine:
+        kw['engine'] = args.engine
     out = sim.run(_load_program(args.program, args.qasm), shots=args.shots,
                   **kw)
+    from .sim.interpreter import resolve_engine
     n_pulses = np.asarray(out['n_pulses'])
     err = np.asarray(out['err'])
     result = {
         'shots': args.shots,
+        'engine': resolve_engine(out['_mp'], out['_cfg']),
         'mean_pulses_per_core': np.atleast_2d(n_pulses).mean(0).tolist(),
         'error_shots': int(np.any(np.atleast_2d(err) != 0, -1).sum()),
         'steps': int(out['steps']),
@@ -267,9 +271,10 @@ def cmd_sweep(args):
                       depol_per_pulse=args.depol)
     model = ReadoutPhysics(sigma=args.sigma, p1_init=args.p1_init,
                            device=dev)
+    cfg_kw = {'engine': args.engine} if args.engine else {}
     out = run_physics_sweep(mp, model, args.shots, args.batch,
                             key=args.key,
-                            cfg=sim.interpreter_config(mp),
+                            cfg=sim.interpreter_config(mp, **cfg_kw),
                             checkpoint=args.checkpoint,
                             checkpoint_every=args.checkpoint_every,
                             span=args.span,
@@ -385,6 +390,16 @@ def main(argv=None):
     p.add_argument('--classify3', action='store_true',
                    help='statevec + --leak-iq: 3-class nearest-centroid '
                         'discrimination; reports per-core class-2 rates')
+    p.add_argument('--engine',
+                   choices=('auto', 'generic', 'block', 'straightline'),
+                   default=None,
+                   help='interpreter engine ladder (docs/PERF.md "Engine '
+                        'ladder"): auto picks straightline for small '
+                        'branch-free programs, else block '
+                        '(CFG-superinstruction) when eligible, else '
+                        'generic fetch-dispatch; block/straightline '
+                        'raise with the reason when ineligible '
+                        '(default: generic)')
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser('sweep', help='physics-closed statistics sweep '
@@ -429,6 +444,12 @@ def main(argv=None):
                    help='bloch/statevec: T2 in microseconds (0 = off)')
     p.add_argument('--depol', type=float, default=0.0,
                    help='bloch/statevec: 1q depolarization per pulse')
+    p.add_argument('--engine',
+                   choices=('auto', 'generic', 'block', 'straightline'),
+                   default=None,
+                   help='interpreter engine ladder (see `run --help`); '
+                        'the chosen engine is reported in the result '
+                        'metadata')
     p.set_defaults(fn=cmd_sweep)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')

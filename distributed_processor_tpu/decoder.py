@@ -191,6 +191,51 @@ class MachineProgram:
                 'max_pulses': max(worst_pulses, 1) + 2}
 
 
+def extract_blocks(mp: 'MachineProgram') -> list:
+    """Per-core CFG extraction: partition each core's instruction range
+    into maximal straight-line blocks.
+
+    A block ends at a control-transfer / cross-core instruction
+    (:data:`~distributed_processor_tpu.isa.BLOCK_TERMINATORS` plus
+    DONE — the per-core analog of the reference cores retiring at a
+    branch, `hdl/proc.sv` instruction loop) or just before a jump
+    TARGET (every branch destination starts a block).  Returns one
+    int32 ``[n_blocks, 3]`` array per core, rows ``(start, length,
+    kind)`` where ``kind`` is the terminating instruction's kind or
+    ``-1`` for a fall-through block (split only by an incoming edge).
+
+    Invariants (fuzz-pinned in tests/test_blocks.py): the blocks of a
+    core partition ``[0, n_instr)`` exactly, in order, and every jump
+    target within range is a block start.
+
+    This is the analysis view; the interpreter's runtime layout —
+    union-refined across cores and content-deduplicated — is
+    :func:`~distributed_processor_tpu.isa.build_block_table`.
+    """
+    kind = np.asarray(mp.soa.kind)
+    jump_addr = np.asarray(mp.soa.jump_addr)
+    C, N = kind.shape
+    enders = set(isa.BLOCK_TERMINATORS) | {isa.K_DONE}
+    out = []
+    for c in range(C):
+        kc = kind[c]
+        term = np.isin(kc, list(enders))
+        jmask = (kc == isa.K_JUMP_I) | (kc == isa.K_JUMP_COND) \
+            | (kc == isa.K_JUMP_FPROC)
+        leaders = {0}
+        leaders.update(int(t) for t in jump_addr[c][jmask]
+                       if 0 <= int(t) < N)
+        leaders.update(int(i) + 1 for i in np.nonzero(term)[0]
+                       if int(i) + 1 < N)
+        bounds = sorted(leaders) + [N]
+        rows = []
+        for s, e in zip(bounds, bounds[1:]):
+            k = int(kc[e - 1]) if term[e - 1] else -1
+            rows.append((s, e - s, k))
+        out.append(np.asarray(rows, dtype=np.int32).reshape(-1, 3))
+    return out
+
+
 @dataclass
 class MultiMachineProgram:
     """A stacked ensemble of decoded machine programs — program-as-data.

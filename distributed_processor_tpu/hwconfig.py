@@ -55,8 +55,30 @@ class FPGAConfig:
     # hard-codes N_CORES=8, hwconfig.py:112-115; here it follows the
     # system size — Simulator passes its n_qubits)
     n_cores: int = N_CORES
+    # syndrome-LUT fabric contents (ops/fabric.py MeasLUT and the
+    # interpreter's fabric='lut' path).  The gateware hard-codes these
+    # (reference: hdl/meas_lut.sv:16-20, TODO "make these writable");
+    # here they are hardware configuration like every timing constant
+    # above.  ``meas_lut_mask``: bool per core — which cores' bits form
+    # the table address (LSB = lowest masked core).  ``meas_lut_table``:
+    # 2^popcount(mask) entries, bit c of an entry = output bit for core
+    # c.  Empty (the default) = no LUT configured.
+    meas_lut_mask: tuple = ()
+    meas_lut_table: tuple = ()
 
     def __post_init__(self):
+        # normalize JSON-borne lists to the hashable tuples the
+        # interpreter config requires, and validate the pair early —
+        # a mis-sized table should fail at configuration time, not at
+        # first simulated fproc read
+        self.meas_lut_mask = tuple(bool(b) for b in self.meas_lut_mask)
+        self.meas_lut_table = tuple(int(e) for e in self.meas_lut_table)
+        if self.meas_lut_mask or self.meas_lut_table:
+            k = sum(self.meas_lut_mask)
+            if len(self.meas_lut_table) != 1 << k:
+                raise ValueError(
+                    f'meas_lut_table must have 2^{k} entries for a '
+                    f'{k}-input mask, got {len(self.meas_lut_table)}')
         if self.fproc_channels is None:
             # default: one 'Qn.meas' channel per qubit, served by the rdlo
             # demod chain on that qubit's core
@@ -72,13 +94,19 @@ class FPGAConfig:
         return 1 / self.fpga_clk_period
 
     def to_dict(self) -> dict:
-        return {'fpga_clk_period': self.fpga_clk_period,
-                'alu_instr_clks': self.alu_instr_clks,
-                'jump_cond_clks': self.jump_cond_clks,
-                'jump_fproc_clks': self.jump_fproc_clks,
-                'pulse_regwrite_clks': self.pulse_regwrite_clks,
-                'pulse_load_clks': self.pulse_load_clks,
-                'n_cores': self.n_cores}
+        d = {'fpga_clk_period': self.fpga_clk_period,
+             'alu_instr_clks': self.alu_instr_clks,
+             'jump_cond_clks': self.jump_cond_clks,
+             'jump_fproc_clks': self.jump_fproc_clks,
+             'pulse_regwrite_clks': self.pulse_regwrite_clks,
+             'pulse_load_clks': self.pulse_load_clks,
+             'n_cores': self.n_cores}
+        if self.meas_lut_mask:
+            # only when configured: serialized CompiledPrograms (and the
+            # golden files pinning them) predate these fields
+            d['meas_lut_mask'] = list(self.meas_lut_mask)
+            d['meas_lut_table'] = list(self.meas_lut_table)
+        return d
 
 
 @dataclass

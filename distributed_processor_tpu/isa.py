@@ -456,6 +456,100 @@ def stack_soa_multi(programs: list[SoAProgram],
 
 
 # ---------------------------------------------------------------------------
+# CFG block table (the block-compiled interpreter engine's program layout)
+# ---------------------------------------------------------------------------
+
+# Kinds that END a straight-line block: anything that branches, blocks on
+# another core (fproc read / sync barrier), or otherwise needs the generic
+# engine's dynamic dispatch.  DONE is deliberately NOT here: a halted core
+# simply stops executing, so DONE rows are handled inline by the block
+# bodies — otherwise the DONE padding that equalizes per-core program
+# lengths (stack_soa) would shatter every block of a heterogeneous-length
+# program.
+BLOCK_TERMINATORS = frozenset(
+    {K_JUMP_I, K_JUMP_COND, K_ALU_FPROC, K_JUMP_FPROC, K_SYNC})
+
+# kinds a block body knows how to execute (everything else is a terminator)
+BLOCK_BODY_KINDS = frozenset(
+    {K_PULSE_WRITE, K_PULSE_TRIG, K_REG_ALU, K_INC_QCLK, K_PULSE_RESET,
+     K_IDLE, K_DONE})
+
+# below this, a block saves nothing over the generic boundary step but
+# still costs a specialized trace — leave it to the generic engine
+BLOCK_MIN_LEN = 2
+
+
+def build_block_table(soa_or_fields, min_len: int = BLOCK_MIN_LEN):
+    """Union-refined block table over a stacked ``[n_cores, n_instr]``
+    program: the runtime layout of the block-compiled engine
+    (``sim.interpreter._exec_blocks``).
+
+    Block intervals live in the GLOBAL instruction-index space, shared
+    by every core (cores of one lane sit at independent ``pc`` values,
+    so a per-core table would need a per-core dispatch; a shared table
+    needs one).  Boundaries are the union over cores of (a) every
+    :data:`BLOCK_TERMINATORS` position and (b) every jump target — so
+    no body interval contains, on ANY core, an instruction the body
+    cannot execute, and no jump can land mid-body.
+
+    Bodies with identical instruction content (every field, every core)
+    are DEDUPLICATED: the engine traces one specialized body per
+    distinct content and dispatches lanes onto it by block id, so the
+    compile cost scales with the deduped total length, not the program
+    length.
+
+    ``soa_or_fields``: a :class:`SoAProgram` (or anything with
+    ``.asdict()``) or a ``{field: [n_cores, n_instr] array}`` dict —
+    at minimum ``kind`` and ``jump_addr``; ALL supplied fields enter
+    the dedup key.
+
+    Returns ``(bid_at, bodies)``: ``bid_at`` int32 ``[n_instr]`` maps a
+    body-interval START to its deduplicated body id (−1 everywhere
+    else); ``bodies`` is ``[(start, length)]`` per body id, ``start``
+    being the representative interval whose rows define the body.
+    """
+    fields = soa_or_fields.asdict() if hasattr(soa_or_fields, 'asdict') \
+        else dict(soa_or_fields)
+    kind = np.asarray(fields['kind'])
+    jump_addr = np.asarray(fields['jump_addr'])
+    if kind.ndim != 2:
+        raise ValueError(f'need stacked [n_cores, n_instr] fields; '
+                         f'kind has shape {kind.shape}')
+    C, N = kind.shape
+    term_any = np.zeros(N, dtype=bool)
+    for k in BLOCK_TERMINATORS:
+        term_any |= np.any(kind == k, axis=0)
+    jmask = (kind == K_JUMP_I) | (kind == K_JUMP_COND) \
+        | (kind == K_JUMP_FPROC)
+    leaders = {0}
+    leaders.update(int(t) for t in jump_addr[jmask] if 0 <= int(t) < N)
+    leaders.update(int(i) + 1 for i in np.nonzero(term_any)[0]
+                   if int(i) + 1 < N)
+    bounds = sorted(leaders) + [N]
+    names = sorted(fields)
+    bid_at = np.full(N, -1, dtype=np.int32)
+    bodies: list = []
+    index: dict = {}
+    for s, e in zip(bounds, bounds[1:]):
+        # a terminator position is always the LAST of its segment (its
+        # successor is a leader), so the body is the segment minus at
+        # most that one trailing instruction
+        be = e - 1 if term_any[e - 1] else e
+        if be - s < min_len:
+            continue
+        key = b''.join(
+            np.ascontiguousarray(np.asarray(fields[f])[:, s:be]).tobytes()
+            for f in names)
+        bid = index.get(key)
+        if bid is None:
+            bid = len(bodies)
+            index[key] = bid
+            bodies.append((s, be - s))
+        bid_at[s] = bid
+    return bid_at, bodies
+
+
+# ---------------------------------------------------------------------------
 # human-readable disassembly (debugging / golden tests)
 # ---------------------------------------------------------------------------
 

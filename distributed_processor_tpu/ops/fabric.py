@@ -34,6 +34,18 @@ class MeasLUT:
         self._addr_shift = np.zeros(len(self.input_mask), dtype=np.int32)
         self._addr_shift[self.input_mask] = np.arange(k)
 
+    @classmethod
+    def from_fpga_config(cls, fpga_config) -> 'MeasLUT':
+        """Build the LUT from :class:`~..hwconfig.FPGAConfig`'s
+        ``meas_lut_mask`` / ``meas_lut_table`` fields — the writable
+        analog of the contents the gateware hard-codes (reference:
+        hdl/meas_lut.sv:16-20).  Raises when the config carries no LUT."""
+        if not fpga_config.meas_lut_mask:
+            raise ValueError(
+                'FPGAConfig has no meas LUT configured (meas_lut_mask is '
+                'empty); set meas_lut_mask + meas_lut_table')
+        return cls(fpga_config.meas_lut_mask, fpga_config.meas_lut_table)
+
     def address(self, bits):
         """bits ``[..., n_cores]`` -> table address ``[...]``."""
         bits = jnp.asarray(bits, jnp.int32)

@@ -236,8 +236,13 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     # errored/unresolved shots from the numerator, so dividing by
     # shots_done would bias the rate low by exactly that fraction
     clean = int(acc.state['clean_shots'])
+    from ..sim.interpreter import resolve_engine
     return {
         'shots': shots_done,
+        # which interpreter engine the epoch loop ran (the ladder's
+        # choice for this program/cfg — results metadata, satellite of
+        # the engine-ladder work)
+        'engine': resolve_engine(mp, cfg),
         'mean_pulses': acc.state['pulse_sum'] / shots_done,
         'meas1_rate': acc.state['meas1_sum'] / shots_done,
         'survival00_rate': float(acc.state['allzero_sum'] / clean)
@@ -323,7 +328,10 @@ def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
         cfg = InterpreterConfig(**cfg_kw)
     else:
         cfg = replace(cfg, **cfg_kw)
-    cfg = replace(cfg, record_pulses=False, straightline=False)
+    # program-as-data path: the content-keyed engines (straightline,
+    # block) would retrace per sequence — always the vmapped generic
+    cfg = replace(cfg, record_pulses=False, straightline=False,
+                  engine=None)
     if total_shots <= 0 or batch <= 0:
         raise ValueError(f'need positive total_shots/batch, got '
                          f'{total_shots}/{batch}')
@@ -428,6 +436,7 @@ def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
     return {
         'shots': shots_done,
         'n_progs': n_progs,
+        'engine': 'generic',     # program-as-data path (see above)
         'mean_pulses': acc.state['pulse_sum'] / shots_done,
         'err_rate': acc.state['err_shots'] / shots_done,
         # the integer numerator behind err_rate, per program — exact

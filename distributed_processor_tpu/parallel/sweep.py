@@ -35,7 +35,20 @@ else:                    # pragma: no cover - depends on jax version
 
 from .. import isa
 from ..sim.interpreter import (InterpreterConfig, _program_constants,
-                               _run_batch, _pad_meas)
+                               _run_batch, _run_batch_engine, _pad_meas,
+                               _soa_static, resolve_engine)
+
+
+def _mesh_engine(mp, cfg: InterpreterConfig):
+    """``(engine, prog)`` for the shard-local executor.  The sharded
+    paths predate the engine ladder and always ran the generic engine;
+    ``cfg.engine=None`` keeps that default (no auto-upgrade), while an
+    explicit engine resolves through the same ladder as simulate_batch
+    and runs inside every shard's local jit."""
+    if cfg.engine is None:
+        return 'generic', None
+    eng = resolve_engine(mp, cfg)
+    return eng, (_soa_static(mp) if eng != 'generic' else None)
 
 
 def _shotwise_init_regs(init_regs, n_shots, n_cores):
@@ -68,13 +81,15 @@ def sharded_simulate(mp, meas_bits, mesh, init_regs=None,
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)
+    eng, prog = _mesh_engine(mp, cfg)
 
     def local(mb, ir):
-        out = _run_batch(soa, spc, interp, sync_part, mb, cfg,
-                         mp.n_cores, ir)
+        out = _run_batch_engine(soa, spc, interp, sync_part, mb, cfg,
+                                mp.n_cores, ir, engine=eng, prog=prog)
         # drop scalar diagnostics: every remaining leaf is shot-leading
         out.pop('steps')
         out.pop('incomplete')
+        out.pop('op_hist', None)
         return out
 
     init_regs = _shotwise_init_regs(init_regs, meas_bits.shape[0],
@@ -104,10 +119,11 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
     n_shots = meas_bits.shape[0]
 
     init_regs = _shotwise_init_regs(init_regs, n_shots, mp.n_cores)
+    eng, prog = _mesh_engine(mp, cfg)
 
     def local(mb, ir):
-        out = _run_batch(soa, spc, interp, sync_part, mb, cfg,
-                         mp.n_cores, ir)
+        out = _run_batch_engine(soa, spc, interp, sync_part, mb, cfg,
+                                mp.n_cores, ir, engine=eng, prog=prog)
         pulse_sum = jnp.sum(out['n_pulses'], axis=0)      # [n_cores]
         err_shots = jnp.sum(jnp.any(out['err'] != 0, axis=1))
         qclk_sum = jnp.sum(out['qclk'], axis=0)
@@ -179,7 +195,10 @@ def sharded_multi_stats(mps, meas_bits, mesh, init_regs=None,
         cfg = InterpreterConfig(**kw)
     else:
         cfg = replace(cfg, **kw)
-    cfg = replace(cfg, record_pulses=False, straightline=False)
+    # program-as-data path: content-keyed engines would defeat the
+    # bucket amortization, so the vmapped generic engine always runs
+    cfg = replace(cfg, record_pulses=False, straightline=False,
+                  engine=None)
     soa, spc, interp, sync_part = _program_constants(mmp, cfg)
     traits = program_traits(mmp)
     n_progs, n_cores = mmp.n_progs, mmp.n_cores

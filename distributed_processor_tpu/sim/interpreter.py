@@ -50,6 +50,7 @@ import jax.numpy as jnp
 from .. import isa
 from ..elements import PHASE_BITS
 from ..hwconfig import FPGAConfig
+from ..utils.profiling import counter_get, counter_inc
 from .device import DEVICE_KINDS, STATEVEC_MAX_CORES
 from .oracle import (INIT_TIME, QCLK_RST_DELAY, MEAS_LATENCY,
                      STICKY_RACE_MARGIN)
@@ -178,6 +179,25 @@ class InterpreterConfig:
     # program sweeps); run-heavy single-program workloads (the bench)
     # opt in.
     straightline: bool = False
+    # engine ladder selector (resolve_engine): None (default) keeps the
+    # legacy ``straightline`` tri-state semantics above; 'generic' /
+    # 'straightline' / 'block' force an engine ('straightline' and
+    # 'block' raise with the reason when the program is ineligible);
+    # 'auto' walks the ladder — straightline if eligible and small
+    # enough to unroll, else block if eligible and the deduped body
+    # total is under BLOCK_AUTO_MAX_UNROLL, else generic.  The block
+    # engine (:func:`_exec_blocks`) keys the jit cache on program
+    # CONTENT like the straight-line engine, so compile-bound workloads
+    # should stay on 'generic'.
+    engine: str = None
+    # per-opcode executed-instruction histogram: adds an
+    # ``op_hist[N_KINDS]`` output counting retired instructions per
+    # kind (summed over shots and cores).  Engine-invariant — the same
+    # program retires the same instructions on every engine — which is
+    # what makes block mode's "only pay for opcodes present" win
+    # observable without trusting the engine under test.  Off by
+    # default: it adds a [B, C, N_KINDS] loop carry.
+    opcode_histogram: bool = False
     alu_instr_clks: int = 5
     jump_cond_clks: int = 5
     jump_fproc_clks: int = 8
@@ -186,6 +206,11 @@ class InterpreterConfig:
 
     @classmethod
     def from_fpga_config(cls, fpga_config: FPGAConfig, **kw) -> 'InterpreterConfig':
+        # the hwconfig-resident LUT contents flow through unless the
+        # caller overrides them (explicit kw wins, like every field)
+        if getattr(fpga_config, 'meas_lut_mask', ()):
+            kw.setdefault('lut_mask', tuple(fpga_config.meas_lut_mask))
+            kw.setdefault('lut_table', tuple(fpga_config.meas_lut_table))
         return cls(alu_instr_clks=fpga_config.alu_instr_clks,
                    jump_cond_clks=fpga_config.jump_cond_clks,
                    jump_fproc_clks=fpga_config.jump_fproc_clks,
@@ -417,6 +442,8 @@ def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
            if cfg.record_pulses else {}),
         n_resets=z(B, C), rst_time=z(B, C, R),
         n_meas=z(B, C),
+        **({'op_hist': z(B, C, isa.N_KINDS)}
+           if cfg.opcode_histogram else {}),
         meas_avail=jnp.full((B, C, M), INT32_MAX, jnp.int32),
         **({'trace_pc': z(B, C, T), 'trace_time': z(B, C, T),
             'trace_off': z(B, C, T)}
@@ -1195,6 +1222,14 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         err = err | jnp.where(sync_adv & sync_err[:, None],
                               ERR_SYNC_DONE, 0)
 
+    hist = {}
+    if 'op_hist' in st:
+        # retired-instruction histogram: one count per (shot, core) per
+        # executed step, bucketed by kind — engine-invariant by
+        # construction (stalled cores retire nothing)
+        hist['op_hist'] = st['op_hist'] \
+            + _onehot(kind, isa.N_KINDS) * adv[..., None]
+
     tr = {}
     if cfg.trace:
         # instruction-trace export: the simulator's VCD analog
@@ -1213,7 +1248,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                 done=st['done'] | is_done, err=err, pp=pp, n_pulses=n_pulses,
                 n_resets=n_resets, rst_time=rst_time,
                 n_meas=n_meas, meas_avail=meas_avail,
-                **rec_update, **phys_updates, **tr)
+                **rec_update, **phys_updates, **hist, **tr)
 
 
 def _split_records(rec) -> dict:
@@ -1390,6 +1425,110 @@ def straightline_ineligible(mp, cfg: InterpreterConfig) -> str:
     if np.any(kind[:, -1] != isa.K_DONE):
         return 'program not DONE-terminated'
     return None
+
+
+# AUTO block-mode cap on the total DEDUPED unrolled body length: every
+# outer iteration traces one generic boundary step plus every deduped
+# body, so both compile time and per-iteration run time scale with this
+# sum — past it, the generic engine's shared step body wins back
+BLOCK_AUTO_MAX_UNROLL = 512
+
+ENGINES = ('auto', 'generic', 'block', 'straightline')
+
+
+def block_ineligible(mp, cfg: InterpreterConfig) -> str:
+    """Why ``(mp, cfg)`` cannot run on the block-compiled engine
+    (:func:`_exec_blocks`) — ``None`` when it can.
+
+    Block mode keeps loops, forward/backward jumps, SYNC, cross-core
+    fproc reads, and non-DONE termination (the generic boundary step
+    handles all of them), so almost everything straightline rejects is
+    fine here.  What it cannot keep:
+
+    * trace mode — per-instruction-step trace writes are indexed by the
+      step counter, which block mode collapses to iterations;
+    * the statevec event-ordering gate — pulse triggers must be globally
+      serialized per instruction step;
+    * the LUT fabric with fproc reads — a LUT read consumes the LATEST
+      bit of every masked producer, so the served value depends on how
+      producer instructions interleave with the read; only per-step
+      dispatch reproduces the reference ordering.  (Sticky and fresh
+      reads are interleaving-final: once a producer's clock passes the
+      request, nothing it still executes can change the served value —
+      ``MEAS_LATENCY`` > ``STICKY_RACE_MARGIN`` — so block-granular
+      producer progress serves bit-identical data.)
+    """
+    kind = np.asarray(mp.soa.kind)
+    if cfg.trace:
+        return 'trace mode records per-instruction-step state'
+    if cfg.physics and cfg.device == 'statevec':
+        return 'statevec device (event-ordering gate needs the ' \
+               'generic engine)'
+    fmask = (kind == isa.K_ALU_FPROC) | (kind == isa.K_JUMP_FPROC)
+    if cfg.fabric == 'lut' and np.any(fmask):
+        return "fabric 'lut' with fproc reads (LUT reads latch the " \
+               "LATEST producer bits — interleaving-sensitive)"
+    return None
+
+
+@functools.lru_cache(maxsize=128)
+def _block_plan(blk: tuple):
+    """Cached block table for a static program: ``(bid_at, bodies)``
+    from :func:`isa.build_block_table` keyed on program content."""
+    soa_np = _soa_from_static(blk)
+    bid_at, bodies = isa.build_block_table(
+        {name: soa_np[:, :, _F[name]] for name in _FIELDS})
+    return bid_at, tuple(bodies)
+
+
+def _soa_traits(soa_np) -> tuple:
+    """:func:`program_traits` over a packed ``[C, N, F]`` field array."""
+    return (frozenset(int(k)
+                      for k in np.unique(soa_np[..., _F['kind']])),
+            bool(np.any(soa_np[..., _F['in0_is_reg']])),
+            bool(np.any(soa_np[..., _F['p_regsel']])))
+
+
+def resolve_engine(mp, cfg: InterpreterConfig) -> str:
+    """Resolve ``cfg.engine`` against the program: the engine ladder.
+
+    ``None`` preserves the legacy ``cfg.straightline`` tri-state
+    (straightline vs generic only); ``'generic'`` / ``'straightline'``
+    / ``'block'`` force an engine (the specialized engines raise with
+    the ineligibility reason); ``'auto'`` walks the ladder —
+    straight-line when eligible and small enough to unroll, else block
+    when eligible and the deduped body total is under
+    :data:`BLOCK_AUTO_MAX_UNROLL` (and at least one body exists), else
+    generic.  Returns one of ``'generic' | 'block' | 'straightline'``.
+    """
+    eng = cfg.engine
+    if eng is None:
+        return 'straightline' if use_straightline(mp, cfg) else 'generic'
+    if eng == 'generic':
+        return 'generic'
+    if eng == 'straightline':
+        reason = straightline_ineligible(mp, cfg)
+        if reason:
+            raise ValueError(f"engine='straightline' but the program "
+                             f"is ineligible: {reason}")
+        return 'straightline'
+    if eng == 'block':
+        reason = block_ineligible(mp, cfg)
+        if reason:
+            raise ValueError(f"engine='block' but the program is "
+                             f"ineligible: {reason}")
+        return 'block'
+    if eng == 'auto':
+        if straightline_ineligible(mp, cfg) is None \
+                and mp.n_instr <= SL_AUTO_MAX_INSTR:
+            return 'straightline'
+        if block_ineligible(mp, cfg) is None:
+            _, bodies = _block_plan(_soa_static(mp))
+            if bodies and sum(L for _, L in bodies) \
+                    <= BLOCK_AUTO_MAX_UNROLL:
+                return 'block'
+        return 'generic'
+    raise ValueError(f'unknown engine {eng!r}; one of {ENGINES} or None')
 
 
 def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
@@ -1610,6 +1749,12 @@ def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
             err_i = err_i | jnp.where(active & j(m_fproc) & f_race,
                                       ERR_STICKY_RACE, 0)
 
+        if 'op_hist' in st:
+            oh_kind = (kind[:, None]
+                       == np.arange(isa.N_KINDS)[None, :]).astype(np.int32)
+            st['op_hist'] = st['op_hist'] \
+                + active[..., None] * jnp.asarray(oh_kind)[None]
+
         # ---- next pc / time / offset / done -------------------------
         pc_next = jnp.int32(i + 1)
         if has(m_jmpi | m_jcond | m_jfp):
@@ -1654,10 +1799,325 @@ def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
     return st
 
 
+def _exec_block_body(st: dict, act, rows_np, spc, interp,
+                     cfg: InterpreterConfig, dev=None) -> dict:
+    """One deduplicated superinstruction: execute the ``[C, L, F]``
+    instruction run ``rows_np`` for the lanes/cores selected by ``act``
+    [B, C] (already ``bid == k``-masked and live).
+
+    Same per-row static specialization as :func:`_exec_straightline`
+    restricted to the body-safe kinds (:data:`isa.BLOCK_BODY_KINDS`):
+    no fproc, jump, or sync handling — those are terminators, refined
+    out of every body by :func:`isa.build_block_table`.  DONE rows are
+    padding from :func:`isa.stack_soa` on heterogeneous-length
+    programs: they halt the lane inline without advancing ``pc``, so
+    the retired state matches the generic engine bit-for-bit.  ``pc``
+    advances RELATIVELY (``pc + 1`` per retired row) because a deduped
+    body runs for segments at different start addresses.
+    """
+    B, C = act.shape
+    L = rows_np.shape[1]
+    pmask_np = _PMASKS
+
+    for off in range(L):
+        f = {name: np.asarray(rows_np[:, off, _F[name]])
+             for name in _FIELDS}
+        kind = f['kind']
+        m_pw, m_pt = kind == isa.K_PULSE_WRITE, kind == isa.K_PULSE_TRIG
+        m_rst, m_idle = kind == isa.K_PULSE_RESET, kind == isa.K_IDLE
+        m_regalu, m_incq = kind == isa.K_REG_ALU, kind == isa.K_INC_QCLK
+        m_done = kind == isa.K_DONE
+        m_alu = m_regalu | m_incq
+        has = lambda m: bool(np.any(m))
+        j = lambda a: jnp.asarray(np.asarray(a))[None]       # [1, C]
+
+        active = act & ~st['done']
+        time, offset, regs = st['time'], st['offset'], st['regs']
+        err_i = jnp.zeros((B, C), jnp.int32)
+
+        def reg_read_static(addr_c):
+            oh = (np.asarray(addr_c)[:, None]
+                  == np.arange(isa.N_REGS)[None, :]).astype(np.int32)
+            return jnp.sum(regs * jnp.asarray(oh)[None], axis=-1)
+
+        # ---- ALU (REG_ALU / INC_QCLK only) --------------------------
+        if has(m_alu):
+            in0 = jnp.where(j(f['in0_is_reg'] == 1),
+                            reg_read_static(f['in0_reg']), j(f['imm'])) \
+                if np.any(f['in0_is_reg'][m_alu]) else j(f['imm'])
+            in1 = jnp.int32(0)
+            if has(m_regalu):
+                in1 = reg_read_static(f['in1_reg'])
+            if has(m_incq):
+                in1 = jnp.where(j(m_incq), time - offset, in1)
+            alu_res = _alu_vec(j(f['alu_op']), in0, in1)
+            if has(m_regalu):
+                wr = active & j(m_regalu)
+                wr_oh = (np.asarray(f['out_reg'])[:, None]
+                         == np.arange(isa.N_REGS)[None, :])
+                regs = jnp.where(wr[..., None] & jnp.asarray(wr_oh)[None],
+                                 alu_res[..., None], regs)
+                st['regs'] = regs
+        else:
+            alu_res = jnp.int32(0)
+
+        # ---- pulse latch + trigger ----------------------------------
+        pp = st['pp']
+        if has(m_pw | m_pt):
+            is_pulse = active & j(m_pw | m_pt)
+            imm_vals = np.stack([f['p_env'], f['p_phase'], f['p_freq'],
+                                 f['p_amp'], f['p_cfg']], -1)   # [C, 5]
+            wen = ((f['p_wen'][:, None] >> np.arange(5)) & 1) == 1
+            if np.any(f['p_regsel']):
+                rsel = ((f['p_regsel'][:, None] >> np.arange(5)) & 1)
+                regval = reg_read_static(f['p_reg'])
+                cand = jnp.where(jnp.asarray(rsel == 1)[None],
+                                 regval[..., None],
+                                 jnp.asarray(imm_vals)[None]) \
+                    & jnp.asarray(pmask_np)
+            else:
+                cand = jnp.asarray((imm_vals & pmask_np))[None]
+            pp = jnp.where(is_pulse[..., None] & jnp.asarray(wen)[None],
+                           cand, pp)
+            st['pp'] = pp
+
+        trig = offset + j(f['cmd_time'])
+        if has(m_pt):
+            fire = active & j(m_pt)
+            err_i = err_i | jnp.where(fire & (trig < time),
+                                      ERR_MISSED_TRIG, 0)
+            trig = jnp.maximum(trig, time)
+            elem = pp[..., 4] & 0b11
+            oh_elem = _onehot(jnp.minimum(elem, spc.shape[1] - 1),
+                              spc.shape[1])
+            spc_e = _ohsel(spc[None], oh_elem)
+            interp_e = _ohsel(interp[None], oh_elem)
+            env_len = (pp[..., 0] >> 12) & 0xfff
+            nsamp = env_len * 4 * interp_e
+            dur = jnp.where(env_len == 0xfff, 0,
+                            (nsamp + spc_e - 1) // spc_e)
+            err_i = err_i | jnp.where(
+                fire & (st['n_pulses'] >= cfg.max_pulses),
+                ERR_PULSE_OVERFLOW, 0)
+            if cfg.record_pulses:
+                rec_vals = jnp.stack(
+                    [j(f['cmd_time']) * jnp.ones_like(trig), trig,
+                     pp[..., 0], pp[..., 1], pp[..., 2], pp[..., 3],
+                     pp[..., 4], elem, dur], axis=-1)
+                oh_pslot = _onehot(
+                    jnp.minimum(st['n_pulses'], cfg.max_pulses - 1),
+                    cfg.max_pulses)
+                pwrite = (oh_pslot == 1) \
+                    & (fire & (st['n_pulses'] < cfg.max_pulses))[..., None]
+                FR, P = len(_REC_FIELDS), cfg.max_pulses
+                st['rec'] = jnp.where(
+                    pwrite[:, :, None, :], rec_vals[:, :, :, None],
+                    st['rec'].reshape(B, C, FR, P)).reshape(B, C, FR * P)
+            st['n_pulses'] = st['n_pulses'] + fire.astype(jnp.int32)
+
+            is_meas_pulse = fire & (elem == cfg.meas_elem)
+            err_i = err_i | jnp.where(
+                is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
+                ERR_MEAS_OVERFLOW, 0)
+            oh_mslot = _onehot(jnp.minimum(st['n_meas'],
+                                           cfg.max_meas - 1), cfg.max_meas)
+            meas_avail = jnp.where(
+                (oh_mslot == 1) & is_meas_pulse[..., None],
+                (trig + dur + cfg.meas_latency)[..., None],
+                st['meas_avail'])
+            cw_clks = 0
+            if cfg.physics and cfg.cw_horizon > 0:
+                cw_clks = (cfg.cw_horizon + spc_e - 1) // spc_e
+                meas_avail = jnp.where(
+                    (oh_mslot == 1) & (is_meas_pulse
+                                       & (env_len == 0xfff))[..., None],
+                    (trig + cw_clks + cfg.meas_latency)[..., None],
+                    meas_avail)
+            elif cfg.physics:
+                err_i = err_i | jnp.where(
+                    is_meas_pulse & (env_len == 0xfff), ERR_CW_MEAS, 0)
+            st['meas_avail'] = meas_avail
+            st['n_meas'] = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
+
+            # physics co-state: the SAME helper as _step and the
+            # straightline engine, so the physics cannot drift
+            if cfg.physics:
+                mwr = (oh_mslot == 1) & is_meas_pulse[..., None]
+                dev_updates, state_bit = _device_1q_pulse(
+                    st, cfg, dev, fire, elem, pp, trig, oh_mslot,
+                    is_meas_pulse)
+                st.update(dev_updates)
+                st['meas_state'] = jnp.where(mwr, state_bit[..., None],
+                                             st['meas_state'])
+                st['meas_amp'] = jnp.where(mwr, pp[..., 3:4],
+                                           st['meas_amp'])
+                st['meas_phase'] = jnp.where(mwr, pp[..., 1:2],
+                                             st['meas_phase'])
+                st['meas_freq'] = jnp.where(mwr, pp[..., 2:3],
+                                            st['meas_freq'])
+                st['meas_gtime'] = jnp.where(mwr, trig[..., None],
+                                             st['meas_gtime'])
+                st['meas_env'] = jnp.where(mwr, pp[..., 0:1],
+                                           st['meas_env'])
+
+        # ---- phase reset / idle -------------------------------------
+        if has(m_rst):
+            is_rst = active & j(m_rst)
+            oh_rslot = _onehot(jnp.minimum(st['n_resets'],
+                                           cfg.max_resets - 1),
+                               cfg.max_resets)
+            st['rst_time'] = jnp.where((oh_rslot == 1) & is_rst[..., None],
+                                       time[..., None], st['rst_time'])
+            st['n_resets'] = st['n_resets'] + is_rst.astype(jnp.int32)
+        if has(m_idle):
+            is_idle = active & j(m_idle)
+            idle_end = offset + j(f['cmd_time'])
+            err_i = err_i | jnp.where(is_idle & (time > idle_end),
+                                      ERR_MISSED_TRIG, 0)
+            idle_end = jnp.maximum(idle_end, time)
+
+        if 'op_hist' in st:
+            oh_kind = (kind[:, None]
+                       == np.arange(isa.N_KINDS)[None, :]).astype(np.int32)
+            st['op_hist'] = st['op_hist'] \
+                + active[..., None] * jnp.asarray(oh_kind)[None]
+
+        # ---- next pc / time / offset / done (pc is RELATIVE) --------
+        st['pc'] = jnp.where(active & ~j(m_done), st['pc'] + 1, st['pc'])
+        time_next = time
+        if has(m_pt):
+            time_next = jnp.where(j(m_pt), trig + cfg.pulse_load_clks,
+                                  time_next)
+        if has(m_pw | m_rst):
+            time_next = jnp.where(j(m_pw | m_rst),
+                                  time + cfg.pulse_regwrite_clks,
+                                  time_next)
+        if has(m_idle):
+            time_next = jnp.where(j(m_idle),
+                                  idle_end + cfg.pulse_load_clks,
+                                  time_next)
+        if has(m_regalu | m_incq):
+            time_next = jnp.where(j(m_regalu | m_incq),
+                                  time + cfg.alu_instr_clks, time_next)
+        st['time'] = jnp.where(active, time_next, time)
+        if has(m_incq):
+            st['offset'] = jnp.where(active & j(m_incq), time - alu_res,
+                                     offset)
+        st['err'] = st['err'] | jnp.where(active, err_i, 0)
+        st['done'] = st['done'] | (active & j(m_done))
+
+    return st
+
+
+def _exec_blocks(st0: dict, blk: tuple, spc, interp, sync_part, meas_bits,
+                 meas_valid, cfg: InterpreterConfig, dev=None) -> dict:
+    """The block-compiled engine: an outer while_loop over CFG blocks.
+
+    Per iteration, each core either (a) takes ONE generic :func:`_step`
+    — it is at a terminator (branch / fproc / sync / non-block
+    position), where dynamic dispatch, fproc serves, sync exchange,
+    and physics pause must happen — or (b) retires an ENTIRE deduped
+    straight-line block via its specialized superinstruction.  The
+    boundary step runs first (cores already parked at a block start
+    are suppressed by reverting their per-core state slices — sound
+    because every ``_step`` write is a per-core select, and cross-core
+    fproc/sync reads only consume the iteration-START state either
+    way); block ids are then recomputed so a core the boundary step
+    just advanced onto a block start retires that block in the SAME
+    iteration, and each deduped body runs masked by its id.  Masked
+    application over the deduped body set is the vectorized form of a
+    per-core ``lax.switch``: lanes diverge per (shot, core), so a
+    scalar switch cannot dispatch them.
+
+    ``_steps`` counts OUTER ITERATIONS here (each retires up to a full
+    block per core), so ``stats['steps']`` is the engine's dispatch
+    count — the quantity the engine ladder exists to shrink — and
+    ``cfg.max_steps`` bounds iterations, never binding earlier than
+    the generic engine's per-instruction budget.  Quiescence, deadlock
+    flagging, physics pause, and the exactness select mirror
+    :func:`_exec_loop` one-for-one.
+    """
+    soa_np = _soa_from_static(blk)
+    bid_at, bodies = _block_plan(blk)
+    traits = _soa_traits(soa_np)
+    B, C = st0['pc'].shape
+    N = soa_np.shape[1]
+    soa = jnp.asarray(soa_np)
+    # +1-encoded lookup so any out-of-range pc decodes to "no block"
+    bid_tab = jnp.asarray(np.asarray(bid_at) + 1)
+
+    def block_id(pc):
+        if N <= _FETCH_ONEHOT_MAX:
+            oh = (pc[..., None] == jnp.arange(N, dtype=jnp.int32)) \
+                .astype(jnp.int32)
+            return jnp.sum(bid_tab[None, None, :] * oh, axis=-1) - 1
+        b = bid_tab[jnp.clip(pc, 0, N - 1)]
+        return jnp.where((pc >= 0) & (pc < N), b, 0) - 1
+
+    def cond(st):
+        settled = jnp.all(st['done'], axis=-1)
+        if cfg.physics:
+            settled = settled | st['paused']
+        return (~jnp.all(settled)) & (st['_steps'] < cfg.max_steps)
+
+    def body(st):
+        steps = st.pop('_steps')
+        paused = st.pop('paused') if cfg.physics else None
+        st_in = st
+        # (1) boundary step, suppressed for cores parked at a block
+        # start (they retire the whole block below instead)
+        sup = block_id(st['pc']) >= 0
+        st2 = _step(st, steps, soa, spc, interp, sync_part, meas_bits,
+                    meas_valid, cfg, dev, traits)
+
+        def keep(old, new):
+            m = sup.reshape(sup.shape + (1,) * (new.ndim - 2))
+            return jnp.where(m, old, new)
+        st2 = {k: (keep(st_in[k], v)
+                   if getattr(v, 'ndim', 0) >= 2 and v.shape[:2] == (B, C)
+                   else v)
+               for k, v in st2.items()}
+        # (2) superinstructions: suppressed cores + cores the boundary
+        # step just advanced onto a block start (bid fixed up front, so
+        # a body that ends on another block's start waits an iteration)
+        bid = block_id(st2['pc'])
+        for k, (s, L) in enumerate(bodies):
+            st2 = _exec_block_body(
+                st2, (bid == jnp.int32(k)) & ~st2['done'],
+                soa_np[:, s:s + L, :], spc, interp, cfg, dev)
+        # (3) quiescence / pause / deadlock / exactness per _exec_loop
+        same = jnp.all((st2['pc'] == st_in['pc'])
+                       & (st2['time'] == st_in['time'])
+                       & (st2['done'] == st_in['done']), axis=-1)
+        if cfg.physics:
+            pending = jnp.any(st2['phys_wait'] & ~st2['done'], axis=-1)
+            st2['paused'] = paused | (same & pending)
+            hard = same & ~pending
+        else:
+            hard = same
+        st2['err'] = jnp.where(hard[:, None] & ~st2['done'],
+                               st2['err'] | ERR_FPROC_DEADLOCK, st2['err'])
+        st2['done'] = st2['done'] | hard[:, None]
+        settled_in = jnp.all(st_in['done'], axis=-1)
+        if cfg.physics:
+            st_in = dict(st_in, paused=paused)
+            settled_in = settled_in | paused
+        ok = (steps < cfg.max_steps) & ~jnp.all(settled_in)
+        st2 = {k: jnp.where(ok, v, st_in[k]) for k, v in st2.items()}
+        st2['_steps'] = jnp.where(ok, steps + 1, steps)
+        return st2
+
+    return jax.lax.while_loop(cond, body, st0)
+
+
 def _finalize(st: dict, cfg: InterpreterConfig) -> dict:
     steps = st.pop('_steps')
     if cfg.record_pulses:
         st.update(_split_records(st.pop('rec')))
+    if 'op_hist' in st:
+        # [B, C, N_KINDS] carry -> one [N_KINDS] retired-instruction
+        # histogram per batch (engine-invariant; see opcode_histogram)
+        st['op_hist'] = jnp.sum(st['op_hist'], axis=(0, 1))
     st['qclk'] = st['time'] - st['offset']
     st['steps'] = steps
     st['incomplete'] = ~jnp.all(st['done'])
@@ -1693,6 +2153,38 @@ def _run_batch(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
     return _finalize(st, cfg)
 
 
+def _run_batch_engine(soa, spc, interp, sync_part, meas_bits,
+                      cfg: InterpreterConfig, n_cores: int, init_regs=None,
+                      traits=None, engine: str = 'generic',
+                      prog: tuple = None) -> dict:
+    """Engine-dispatched :func:`_run_batch` for callers that build their
+    own jit boundary (the shard_map locals in ``parallel.sweep``):
+    ``engine`` is a RESOLVED engine name (:func:`resolve_engine`) and
+    ``prog`` the :func:`_soa_static` tuple the specialized engines
+    trace against (must be a host constant at trace time)."""
+    if engine == 'generic':
+        return _run_batch(soa, spc, interp, sync_part, meas_bits, cfg,
+                          n_cores, init_regs, traits)
+    _check_fabric(cfg, n_cores)
+    B = meas_bits.shape[0]
+    st0 = _init_state(B, n_cores, cfg, init_regs)
+    st0['_steps'] = jnp.int32(0)
+    meas_valid = jnp.ones(meas_bits.shape, bool)
+    if engine == 'straightline':
+        st = _exec_straightline(st0, _soa_from_static(prog), spc, interp,
+                                meas_bits, meas_valid, cfg)
+    elif engine == 'block':
+        if cfg.physics:
+            st0['paused'] = jnp.zeros((B,), bool)
+        st = _exec_blocks(st0, prog, spc, interp, sync_part, meas_bits,
+                          meas_valid, cfg)
+        st.pop('paused', None)
+    else:
+        raise ValueError(f'unresolved engine {engine!r}')
+    st.pop('phys_wait', None)
+    return _finalize(st, cfg)
+
+
 def _run(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
          n_cores: int, init_regs=None, traits=None) -> dict:
     """Single-shot wrapper: meas_bits ``[n_cores, max_meas]``."""
@@ -1700,7 +2192,7 @@ def _run(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
         init_regs = jnp.asarray(init_regs, jnp.int32)[None]
     out = _run_batch(soa, spc, interp, sync_part, meas_bits[None], cfg,
                      n_cores, init_regs, traits)
-    return {k: (v if k in ('steps', 'incomplete') else v[0])
+    return {k: (v if k in ('steps', 'incomplete', 'op_hist') else v[0])
             for k, v in out.items()}
 
 
@@ -1723,27 +2215,35 @@ def _run_batch_sl_jit(spc, interp, meas_bits, cfg, n_cores, init_regs,
                       sl=None):
     """Injected-bits batch on the straight-line executor (one pass —
     with every bit valid a lane can never stall)."""
-    _check_fabric(cfg, n_cores)
-    B = meas_bits.shape[0]
-    st0 = _init_state(B, n_cores, cfg, init_regs)
-    st0['_steps'] = jnp.int32(0)
-    meas_valid = jnp.ones(meas_bits.shape, bool)
-    st = _exec_straightline(st0, _soa_from_static(sl), spc, interp,
-                            meas_bits, meas_valid, cfg)
-    st.pop('phys_wait', None)
-    return _finalize(st, cfg)
+    return _run_batch_engine(None, spc, interp, None, meas_bits, cfg,
+                             n_cores, init_regs, engine='straightline',
+                             prog=sl)
 
 
-# trace probe for the shape-bucket contract (tests assert EXACTLY one
-# retrace per bucket): incremented at trace time, i.e. once per jit
-# cache miss of the multi-program executor
-_MULTI_TRACE_COUNT = 0
+@functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'blk'))
+def _run_batch_blk_jit(spc, interp, sync_part, meas_bits, cfg, n_cores,
+                       init_regs, blk=None):
+    """Injected-bits batch on the block-compiled engine.  ``blk`` is the
+    content-keyed static program (:func:`_soa_static`), so identical
+    programs share one cache entry and the block table / superinstruction
+    specialization happen at trace time."""
+    counter_inc('block_trace')
+    return _run_batch_engine(None, spc, interp, sync_part, meas_bits, cfg,
+                             n_cores, init_regs, engine='block', prog=blk)
+
+
+def block_trace_count() -> int:
+    """How many times the block-engine executor has been traced in this
+    process (named counter ``'block_trace'`` — utils.profiling): the
+    retrace contract allows at most one per (bucket, engine) pair."""
+    return counter_get('block_trace')
 
 
 def multi_trace_count() -> int:
     """How many times the multi-program executor has been traced in
-    this process — a second same-shape ensemble must not move it."""
-    return _MULTI_TRACE_COUNT
+    this process — a second same-shape ensemble must not move it.
+    (Named counter ``'multi_trace'`` in the utils.profiling registry.)"""
+    return counter_get('multi_trace')
 
 
 @functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'traits'))
@@ -1762,8 +2262,7 @@ def _run_multi_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
     (:func:`program_traits` of the stacked program) so the shared step
     body covers every member.
     """
-    global _MULTI_TRACE_COUNT
-    _MULTI_TRACE_COUNT += 1
+    counter_inc('multi_trace')
 
     def one_program(s, sy, mb, ir):
         return _run_batch(s, spc, interp, sy, mb, cfg, n_cores, ir,
@@ -1772,16 +2271,11 @@ def _run_multi_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
     return jax.vmap(one_program)(soa, sync_part, meas_bits, init_regs)
 
 
-# trace probe for the span contract (tests assert every FULL span of a
-# sweep shares ONE compiled executable): incremented at trace time,
-# i.e. once per jit cache miss of a span runner
-_SPAN_TRACE_COUNT = 0
-
-
 def span_trace_count() -> int:
     """How many times any span runner has been traced in this process —
-    a sweep whose span divides its batch count must move it by one."""
-    return _SPAN_TRACE_COUNT
+    a sweep whose span divides its batch count must move it by one.
+    (Named counter ``'span_trace'`` in the utils.profiling registry.)"""
+    return counter_get('span_trace')
 
 
 def make_span_runner(step):
@@ -1812,8 +2306,7 @@ def make_span_runner(step):
     @functools.partial(jax.jit, static_argnames=('span',),
                        donate_argnums=(0,))
     def run_span(carry_in, key, start, span: int):
-        global _SPAN_TRACE_COUNT
-        _SPAN_TRACE_COUNT += 1
+        counter_inc('span_trace')
 
         def body(carry, i):
             stats = step(jax.random.fold_in(key, i))
@@ -1862,13 +2355,15 @@ def simulate_multi_batch(mps, meas_bits, init_regs=None,
         cfg = InterpreterConfig(**kw)
     else:
         cfg = replace(cfg, **kw)
-    if cfg.straightline:
+    if cfg.straightline or cfg.engine in ('straightline', 'block'):
         raise ValueError(
             'simulate_multi_batch runs the generic engine only: the '
-            'straight-line executor keys its cache on program content, '
-            'the per-sequence compile this path amortizes away')
-    if cfg.straightline is None:
-        cfg = replace(cfg, straightline=False)
+            'straight-line and block executors key their caches on '
+            'program content, the per-sequence compile this path '
+            'amortizes away')
+    if cfg.straightline is None or cfg.engine is not None:
+        # normalize 'auto'/'generic' to the one legacy cache key
+        cfg = replace(cfg, straightline=False, engine=None)
     # _program_constants/program_traits consume the soa/tables attribute
     # surface, which MultiMachineProgram mirrors with a program axis;
     # traits become the UNION of instruction kinds over the ensemble
@@ -1934,14 +2429,20 @@ def simulate(mp, meas_bits=None, init_regs=None,
     if init_regs is None:
         init_regs = jnp.zeros((mp.n_cores, isa.N_REGS), jnp.int32)
     init_regs = jnp.asarray(init_regs, jnp.int32)
-    if use_straightline(mp, cfg):
+    eng = resolve_engine(mp, cfg)
+    if eng == 'straightline':
         out = _run_batch_sl_jit(spc, interp, meas_bits[None], cfg,
                                 mp.n_cores, init_regs[None],
                                 sl=_soa_static(mp))
-        return {k: (v if k in ('steps', 'incomplete') else v[0])
-                for k, v in out.items()}
-    return _run_jit(soa, spc, interp, sync_part, meas_bits, cfg, mp.n_cores,
-                    init_regs, program_traits(mp))
+    elif eng == 'block':
+        out = _run_batch_blk_jit(spc, interp, sync_part, meas_bits[None],
+                                 cfg, mp.n_cores, init_regs[None],
+                                 blk=_soa_static(mp))
+    else:
+        return _run_jit(soa, spc, interp, sync_part, meas_bits, cfg,
+                        mp.n_cores, init_regs, program_traits(mp))
+    return {k: (v if k in ('steps', 'incomplete', 'op_hist') else v[0])
+            for k, v in out.items()}
 
 
 def simulate_batch(mp, meas_bits, init_regs=None,
@@ -1959,8 +2460,13 @@ def simulate_batch(mp, meas_bits, init_regs=None,
         init_regs = jnp.broadcast_to(
             init_regs[None],
             (meas_bits.shape[0],) + tuple(init_regs.shape))
-    if use_straightline(mp, cfg):
+    eng = resolve_engine(mp, cfg)
+    if eng == 'straightline':
         return _run_batch_sl_jit(spc, interp, meas_bits, cfg, mp.n_cores,
                                  init_regs, sl=_soa_static(mp))
+    if eng == 'block':
+        return _run_batch_blk_jit(spc, interp, sync_part, meas_bits, cfg,
+                                  mp.n_cores, init_regs,
+                                  blk=_soa_static(mp))
     return _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
                           mp.n_cores, init_regs, program_traits(mp))

@@ -65,13 +65,20 @@ from ..ops.waveform import (PHASE_BITS, AMP_SCALE, complex_to_iq,
 from .device import DeviceModel, STATEVEC_MAX_CORES
 from .interpreter import (InterpreterConfig, _program_constants, _init_state,
                           _exec_loop, _finalize, _check_fabric,
-                          program_traits, use_straightline, _soa_static)
+                          program_traits, use_straightline, _soa_static,
+                          resolve_engine)
 
 
-def _sl_static(mp, cfg: InterpreterConfig):
-    """Static straight-line program for the physics epoch loop, or
-    ``None`` to use the generic engine (interpreter.use_straightline)."""
-    return _soa_static(mp) if use_straightline(mp, cfg) else None
+def _engine_static(mp, cfg: InterpreterConfig):
+    """``(sl, blk)`` content-keyed static programs for the physics epoch
+    loop: exactly one is non-``None`` when :func:`resolve_engine` picks
+    a specialized engine, both ``None`` for the generic engine."""
+    eng = resolve_engine(mp, cfg)
+    if eng == 'straightline':
+        return _soa_static(mp), None
+    if eng == 'block':
+        return None, _soa_static(mp)
+    return None, None
 
 # default-qchip X90 amplitude word: round(0.48 * (2^16 - 1))
 X90_AMP_DEFAULT = 31457
@@ -845,7 +852,7 @@ _build_tables_jit = functools.partial(
                                              'native_rng', 'rows',
                                              'dev_static', 'cw',
                                              'colored', 'classify3',
-                                             'sl'))
+                                             'sl', 'blk'))
 def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      tabs, freq_stack, g0, g1, sigma, inv_ring,
                      key, dev_params, meas_u,
@@ -858,7 +865,7 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      traj_key=None, dev_static: tuple = None,
                      cw: int = 0, colored: bool = False,
                      rho=None, g2=None, classify3: bool = False,
-                     sl: tuple = None) -> dict:
+                     sl: tuple = None, blk: tuple = None) -> dict:
     B = init_states.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
@@ -933,6 +940,13 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
             st = _exec_straightline(st, _soa_from_static(sl), spc, interp,
                                     bits, valid, cfg, dev)
             st['paused'] = jnp.any(st['phys_wait'] & ~st['done'], -1)
+        elif blk is not None:
+            # the block engine runs its own while_loop and manages the
+            # paused flag exactly like _exec_loop (pause at unresolved
+            # fproc reads only ever happens in the boundary step)
+            from .interpreter import _exec_blocks
+            st = _exec_blocks(st, blk, spc, interp, sync_part, bits,
+                              valid, cfg, dev)
         else:
             st = _exec_loop(st, soa, spc, interp, sync_part, bits, valid,
                             cfg, dev, traits)
@@ -1276,6 +1290,7 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
                                    model.resolve_mode, W,
                                    model.resolve_chunk, interps, rows,
                                    _tables_meta(model, W, interps, mp))
+    eng_sl, eng_blk = _engine_static(mp, cfg)
     return _run_physics_jit(
         soa, spc, interp, sync_part, init_states, init_regs, tables,
         freq_stack, as_iq(model.g0), as_iq(model.g1),
@@ -1289,4 +1304,4 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         jnp.float32(model.noise_ar1),
         g2=as_iq(model.g2) if model.g2 is not None else None,
         classify3=bool(model.classify3),
-        sl=_sl_static(mp, cfg))
+        sl=eng_sl, blk=eng_blk)

@@ -15,6 +15,35 @@ import numpy as np
 import jax
 
 
+# ---------------------------------------------------------------------------
+# named counters: one process-wide registry for trace/step probes
+# ---------------------------------------------------------------------------
+# The interpreter's retrace probes (multi_trace_count / span_trace_count /
+# block_trace_count) were separate module globals; they now share this
+# registry so tests and bench rows can snapshot every probe uniformly.
+# Counters are ints incremented at Python (trace) time — NOT inside traced
+# code — so they count host events (jit cache misses, dispatches), which
+# is exactly what the retrace-contract tests assert on.
+
+_COUNTERS: dict = {}
+
+
+def counter_inc(name: str, amount: int = 1) -> int:
+    """Increment (and return) the named counter."""
+    _COUNTERS[name] = _COUNTERS.get(name, 0) + amount
+    return _COUNTERS[name]
+
+
+def counter_get(name: str) -> int:
+    """Current value of the named counter (0 if never incremented)."""
+    return _COUNTERS.get(name, 0)
+
+
+def counters() -> dict:
+    """Snapshot of every named counter."""
+    return dict(_COUNTERS)
+
+
 @contextlib.contextmanager
 def device_profile(logdir: str):
     """Capture an XLA device profile (view with TensorBoard/Perfetto)."""
