@@ -40,6 +40,11 @@ Besides the final stdout line, every completed row is written
 incrementally and atomically to BENCH_ARTIFACT (default
 bench_partial.json next to this script; set empty to disable), so a
 killed or hung row cannot erase the rows already measured.
+BENCH_ROW_TIMEOUT (seconds, default 0 = off) arms a soft per-row
+watchdog around each secondary: a row that exceeds it records
+``{"error": "timeout"}`` in the artifact and the remaining rows still
+run.  Leave it off on CPU, where a single block-engine compile can
+legitimately take minutes.
 
 If the accelerator preflight fails all its backoff attempts, the bench
 reruns itself in a CPU child process (JAX_PLATFORMS=cpu, CPU-sized
@@ -71,6 +76,7 @@ cache was warm, so the number is never silently flattered.
 import glob
 import json
 import os
+import signal
 import sys
 import time
 import zlib
@@ -123,6 +129,38 @@ def _cache_state() -> str:
 def _fmt_sps(v):
     """Secondary shots/s: number, error string, or None (not measured)."""
     return round(v, 1) if isinstance(v, float) else v
+
+
+class _RowTimeout(Exception):
+    pass
+
+
+def _timed_row(fn):
+    """Run one secondary row under the per-row watchdog.
+
+    ``BENCH_ROW_TIMEOUT`` (seconds, default 0 = off — CPU runs
+    routinely spend minutes in one compile) arms a SIGALRM timer around
+    the row; on expiry the row is abandoned with ``_RowTimeout`` and the
+    caller records ``{'error': 'timeout'}``, so one wedged secondary
+    cannot starve the rows after it.  SOFT: the alarm is delivered
+    between Python bytecodes, so a row stuck inside a single device
+    call is reaped when that call returns — the host-loop-structured
+    secondaries (probe rounds, scaling, ladder) check out promptly.
+    """
+    t = float(os.environ.get('BENCH_ROW_TIMEOUT', 0) or 0)
+    if not t or not hasattr(signal, 'SIGALRM'):
+        return fn()
+
+    def _alarm(signum, frame):
+        raise _RowTimeout(f'row exceeded BENCH_ROW_TIMEOUT={t:g}s')
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.setitimer(signal.ITIMER_REAL, t)
+    try:
+        return fn()
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
 
 
 class _ArtifactWriter:
@@ -1123,9 +1161,11 @@ def main():
     # guarded: a failure here must not discard the minutes of headline
     # measurement already taken
     try:
-        utilization = utilization_accounting(
-            mp, cfg, model, batch, elapsed / n_batches, int(res[4])) \
+        utilization = _timed_row(lambda: utilization_accounting(
+            mp, cfg, model, batch, elapsed / n_batches, int(res[4]))) \
             if secondaries else None
+    except _RowTimeout as e:
+        utilization = {'error': 'timeout', 'detail': str(e)}
     except Exception as e:      # pragma: no cover - defensive
         utilization = {'error': f'{type(e).__name__}: {e}'[:200]}
     # statevec roofline rows, from the interleaved probe medians
@@ -1143,8 +1183,10 @@ def main():
             sv_utils[nm] = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('utilization', utilization)
     try:
-        scaling = large_program_scaling(n_qubits, small_depth=depth) \
-            if secondaries else None
+        scaling = _timed_row(lambda: large_program_scaling(
+            n_qubits, small_depth=depth)) if secondaries else None
+    except _RowTimeout as e:
+        scaling = {'error': 'timeout', 'detail': str(e)}
     except Exception as e:      # pragma: no cover - defensive
         scaling = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('scaling', scaling)
@@ -1152,23 +1194,27 @@ def main():
     # ensemble in one shape-bucketed jit vs per-sequence content-keyed
     # compiles) — guarded like every secondary
     try:
-        multi_rb = multi_sequence_rb(
+        multi_rb = _timed_row(lambda: multi_sequence_rb(
             n_qubits, depth,
             n_seqs=int(os.environ.get('BENCH_MULTI_SEQS', 16)),
-            shots=int(os.environ.get('BENCH_MULTI_SHOTS', 4096))) \
+            shots=int(os.environ.get('BENCH_MULTI_SHOTS', 4096)))) \
             if secondaries else None
+    except _RowTimeout as e:
+        multi_rb = {'error': 'timeout', 'detail': str(e)}
     except Exception as e:      # pragma: no cover - defensive
         multi_rb = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('multi_sequence_rb', multi_rb)
     # dispatch-amortization row: host loop vs device-resident span on a
     # dispatch-bound sweep shape — guarded like every secondary
     try:
-        sweep_span = sweep_span_amortization(
+        sweep_span = _timed_row(lambda: sweep_span_amortization(
             n_qubits,
             shots=int(os.environ.get('BENCH_SWEEP_SHOTS', 131072)),
             batch=int(os.environ.get('BENCH_SWEEP_BATCH', 2048)),
             span=int(os.environ.get('BENCH_SWEEP_SPAN', 16)),
-            sigma=sigma) if secondaries else None
+            sigma=sigma)) if secondaries else None
+    except _RowTimeout as e:
+        sweep_span = {'error': 'timeout', 'detail': str(e)}
     except Exception as e:      # pragma: no cover - defensive
         sweep_span = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('sweep_span', sweep_span)
@@ -1180,7 +1226,10 @@ def main():
         if secondaries else 0
     if ladder_depth:
         try:
-            ladder = engine_ladder(n_qubits, ladder_depth)
+            ladder = _timed_row(lambda: engine_ladder(n_qubits,
+                                                      ladder_depth))
+        except _RowTimeout as e:
+            ladder = {'error': 'timeout', 'detail': str(e)}
         except Exception as e:  # pragma: no cover - defensive
             ladder = {'error': f'{type(e).__name__}: {e}'[:200]}
     else:

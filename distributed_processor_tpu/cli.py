@@ -146,6 +146,25 @@ def cmd_freqdump(args):
                       f'iq[1] {iq0.real:+.5f}{iq0.imag:+.5f}j')
 
 
+def _fault_table(fault_shots: dict) -> None:
+    """Print the nonzero trapped-shot counts to stderr (the JSON result
+    on stdout stays machine-parseable)."""
+    nz = {k: int(v) for k, v in fault_shots.items() if v}
+    if not nz:
+        return
+    w = max(len(k) for k in nz)
+    print('fault summary (trapped shots, docs/ROBUSTNESS.md):',
+          file=sys.stderr)
+    for k, v in nz.items():
+        print(f'  {k:<{w}}  {v}', file=sys.stderr)
+
+
+def _fault_shot_dict(fault) -> dict:
+    from .sim.interpreter import FAULT_CODES, fault_shot_counts
+    counts = np.asarray(fault_shot_counts(fault))
+    return {name: int(c) for (name, _), c in zip(FAULT_CODES, counts)}
+
+
 def cmd_run(args):
     sim = _make_sim(args)
     kw = {}
@@ -206,16 +225,28 @@ def cmd_run(args):
         kw['p1'] = args.p1
     if args.engine:
         kw['engine'] = args.engine
-    out = sim.run(_load_program(args.program, args.qasm), shots=args.shots,
-                  **kw)
+    if args.max_steps is not None:
+        kw['max_steps'] = args.max_steps
+    from .decoder import validate_program, ProgramValidationError
+    mp = sim.compile(_load_program(args.program, args.qasm))
+    try:
+        # pre-flight: reject always-wrong programs with instruction
+        # coordinates before any compile/dispatch cost
+        validate_program(mp, sim.interpreter_config(mp, **{
+            k: v for k, v in kw.items() if k == 'engine'}))
+    except ProgramValidationError as e:
+        raise SystemExit(str(e))
+    out = sim.run(mp, shots=args.shots, **kw)
     from .sim.interpreter import resolve_engine
     n_pulses = np.asarray(out['n_pulses'])
     err = np.asarray(out['err'])
+    faults = _fault_shot_dict(out['fault'])
     result = {
         'shots': args.shots,
         'engine': resolve_engine(out['_mp'], out['_cfg']),
         'mean_pulses_per_core': np.atleast_2d(n_pulses).mean(0).tolist(),
         'error_shots': int(np.any(np.atleast_2d(err) != 0, -1).sum()),
+        'fault_shots': faults,
         'steps': int(out['steps']),
     }
     if args.physics:
@@ -234,6 +265,9 @@ def cmd_run(args):
             result['class2_rate_per_core'] = \
                 (cls[..., 0] == 2).mean(0).tolist()
     print(json.dumps(result, indent=2))
+    _fault_table(faults)
+    if args.strict_faults and any(faults.values()):
+        raise SystemExit(2)
 
 
 def cmd_sweep(args):
@@ -259,8 +293,10 @@ def cmd_sweep(args):
             'such physics)')
     sim = _make_sim(args)
     mp = sim.compile(_load_program(args.program, args.qasm))
+    from .decoder import validate_program, ProgramValidationError
     from .sim.device import DeviceModel
     from .sim.physics import ReadoutPhysics
+    from .sim.interpreter import FaultError
     from .parallel import run_physics_sweep
     dev = DeviceModel(args.device,
                       detuning_hz=args.detuning_hz,
@@ -272,15 +308,32 @@ def cmd_sweep(args):
     model = ReadoutPhysics(sigma=args.sigma, p1_init=args.p1_init,
                            device=dev)
     cfg_kw = {'engine': args.engine} if args.engine else {}
-    out = run_physics_sweep(mp, model, args.shots, args.batch,
-                            key=args.key,
-                            cfg=sim.interpreter_config(mp, **cfg_kw),
-                            checkpoint=args.checkpoint,
-                            checkpoint_every=args.checkpoint_every,
-                            span=args.span,
-                            strict_resume=args.strict_resume)
+    if args.max_steps is not None:
+        cfg_kw['max_steps'] = args.max_steps
+    if args.strict_faults:
+        cfg_kw['fault_mode'] = 'strict'
+    cfg = sim.interpreter_config(mp, **cfg_kw)
+    try:
+        validate_program(mp, cfg)
+    except ProgramValidationError as e:
+        raise SystemExit(str(e))
+    try:
+        out = run_physics_sweep(mp, model, args.shots, args.batch,
+                                key=args.key, cfg=cfg,
+                                checkpoint=args.checkpoint,
+                                checkpoint_every=args.checkpoint_every,
+                                span=args.span,
+                                strict_resume=args.strict_resume)
+    except FaultError as e:
+        # the sweep completed (and checkpointed); the counts failed the
+        # strict gate — report the per-code table and exit nonzero
+        from .sim.interpreter import FAULT_CODES
+        _fault_table({name: int(n) for (name, _), n
+                      in zip(FAULT_CODES, e.counts)})
+        raise SystemExit(2)
     print(json.dumps({k: (v.tolist() if isinstance(v, np.ndarray) else v)
                       for k, v in out.items()}, indent=2))
+    _fault_table(out.get('fault_shots', {}))
 
 
 def cmd_trace(args):
@@ -400,6 +453,17 @@ def main(argv=None):
                         'generic fetch-dispatch; block/straightline '
                         'raise with the reason when ineligible '
                         '(default: generic)')
+    p.add_argument('--strict-faults', action='store_true',
+                   help='exit nonzero (status 2) if any shot trapped a '
+                        'runtime fault (budget exhaustion, record '
+                        'overflow, deadlock/starvation — see '
+                        'docs/ROBUSTNESS.md); default: report '
+                        'fault_shots counts and a summary table on '
+                        'stderr, exit 0')
+    p.add_argument('--max-steps', type=int, default=None,
+                   help='interpreter step budget override (default: '
+                        'sized by static loop analysis); shots still '
+                        'running at the budget trap budget_exhausted')
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser('sweep', help='physics-closed statistics sweep '
@@ -450,6 +514,16 @@ def main(argv=None):
                    help='interpreter engine ladder (see `run --help`); '
                         'the chosen engine is reported in the result '
                         'metadata')
+    p.add_argument('--strict-faults', action='store_true',
+                   help='run with fault_mode=strict: after the sweep '
+                        'completes (and checkpoints), exit nonzero '
+                        '(status 2) with a per-code table if any shot '
+                        'trapped a runtime fault (docs/ROBUSTNESS.md); '
+                        'default: fault_shots counts in the JSON result '
+                        'plus a stderr summary when nonzero')
+    p.add_argument('--max-steps', type=int, default=None,
+                   help='interpreter step budget override (see '
+                        '`run --help`)')
     p.set_defaults(fn=cmd_sweep)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')

@@ -191,6 +191,161 @@ class MachineProgram:
                 'max_pulses': max(worst_pulses, 1) + 2}
 
 
+class ProgramValidationError(ValueError):
+    """A machine program failed static validation.
+
+    ``errors`` is a list of ``(code, core, instr, message)`` tuples —
+    one per defect, with instruction coordinates — so callers (CLI
+    pre-flight, the fault-injection harness) can match on the failure
+    kind instead of parsing the message.  ``core``/``instr`` may be
+    ``None`` for program-wide defects (e.g. inconsistent sync sets).
+    """
+
+    def __init__(self, errors):
+        self.errors = list(errors)
+        lines = [f'[{code}] core={core} instr={instr}: {msg}'
+                 for code, core, instr, msg in self.errors]
+        super().__init__('program validation failed:\n  '
+                         + '\n  '.join(lines))
+
+    @property
+    def codes(self) -> set:
+        return {e[0] for e in self.errors}
+
+
+def _core_validation_errors(soa, core: int, cfg=None) -> list:
+    """Static defects of one core's ``[n_instr]`` instruction stream."""
+    kind = np.asarray(soa.kind[core])
+    jump_addr = np.asarray(soa.jump_addr[core])
+    N = len(kind)
+    errs = []
+    jump_kinds = (isa.K_JUMP_I, isa.K_JUMP_COND, isa.K_JUMP_FPROC)
+    exit_kinds = {isa.K_JUMP_COND, isa.K_JUMP_FPROC, isa.K_DONE}
+
+    bad_kind = (kind < 0) | (kind >= isa.N_KINDS)
+    for j in np.nonzero(bad_kind)[0]:
+        errs.append(('illegal_op', core, int(j),
+                     f'kind {int(kind[j])} outside [0, {isa.N_KINDS})'))
+
+    for j in np.nonzero(np.isin(kind, jump_kinds))[0]:
+        t = int(jump_addr[j])
+        if not 0 <= t < N:
+            errs.append(('jump_oob', core, int(j),
+                         f'jump target {t} outside [0, {N})'))
+
+    if not np.any(kind == isa.K_DONE):
+        errs.append(('no_done', core, None,
+                     'no DONE instruction — execution runs off the end '
+                     'of the command buffer'))
+
+    # provably infinite loop: a backward jump_i whose body [t, j] has no
+    # possible exit — no conditional/fproc branch, no DONE, and every
+    # other unconditional jump stays inside the body.  (Backward
+    # jump_fproc loops — the active-reset retry idiom — always have a
+    # data-dependent exit and are NOT flagged.)
+    for j in np.nonzero(kind == isa.K_JUMP_I)[0]:
+        t = int(jump_addr[j])
+        if not 0 <= t <= j:
+            continue
+        body = range(t, int(j) + 1)
+        if any(int(kind[i]) in exit_kinds for i in body):
+            continue
+        if any(int(kind[i]) == isa.K_JUMP_I
+               and not t <= int(jump_addr[i]) <= j for i in body):
+            continue
+        errs.append(('infinite_loop', core, int(j),
+                     f'unconditional backward jump to {t} encloses no '
+                     f'exit — provably infinite'))
+
+    if cfg is not None:
+        n_cores = np.asarray(soa.kind).shape[0] if soa.kind.ndim > 1 \
+            else 1
+        fmask = np.isin(kind, (isa.K_ALU_FPROC, isa.K_JUMP_FPROC))
+        fids = np.asarray(soa.func_id[core])
+        fabric = getattr(cfg, 'fabric', 'sticky')
+        for j in np.nonzero(fmask)[0]:
+            fid = int(fids[j])
+            if fabric == 'lut':
+                # lut fabric: func_id 0 = own fresh result, nonzero =
+                # the LUT output — which must actually be configured
+                if fid != 0 and (len(getattr(cfg, 'lut_mask', ()))
+                                 != n_cores
+                                 or not getattr(cfg, 'lut_table', ())):
+                    errs.append(('fproc_unreachable', core, int(j),
+                                 f'func_id {fid} reads the LUT but '
+                                 f'lut_mask/lut_table are not '
+                                 f'configured'))
+            elif not 0 <= fid < n_cores:
+                errs.append(('fproc_unreachable', core, int(j),
+                             f'func_id {fid} outside [0, {n_cores}) — '
+                             f'no core produces this result'))
+    return errs
+
+
+def validate_program(mp, cfg=None) -> None:
+    """Pre-flight static validation — defects caught here never reach a
+    jit, never burn a dispatch, and carry instruction coordinates the
+    runtime fault word cannot.
+
+    Checks, per core: instruction kinds decodable (``illegal_op``),
+    jump targets inside ``[0, n_instr)`` (``jump_oob``), a DONE
+    instruction present (``no_done``), no provably infinite
+    unconditional loop (``infinite_loop``); with ``cfg`` given, fproc
+    reads must name a producing core — or a configured LUT under
+    ``fabric='lut'`` (``fproc_unreachable``).  Across cores: if every
+    SYNC participant is branch-free, their barrier sequences must agree
+    (``sync_mismatch``) — a shorter partner parks the others at a
+    barrier that can never fill (runtime ``FAULT_SYNC_DEADLOCK``).
+    Data-dependent behavior (fproc-driven back-edges, register-bounded
+    loops) is deliberately NOT flagged: the validator only rejects
+    programs that are wrong on EVERY input; everything else is the
+    runtime fault word's job.
+
+    Accepts a :class:`MachineProgram` or a stacked
+    :class:`MultiMachineProgram` (every ensemble member is validated).
+    Raises :class:`ProgramValidationError` listing ALL defects.
+    """
+    kind_all = np.asarray(mp.soa.kind)
+    multi = kind_all.ndim == 3
+    errors = []
+    for p in range(kind_all.shape[0] if multi else 1):
+        soa = isa.SoAProgram(**{k: v[p] for k, v in
+                                mp.soa.asdict().items()}) \
+            if multi else mp.soa
+        kind = np.asarray(soa.kind)
+        C, N = kind.shape
+        errs = []
+        for c in range(C):
+            errs.extend(_core_validation_errors(soa, c, cfg=cfg))
+        # sync consistency: statically decidable only when every
+        # participant is branch-free (its barrier sequence is the
+        # textual one); any branch makes the sequence data-dependent
+        part = np.nonzero(np.any(kind == isa.K_SYNC, axis=1))[0]
+        if len(part) > 1:
+            jump_kinds = (isa.K_JUMP_I, isa.K_JUMP_COND,
+                          isa.K_JUMP_FPROC)
+            if not any(np.any(np.isin(kind[c], jump_kinds))
+                       for c in part):
+                seqs = {c: tuple(
+                    int(b) for b in np.asarray(soa.barrier[c])[
+                        kind[c] == isa.K_SYNC]) for c in part}
+                ref_c = int(part[0])
+                for c in part[1:]:
+                    if seqs[int(c)] != seqs[ref_c]:
+                        errs.append((
+                            'sync_mismatch', int(c), None,
+                            f'barrier sequence {seqs[int(c)]} != core '
+                            f'{ref_c}\'s {seqs[ref_c]} — the longer '
+                            f'sequence waits at a barrier that never '
+                            f'fills'))
+        if multi:
+            errs = [(code, (p, core) if core is not None else p,
+                     instr, msg) for code, core, instr, msg in errs]
+        errors.extend(errs)
+    if errors:
+        raise ProgramValidationError(errors)
+
+
 def extract_blocks(mp: 'MachineProgram') -> list:
     """Per-core CFG extraction: partition each core's instruction range
     into maximal straight-line blocks.

@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 
 from .. import isa
-from ..sim.interpreter import InterpreterConfig
+from ..sim.interpreter import (InterpreterConfig, FaultError, FAULT_CODES,
+                               _fault_policy, fault_shot_counts)
 from ..utils.results import SweepAccumulator
 from .sweep import physics_batch_stats
 
@@ -30,7 +31,9 @@ from .sweep import physics_batch_stats
 # v4: batch stats gained `clean_shots` (the survival denominator —
 # dividing the clean-shot numerator by total shots biased survival low
 # by the errored/unresolved fraction); v3 states lack the key
-FINGERPRINT_VERSION = 4
+# v5: batch stats gained `fault_shots` (per-code trapped-shot counts,
+# the trap-and-report runtime); v4 states lack the key
+FINGERPRINT_VERSION = 5
 
 
 def _jsonable(v):
@@ -129,13 +132,21 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
 
     Returns ``{'shots', 'mean_pulses' [C], 'meas1_rate' [C],
     'survival00_rate' (joint P(every first-slot bit reads 0) — the
-    multi-qubit RB survival), 'err_shots', 'incomplete_batches'}``.
+    multi-qubit RB survival), 'err_shots', 'fault_shots' (per-code
+    trapped-shot counts, see ``sim.interpreter.FAULT_CODES``),
+    'incomplete_batches'}``.  ``cfg.fault_mode='strict'`` raises
+    :class:`~..sim.interpreter.FaultError` after the sweep completes
+    (and checkpoints) if any shot trapped.
     """
     from ..sim.physics import (run_physics_batch, prepare_physics_tables,
                                validate_physics_tables)
     from dataclasses import replace
     cfg = replace(cfg, **cfg_kw) if cfg else InterpreterConfig(**cfg_kw)
     cfg = replace(cfg, record_pulses=False)       # stats only
+    # strict faults are a host-side reporting policy, not sweep identity:
+    # normalize to 'count' BEFORE the fingerprint and the jitted step, so
+    # checkpoints interchange between modes and the jit cache stays one
+    cfg, strict_faults = _fault_policy(cfg)
     if total_shots <= 0 or batch <= 0:
         raise ValueError(f'need positive total_shots/batch, got '
                          f'{total_shots}/{batch}')
@@ -236,6 +247,10 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     # errored/unresolved shots from the numerator, so dividing by
     # shots_done would bias the rate low by exactly that fraction
     clean = int(acc.state['clean_shots'])
+    faults = {name: int(n) for (name, _), n
+              in zip(FAULT_CODES, np.asarray(acc.state['fault_shots']))}
+    if strict_faults and any(faults.values()):
+        raise FaultError(acc.state['fault_shots'])
     from ..sim.interpreter import resolve_engine
     return {
         'shots': shots_done,
@@ -249,6 +264,9 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
         if clean else float('nan'),
         'clean_shots': clean,
         'err_shots': int(acc.state['err_shots']),
+        # per-code counts of shots that trapped (sim.interpreter
+        # FAULT_CODES order) — zero everywhere for a healthy sweep
+        'fault_shots': faults,
         'incomplete_batches': incomplete,
     }
 
@@ -314,7 +332,8 @@ def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
     ``err_rate [n_progs]``, ``err_shots [n_progs]`` (the summed int
     numerator behind ``err_rate`` — clean accounting matching
     ``run_physics_sweep``), ``mean_qclk [n_progs, n_cores]``, plus
-    ``shots`` (per program) and ``incomplete_batches``.
+    ``shots`` (per program), ``fault_shots`` (per-code name →
+    ``[n_progs]`` trapped-shot counts) and ``incomplete_batches``.
     """
     from dataclasses import replace
     from ..decoder import MultiMachineProgram, stack_machine_programs
@@ -332,6 +351,7 @@ def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
     # block) would retrace per sequence — always the vmapped generic
     cfg = replace(cfg, record_pulses=False, straightline=False,
                   engine=None)
+    cfg, strict_faults = _fault_policy(cfg)   # see run_physics_sweep
     if total_shots <= 0 or batch <= 0:
         raise ValueError(f'need positive total_shots/batch, got '
                          f'{total_shots}/{batch}')
@@ -373,6 +393,7 @@ def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
                         err_shots=jnp.sum(jnp.any(out['err'] != 0,
                                                   axis=1)),
                         qclk_sum=jnp.sum(out['qclk'], axis=0),
+                        fault_shots=fault_shot_counts(out['fault']),
                         incomplete=out['incomplete'].astype(jnp.int32))
         return jax.vmap(one)(soa, sync_part, bits, regs_dev)
 
@@ -433,6 +454,9 @@ def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
             f'did not finish (step budget); means include their partial '
             f'counts — raise max_steps or treat them as lower bounds',
             stacklevel=2)
+    fault_pp = np.asarray(acc.state['fault_shots'])   # [n_progs, n_codes]
+    if strict_faults and fault_pp.any():
+        raise FaultError(fault_pp.sum(axis=0))
     return {
         'shots': shots_done,
         'n_progs': n_progs,
@@ -443,5 +467,9 @@ def run_multi_sweep(mps, total_shots: int, batch: int, p1=0.5,
         # accounting a rate cannot carry (run_physics_sweep parity)
         'err_shots': np.asarray(acc.state['err_shots']).copy(),
         'mean_qclk': acc.state['qclk_sum'] / shots_done,
+        # per-program per-code trapped-shot counts, keyed by code name
+        # (run_physics_sweep parity; arrays because this is an ensemble)
+        'fault_shots': {name: fault_pp[:, i].copy() for i, (name, _)
+                        in enumerate(FAULT_CODES)},
         'incomplete_batches': incomplete,
     }

@@ -36,7 +36,8 @@ else:                    # pragma: no cover - depends on jax version
 from .. import isa
 from ..sim.interpreter import (InterpreterConfig, _program_constants,
                                _run_batch, _run_batch_engine, _pad_meas,
-                               _soa_static, resolve_engine)
+                               _soa_static, resolve_engine,
+                               fault_shot_counts)
 
 
 def _mesh_engine(mp, cfg: InterpreterConfig):
@@ -128,7 +129,8 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
         err_shots = jnp.sum(jnp.any(out['err'] != 0, axis=1))
         qclk_sum = jnp.sum(out['qclk'], axis=0)
         stats = dict(pulse_sum=pulse_sum, err_shots=err_shots,
-                     qclk_sum=qclk_sum)
+                     qclk_sum=qclk_sum,
+                     fault_shots=fault_shot_counts(out['fault']))
         return jax.tree.map(lambda x: jax.lax.psum(x, 'dp'), stats)
 
     fn = shard_map(local, mesh=mesh, in_specs=(P('dp'), P('dp')),
@@ -136,7 +138,8 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
     out = jax.jit(fn)(meas_bits, init_regs)
     return dict(mean_pulses=out['pulse_sum'] / n_shots,
                 err_rate=out['err_shots'] / n_shots,
-                mean_qclk=out['qclk_sum'] / n_shots)
+                mean_qclk=out['qclk_sum'] / n_shots,
+                fault_shots=out['fault_shots'])
 
 
 def physics_batch_stats(out: dict) -> dict:
@@ -165,6 +168,7 @@ def physics_batch_stats(out: dict) -> dict:
                              & clean).astype(jnp.int32)),
         clean_shots=jnp.sum(clean.astype(jnp.int32)),
         err_shots=jnp.sum(jnp.any(out['err'] != 0, axis=1)),
+        fault_shots=fault_shot_counts(out['fault']),
     )
 
 
@@ -224,7 +228,8 @@ def sharded_multi_stats(mps, meas_bits, mesh, init_regs=None,
             return dict(pulse_sum=jnp.sum(out['n_pulses'], axis=0),
                         err_shots=jnp.sum(jnp.any(out['err'] != 0,
                                                   axis=1)),
-                        qclk_sum=jnp.sum(out['qclk'], axis=0))
+                        qclk_sum=jnp.sum(out['qclk'], axis=0),
+                        fault_shots=fault_shot_counts(out['fault']))
         stats = jax.vmap(one)(soa, sync_part, mb, ir)
         return jax.tree.map(lambda x: jax.lax.psum(x, 'dp'), stats)
 
@@ -234,7 +239,8 @@ def sharded_multi_stats(mps, meas_bits, mesh, init_regs=None,
     out = jax.jit(fn)(meas_bits, init_regs)
     return dict(mean_pulses=out['pulse_sum'] / n_shots,
                 err_rate=out['err_shots'] / n_shots,
-                mean_qclk=out['qclk_sum'] / n_shots)
+                mean_qclk=out['qclk_sum'] / n_shots,
+                fault_shots=out['fault_shots'])
 
 
 def sharded_physics_stats(mp, model, key, shots: int, mesh,
@@ -275,7 +281,8 @@ def sharded_physics_stats(mp, model, key, shots: int, mesh,
     out = jax.jit(fn)()
     return dict(mean_pulses=out['pulse_sum'] / shots,
                 err_rate=out['err_shots'] / shots,
-                meas1_rate=out['meas1_sum'] / shots)
+                meas1_rate=out['meas1_sum'] / shots,
+                fault_shots=out['fault_shots'])
 
 
 def sharded_demod(adc, weights, mesh):

@@ -1,0 +1,437 @@
+"""Fault-injection harness: mutate valid machine programs, assert no
+injected defect is ever SILENT.
+
+The trap-and-report contract has two layers — the static validator
+(:func:`~distributed_processor_tpu.decoder.validate_program`) rejects
+programs that are wrong on every input before they reach a jit, and the
+runtime fault word traps data-dependent failures per lane — and this
+module is the adversarial check that the layers compose with no gap:
+every mutant is either rejected at decode, rejected by the validator,
+trapped with a nonzero ``fault_shots`` code by EVERY engine that runs
+it, or provably benign (a bit flip in a pulse parameter is a different
+valid program, not a fault).  A mutant that hangs, crashes an engine,
+or runs cleanly where its mutator guarantees breakage is a harness
+failure.
+
+Deterministic: every mutant derives from ``np.random.default_rng`` on
+the (seed, case index) pair, so a failing case name reproduces exactly.
+``tools/faultfuzz.py`` is the CLI front-end (``--quick`` for the tier-1
+flow); ``run_fuzz`` is the library entry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+from .. import isa
+from ..decoder import (machine_program_from_cmds, stack_machine_programs,
+                       validate_program, ProgramValidationError)
+from .interpreter import (InterpreterConfig, FAULT_CODES,
+                          fault_shot_counts, simulate_batch,
+                          simulate_multi_batch)
+
+ENGINES = ('generic', 'block', 'straightline')
+
+
+def _pulse(t: int = 10) -> int:
+    return isa.pulse_cmd(amp_word=1000, cfg_word=0, env_word=3, cmd_time=t)
+
+
+# ---------------------------------------------------------------------------
+# base programs — small, valid, covering the control-flow idioms the
+# mutators target (straight-line, counted loop, sync barrier, fproc)
+# ---------------------------------------------------------------------------
+
+def base_linear(rng) -> tuple:
+    n = int(rng.integers(2, 6))
+    core = [_pulse(10 + 20 * i) for i in range(n)] + [isa.done_cmd()]
+    return [list(core), list(core)], InterpreterConfig(max_steps=256)
+
+
+def base_loop(rng) -> tuple:
+    iters = int(rng.integers(2, 5))
+    core = [isa.alu_cmd('reg_alu', 'i', iters, 'id0', write_reg_addr=0),
+            _pulse(),
+            isa.alu_cmd('reg_alu', 'i', -1, 'add', 0, write_reg_addr=0),
+            isa.alu_cmd('jump_cond', 'i', 0, 'le', 0, jump_cmd_ptr=1),
+            isa.done_cmd()]
+    return [core], InterpreterConfig(max_steps=256)
+
+
+def base_sync(rng) -> tuple:
+    nb = int(rng.integers(1, 3))
+    cores = []
+    for c in range(2):
+        core = []
+        for b in range(nb):
+            core.append(_pulse(10 + 30 * b + 10 * c))
+            core.append(isa.sync(b))
+        core.append(isa.done_cmd())
+        cores.append(core)
+    return cores, InterpreterConfig(max_steps=256)
+
+
+def base_fproc(rng) -> tuple:
+    # core 0 produces a measurement (meas_elem=0: every pulse is a
+    # readout); core 1 blocks on core 0's FRESH result — the fabric
+    # where a producer finishing without measuring starves the reader
+    prod = [_pulse(10), isa.done_cmd()]
+    cons = [isa.alu_cmd('jump_fproc', 'i', 1, 'eq', jump_cmd_ptr=3,
+                        func_id=0),
+            _pulse(200), isa.done_cmd(), isa.done_cmd()]
+    return [prod, cons], InterpreterConfig(max_steps=256, fabric='fresh',
+                                           meas_elem=0)
+
+
+BASE_BUILDERS = (('linear', base_linear), ('loop', base_loop),
+                 ('sync', base_sync), ('fproc', base_fproc))
+
+
+# ---------------------------------------------------------------------------
+# mutants
+# ---------------------------------------------------------------------------
+
+_ALL_OUTCOMES = frozenset(
+    ('rejected_decode', 'illegal_op', 'jump_oob', 'no_done',
+     'infinite_loop', 'fproc_unreachable', 'sync_mismatch')
+    + tuple(name for name, _ in FAULT_CODES))
+
+
+@dataclass
+class Mutant:
+    """One mutated program plus the oracle for judging its outcome."""
+    name: str                 # '<base>+<mutator>#<index>'
+    cmds: list                # per-core 128-bit word lists
+    cfg: InterpreterConfig
+    expected: frozenset       # acceptable non-clean outcome labels
+    allow_clean: bool = False  # may the mutant legitimately run clean?
+
+
+def mut_bit_flip(rng, cmds, cfg):
+    """Flip one bit of one encoded word — anything can happen EXCEPT a
+    silent hang or an engine disagreement."""
+    c = int(rng.integers(len(cmds)))
+    i = int(rng.integers(len(cmds[c])))
+    out = [list(x) for x in cmds]
+    out[c][i] = int(out[c][i]) ^ (1 << int(rng.integers(128)))
+    return Mutant('', out, cfg, _ALL_OUTCOMES, allow_clean=True)
+
+
+def mut_truncate_done(rng, cmds, cfg):
+    """Overwrite a core's DONE terminators in place — on a MAX-LENGTH
+    core, so the stacker's DONE padding cannot quietly re-terminate it:
+    execution runs off the end of the buffer."""
+    n = max(len(core) for core in cmds)
+    longest = [c for c, core in enumerate(cmds) if len(core) == n]
+    c = longest[int(rng.integers(len(longest)))]
+    done = isa.done_cmd()
+    out = [list(x) for x in cmds]
+    out[c] = [_pulse(500) if w == done else w for w in out[c]]
+    return Mutant('', out, cfg,
+                  frozenset({'no_done', 'jump_oob', 'budget_exhausted'}))
+
+
+def mut_drop_sync_partner(rng, cmds, cfg):
+    """Remove one SYNC from one participant.
+
+    If the core keeps other SYNCs it stays a participant with a short
+    barrier sequence — statically inconsistent (validator) or a runtime
+    deadlock.  Removing a core's ONLY sync shrinks the participant set
+    instead (the interpreter derives participation from program
+    content), leaving a smaller barrier that is trivially satisfiable —
+    a semantic change, not a fault, so ``allow_clean``.  Half the time
+    a no-op forward branch is prepended to the mutated core, putting
+    the barrier sequence beyond static analysis and forcing the RUNTIME
+    deadlock trap to catch it.
+    """
+    syncs = [(c, i) for c, core in enumerate(cmds)
+             for i, w in enumerate(core)
+             if isa.decode_soa(isa.cmds_to_bytes([w])).kind[0]
+             == isa.K_SYNC]
+    if not syncs:
+        return None
+    c, i = syncs[int(rng.integers(len(syncs)))]
+    last_sync = sum(1 for cc, _ in syncs if cc == c) == 1
+    out = [list(x) for x in cmds]
+    del out[c][i]
+    if rng.integers(2):
+        # defeat the static check: a branch-free participant set is the
+        # validator's precondition (base programs have no other jumps,
+        # so no targets need re-aiming after the insert)
+        out[c] = [isa.alu_cmd('jump_cond', 'i', 0, 'ge', 0,
+                              jump_cmd_ptr=1)] + out[c]
+    return Mutant('', out, cfg,
+                  frozenset({'sync_mismatch', 'sync_deadlock',
+                             'budget_exhausted'}),
+                  allow_clean=last_sync)
+
+
+def mut_starve_fproc(rng, cmds, cfg):
+    """Drop the producer's measurement: a fresh-fabric reader starves."""
+    if cfg.fabric != 'fresh':
+        return None
+    out = [list(x) for x in cmds]
+    done = isa.done_cmd()
+    out[0] = [w for w in out[0] if w == done] or [done]
+    return Mutant('', out, cfg,
+                  frozenset({'fproc_starved', 'budget_exhausted'}))
+
+
+def mut_retarget_jump(rng, cmds, cfg):
+    """Point a jump outside the program: static jump_oob."""
+    soas = [isa.decode_soa(isa.cmds_to_bytes(core)) for core in cmds]
+    jumps = [(c, i) for c, s in enumerate(soas)
+             for i in np.nonzero(np.isin(
+                 s.kind, (isa.K_JUMP_I, isa.K_JUMP_COND,
+                          isa.K_JUMP_FPROC)))[0]]
+    if not jumps:
+        return None
+    c, i = jumps[int(rng.integers(len(jumps)))]
+    n = max(len(core) for core in cmds)
+    bad = n + int(rng.integers(1, 100))
+    out = [list(x) for x in cmds]
+    mask = ((1 << 8) - 1) << isa.JUMP_ADDR_POS
+    out[c][i] = (int(out[c][i]) & ~mask) \
+        + ((bad & 0xff) << isa.JUMP_ADDR_POS)
+    if not 0 <= (bad & 0xff) < n:   # 8-bit field may wrap in range
+        return Mutant('', out, cfg,
+                      frozenset({'jump_oob', 'budget_exhausted'}))
+    return Mutant('', out, cfg, _ALL_OUTCOMES, allow_clean=True)
+
+
+def mut_shrink_budget(rng, cmds, cfg):
+    """Valid program, starved step budget: BUDGET_EXHAUSTED — or clean
+    on an engine whose coarser step accounting (a block engine
+    iteration retires a whole superinstruction) finishes in budget;
+    completing a VALID program is always correct."""
+    return Mutant('', [list(x) for x in cmds],
+                  replace(cfg, max_steps=int(rng.integers(1, 3))),
+                  frozenset({'budget_exhausted'}), allow_clean=True)
+
+
+def mut_overflow_records(rng, cmds, cfg):
+    """Valid program, one-slot record budgets: overflow traps iff the
+    program emits more than one pulse/measurement."""
+    n_pulse = max(
+        int(np.sum(isa.decode_soa(isa.cmds_to_bytes(core)).kind
+                   == isa.K_PULSE_TRIG))
+        for core in cmds)
+    if n_pulse <= 1:
+        return None
+    exp = {'pulse_overflow'}
+    if cfg.meas_elem == 0:
+        exp.add('meas_overflow')
+    return Mutant('', [list(x) for x in cmds],
+                  replace(cfg, max_pulses=1, max_meas=1),
+                  frozenset(exp))
+
+
+MUTATORS = (('bit_flip', mut_bit_flip),
+            ('truncate_done', mut_truncate_done),
+            ('drop_sync', mut_drop_sync_partner),
+            ('starve_fproc', mut_starve_fproc),
+            ('retarget_jump', mut_retarget_jump),
+            ('shrink_budget', mut_shrink_budget),
+            ('overflow_records', mut_overflow_records))
+
+
+def gen_mutants(seed: int, n: int) -> list:
+    """``n`` deterministic mutants cycling (base × mutator) pairs."""
+    pairs = [(bn, bf, mn, mf) for bn, bf in BASE_BUILDERS
+             for mn, mf in MUTATORS]
+    out = []
+    k = 0
+    while len(out) < n:
+        bn, bf, mn, mf = pairs[k % len(pairs)]
+        rng = np.random.default_rng((seed, k))
+        cmds, cfg = bf(rng)
+        m = mf(rng, cmds, cfg)
+        k += 1
+        if m is None:
+            continue
+        m.name = f'{bn}+{mn}#{k - 1}'
+        out.append(m)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the checker
+# ---------------------------------------------------------------------------
+
+_TIMING_INDEPENDENT = frozenset({'pulse_overflow', 'meas_overflow',
+                                 'reset_overflow', 'illegal_op',
+                                 'jump_oob'})
+
+
+def _fault_names(fault) -> frozenset:
+    counts = np.asarray(fault_shot_counts(fault))
+    return frozenset(name for (name, _), c
+                     in zip(FAULT_CODES, counts) if c)
+
+
+def check_mutant(m: Mutant, engines=ENGINES, shots: int = 4) -> dict:
+    """Judge one mutant.  Returns ``{'verdict', 'detail'}`` where
+    verdict is ``rejected_decode | rejected_validator | trapped |
+    benign | SILENT | MISTRAPPED | INCONSISTENT``; the capitalized
+    verdicts are harness FAILURES."""
+    try:
+        mp = machine_program_from_cmds(m.cmds)
+    except (ValueError, OverflowError) as e:
+        ok = 'rejected_decode' in m.expected
+        return {'verdict': 'rejected_decode' if ok else 'MISTRAPPED',
+                'detail': str(e)}
+    try:
+        validate_program(mp, m.cfg)
+    except ProgramValidationError as e:
+        if e.codes & m.expected:
+            return {'verdict': 'rejected_validator',
+                    'detail': sorted(e.codes)}
+        return {'verdict': 'MISTRAPPED',
+                'detail': f'validator codes {sorted(e.codes)} not in '
+                          f'expected {sorted(m.expected)}'}
+    mb = np.zeros((shots, mp.n_cores, m.cfg.max_meas), np.int32)
+    per_engine = {}
+    for eng in engines:
+        cfg = replace(m.cfg, engine=eng)
+        try:
+            out = simulate_batch(mp, mb, cfg=cfg)
+        except ValueError as e:
+            if 'ineligible' in str(e):
+                continue            # engine doesn't apply to this shape
+            return {'verdict': 'MISTRAPPED',
+                    'detail': f'{eng} raised {e}'}
+        per_engine[eng] = _fault_names(out['fault'])
+    if not per_engine:
+        return {'verdict': 'MISTRAPPED', 'detail': 'no engine ran'}
+    # cross-engine agreement is required on the timing-INDEPENDENT
+    # codes; budget/deadlock/starvation depend on engine step
+    # accounting (a block iteration retires many instructions) and are
+    # judged per engine against the oracle instead
+    strict = {names & _TIMING_INDEPENDENT
+              for names in per_engine.values()}
+    if len(strict) > 1:
+        return {'verdict': 'INCONSISTENT', 'detail': {
+            k: sorted(v) for k, v in per_engine.items()}}
+    for eng, names in per_engine.items():
+        if not names:
+            if not m.allow_clean:
+                return {'verdict': 'SILENT',
+                        'detail': f'{eng}: expected '
+                                  f'{sorted(m.expected)}, no fault '
+                                  f'fired'}
+        elif not names & m.expected:
+            return {'verdict': 'MISTRAPPED',
+                    'detail': f'{eng} trapped {sorted(names)}, '
+                              f'expected {sorted(m.expected)}'}
+    fired = frozenset().union(*per_engine.values())
+    if fired:
+        return {'verdict': 'trapped', 'detail': sorted(fired)}
+    return {'verdict': 'benign', 'detail': sorted(per_engine)}
+
+
+def check_vmap_consistency(seed: int = 0, n: int = 8,
+                           shots: int = 4) -> int:
+    """Stack valid-after-mutation single-core programs and assert the
+    vmapped multi-program executable reports the SAME per-program fault
+    sets as per-program ``simulate_batch`` runs."""
+    mps, cfgs, singles = [], [], []
+    base_cfg = InterpreterConfig(max_steps=64)
+    k = 0
+    while len(mps) < n:
+        r = np.random.default_rng((seed, 7000 + k))
+        k += 1
+        cmds, _ = base_loop(r)
+        m = mut_shrink_budget(r, cmds, base_cfg) if k % 2 \
+            else Mutant('', cmds, base_cfg, frozenset(), allow_clean=True)
+        try:
+            mp = machine_program_from_cmds(m.cmds)
+            validate_program(mp, m.cfg)
+        except (ValueError, ProgramValidationError):
+            continue
+        mps.append(mp)
+        cfgs.append(m.cfg)
+    # one shared cfg: the TIGHTEST budget, so trapping programs trap in
+    # both the single and the stacked run
+    cfg = replace(base_cfg,
+                  max_steps=min(c.max_steps for c in cfgs))
+    for mp in mps:
+        mb = np.zeros((shots, mp.n_cores, cfg.max_meas), np.int32)
+        singles.append(_fault_names(
+            simulate_batch(mp, mb, cfg=cfg)['fault']))
+    mmp = stack_machine_programs(mps)
+    mb = np.zeros((mmp.n_progs, shots, mmp.n_cores, cfg.max_meas),
+                  np.int32)
+    out = simulate_multi_batch(mmp, mb, cfg=cfg)
+    bad = 0
+    for p in range(mmp.n_progs):
+        stacked = _fault_names(out['fault'][p])
+        if stacked != singles[p]:
+            bad += 1
+    return bad
+
+
+def check_mesh_consistency(seed: int = 0, n: int = 4,
+                           shots_per_prog: int = 8) -> int:
+    """Run a mutant ensemble through ``run_multi_sweep`` with and
+    without a dp=2 mesh and count fault-stat mismatches (0 = the
+    sharded reduction reports exactly the per-device faults).  Returns
+    -1 if fewer than 2 devices are available (check skipped)."""
+    import jax
+    from jax.sharding import Mesh
+    if len(jax.devices()) < 2:
+        return -1
+    from ..parallel.driver import run_multi_sweep
+    mps = []
+    k = 0
+    while len(mps) < n:
+        r = np.random.default_rng((seed, 9000 + k))
+        k += 1
+        cmds, _ = base_loop(r)
+        try:
+            mp = machine_program_from_cmds(cmds)
+            validate_program(mp)
+        except (ValueError, ProgramValidationError):
+            continue
+        mps.append(mp)
+    kw = dict(total_shots=shots_per_prog, batch=shots_per_prog,
+              key=seed, max_steps=6)   # starved: every program traps
+    ref = run_multi_sweep(mps, **kw)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ('dp',))
+    got = run_multi_sweep(mps, mesh=mesh, **kw)
+    bad = 0
+    for name, _ in FAULT_CODES:
+        if ref['fault_shots'][name].tolist() \
+                != got['fault_shots'][name].tolist():
+            bad += 1
+    return bad
+
+
+@dataclass
+class FuzzReport:
+    n: int = 0
+    verdicts: dict = field(default_factory=dict)
+    failures: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+def run_fuzz(seed: int = 0, n: int = 200, engines=ENGINES,
+             shots: int = 4, progress=None) -> FuzzReport:
+    """Fuzz ``n`` mutants; any SILENT/MISTRAPPED/INCONSISTENT verdict
+    is recorded as a failure (``report.ok``)."""
+    rep = FuzzReport()
+    for m in gen_mutants(seed, n):
+        res = check_mutant(m, engines=engines, shots=shots)
+        rep.n += 1
+        v = res['verdict']
+        rep.verdicts[v] = rep.verdicts.get(v, 0) + 1
+        if v not in ('rejected_decode', 'rejected_validator',
+                     'trapped', 'benign'):
+            rep.failures.append((m.name, v, res['detail']))
+        if progress and rep.n % 25 == 0:
+            progress(rep)
+    return rep

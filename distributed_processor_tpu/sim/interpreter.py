@@ -83,6 +83,63 @@ ERR_COFIRE_ORDER = 256   # statevec: an equal-trigger-time cross-core
                          # overlap is not a sequenced product either).
                          # Separate the pulses with a barrier/delay.
 
+# fault trap codes (per lane, per core) — the execution runtime's
+# hardware-honest failure channel (docs/ROBUSTNESS.md).  Distinct from
+# the ERR_* model-diagnostic bits above: a fault means the ENGINE could
+# not faithfully execute the program (budget ran out, a barrier can
+# never release, a word is malformed), so the shot's statistics are
+# untrustworthy.  Carried as one extra int32 in the while-loop state;
+# OR-ed on masks the step already computes, so fault-free programs are
+# bit-identical with and without the carry.
+FAULT_BUDGET_EXHAUSTED = 1   # steps hit max_steps with the lane live
+FAULT_SYNC_DEADLOCK = 2      # barrier wait that can never release
+                             # (partner done / not participating)
+FAULT_FPROC_STARVED = 4      # fproc wait with no producer able to
+                             # deliver (hard quiescence, not at a sync)
+FAULT_PULSE_OVERFLOW = 8     # emitted pulses exceed max_pulses
+FAULT_MEAS_OVERFLOW = 16     # measurements exceed max_meas
+FAULT_RESET_OVERFLOW = 32    # reset records exceed max_resets
+FAULT_ILLEGAL_OP = 64        # decoded kind outside the ISA, or fproc
+                             # func_id out of range for the fabric
+FAULT_JUMP_OOB = 128         # pc or taken branch target >= n_instr
+
+# name <-> bit registry, in bit order (docs + aggregation schema)
+FAULT_CODES = (
+    ('budget_exhausted', FAULT_BUDGET_EXHAUSTED),
+    ('sync_deadlock', FAULT_SYNC_DEADLOCK),
+    ('fproc_starved', FAULT_FPROC_STARVED),
+    ('pulse_overflow', FAULT_PULSE_OVERFLOW),
+    ('meas_overflow', FAULT_MEAS_OVERFLOW),
+    ('reset_overflow', FAULT_RESET_OVERFLOW),
+    ('illegal_op', FAULT_ILLEGAL_OP),
+    ('jump_oob', FAULT_JUMP_OOB),
+)
+N_FAULT_CODES = len(FAULT_CODES)
+
+
+class FaultError(RuntimeError):
+    """Raised host-side under ``fault_mode='strict'`` when any lane
+    trapped.  ``counts`` is the ``[N_FAULT_CODES]`` per-code shot
+    count (see :func:`fault_shot_counts`)."""
+
+    def __init__(self, counts):
+        self.counts = np.asarray(counts)
+        parts = [f'{name}={int(n)}'
+                 for (name, _), n in zip(FAULT_CODES, self.counts) if n]
+        super().__init__('faulted shots: ' + (', '.join(parts) or 'none'))
+
+
+def fault_shot_counts(fault) -> jnp.ndarray:
+    """``fault [..., n_cores] -> [N_FAULT_CODES]`` int32: shots where
+    any core trapped with each code (any-over-cores, sum-over-shots).
+    Traceable — the sweep stats layers reduce it under jit."""
+    f = jnp.asarray(fault)
+    bits = jnp.asarray([bit for _, bit in FAULT_CODES], dtype=jnp.int32)
+    per_shot = jnp.any((f[..., None] & bits) != 0, axis=-2)  # cores folded
+    return jnp.sum(per_shot.astype(jnp.int32),
+                   axis=tuple(range(per_shot.ndim - 1)))
+
+
 # program-fetch strategy crossover: one-hot multiply-reduce up to this
 # many instructions, per-lane gather beyond (see _step fetch comment)
 _FETCH_ONEHOT_MAX = 128
@@ -198,6 +255,14 @@ class InterpreterConfig:
     # observable without trusting the engine under test.  Off by
     # default: it adds a [B, C, N_KINDS] loop carry.
     opcode_histogram: bool = False
+    # trap handling (docs/ROBUSTNESS.md): 'count' (default) degrades
+    # gracefully — faulted lanes report their FAULT_* word and sweeps
+    # aggregate per-code ``fault_shots``; 'strict' raises
+    # :class:`FaultError` host-side after dispatch when any lane
+    # trapped.  Strict is purely a host-side policy: the wrappers
+    # normalize the cfg to 'count' before jit so both modes share one
+    # compiled executable.
+    fault_mode: str = 'count'
     alu_instr_clks: int = 5
     jump_cond_clks: int = 5
     jump_fproc_clks: int = 8
@@ -433,7 +498,8 @@ def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
     return dict(
         pc=z(B, C), regs=regs,
         time=jnp.full((B, C), INIT_TIME, jnp.int32), offset=z(B, C),
-        done=jnp.zeros((B, C), bool), err=z(B, C), pp=z(B, C, 5),
+        done=jnp.zeros((B, C), bool), err=z(B, C), fault=z(B, C),
+        pp=z(B, C, 5),
         n_pulses=z(B, C),
         # field-major flat [B, C, F*P]: a trailing axis of F=9 would
         # lane-pad to 128 on TPU (14x HBM + write traffic per step);
@@ -1004,11 +1070,11 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                     pick = jnp.minimum(
                         (traj_u[:, c, 3] * 3).astype(jnp.int32), 2) + 1
                     sel = jnp.where(occ, pick, 0)
-                    N = jnp.einsum(
+                    pmat = jnp.einsum(
                         'bk,kxy->bxy',
                         jax.nn.one_hot(sel, 4, dtype=jnp.complex64),
                         pauli1)
-                    U = jnp.einsum('bxy,byu->bxu', N, U)
+                    U = jnp.einsum('bxy,byu->bxu', pmat, U)
                 psi = _sv_apply_1q(psi, U, c, C)
                 if has_leak1:
                     # leakage channel after the rotation, the full CPTP
@@ -1222,6 +1288,36 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         err = err | jnp.where(sync_adv & sync_err[:, None],
                               ERR_SYNC_DONE, 0)
 
+    # ---- fault word (docs/ROBUSTNESS.md) -------------------------------
+    # Engine-integrity traps, OR-ed on masks computed above — fault-free
+    # lanes see pure zero ORs (bit-identity with the pre-fault engine).
+    # An out-of-ISA kind falls through every dispatch select as a silent
+    # no-op (the masked-to-no-op failure mode); an OOB pc/branch target
+    # would be clipped at fetch and re-execute the last instruction.
+    # Both are flagged instead of silently "working".
+    fault = st['fault'] \
+        | jnp.where(rec_of != 0, FAULT_PULSE_OVERFLOW, 0) \
+        | jnp.where(meas_of != 0, FAULT_MEAS_OVERFLOW, 0) \
+        | jnp.where(is_rst & (st['n_resets'] >= cfg.max_resets),
+                    FAULT_RESET_OVERFLOW, 0) \
+        | jnp.where(adv & ((kind < 0) | (kind >= isa.N_KINDS)),
+                    FAULT_ILLEGAL_OP, 0) \
+        | jnp.where(adv & ~is_done & ((pc_next < 0) | (pc_next >= N)),
+                    FAULT_JUMP_OOB, 0)
+    if any_fproc:
+        fault = fault \
+            | jnp.where(is_fproc & adv & fid_bad, FAULT_ILLEGAL_OP, 0) \
+            | jnp.where(is_fproc & adv & f_deadlock,
+                        FAULT_FPROC_STARVED, 0)
+    if has_sync:
+        fault = fault | jnp.where(sync_adv & sync_err[:, None],
+                                  FAULT_SYNC_DEADLOCK, 0)
+    # transient (popped by the engines before the carry repacks): lanes
+    # stalled AT a sync barrier this step — classifies a later hard
+    # quiescence as SYNC_DEADLOCK vs FPROC_STARVED
+    stall_sync = (at_sync & ~sync_ready[:, None] & live) if has_sync \
+        else jnp.zeros((B, C), bool)
+
     hist = {}
     if 'op_hist' in st:
         # retired-instruction histogram: one count per (shot, core) per
@@ -1245,7 +1341,8 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             st['trace_off'], offset[:, :, None], (0, 0, step_i))
 
     return dict(st, pc=pc_next, regs=regs, time=time_next, offset=offset_next,
-                done=st['done'] | is_done, err=err, pp=pp, n_pulses=n_pulses,
+                done=st['done'] | is_done, err=err, fault=fault,
+                _stall_sync=stall_sync, pp=pp, n_pulses=n_pulses,
                 n_resets=n_resets, rst_time=rst_time,
                 n_meas=n_meas, meas_avail=meas_avail,
                 **rec_update, **phys_updates, **hist, **tr)
@@ -1311,6 +1408,7 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
         st_in = st
         st2 = _step(st, steps, soa, spc, interp, sync_part, meas_bits,
                     meas_valid, cfg, dev, traits)
+        stall_sync = st2.pop('_stall_sync')
         # quiescence detection per shot: no live core changed state
         same = jnp.all((st2['pc'] == st['pc']) & (st2['time'] == st['time'])
                        & (st2['done'] == st['done']), axis=-1)   # [B]
@@ -1323,8 +1421,15 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
             hard = same & ~pending
         else:
             hard = same
-        st2['err'] = jnp.where(hard[:, None] & ~st2['done'],
-                               st2['err'] | ERR_FPROC_DEADLOCK, st2['err'])
+        undone = hard[:, None] & ~st2['done']
+        st2['err'] = jnp.where(undone, st2['err'] | ERR_FPROC_DEADLOCK,
+                               st2['err'])
+        # trap classification at hard quiescence: a lane parked at a
+        # sync barrier that can never release vs. any other stall
+        # (fproc wait with no producer able to deliver)
+        st2['fault'] = st2['fault'] \
+            | jnp.where(undone & stall_sync, FAULT_SYNC_DEADLOCK, 0) \
+            | jnp.where(undone & ~stall_sync, FAULT_FPROC_STARVED, 0)
         st2['done'] = st2['done'] | hard[:, None]
         # exactness select: steps applied past the max_steps budget or
         # after the batch settles must be true no-ops — a scalar-
@@ -1579,6 +1684,14 @@ def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
         active = (st['pc'] == i) & ~st['done'] & ~stalled
         time, offset, regs = st['time'], st['offset'], st['regs']
         err_i = jnp.zeros((B, C), jnp.int32)
+        fault_i = jnp.zeros((B, C), jnp.int32)
+        # out-of-ISA kind at this index retires as a silent no-op in
+        # every emitted block below — trap it (static mask, free when
+        # the program is well-formed)
+        m_badkind = (kind < 0) | (kind >= isa.N_KINDS)
+        if has(m_badkind):
+            fault_i = fault_i | jnp.where(j(m_badkind), FAULT_ILLEGAL_OP,
+                                          0)
 
         def reg_read_static(addr_c):
             oh = (np.asarray(addr_c)[:, None]
@@ -1666,6 +1779,9 @@ def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
             err_i = err_i | jnp.where(
                 fire & (st['n_pulses'] >= cfg.max_pulses),
                 ERR_PULSE_OVERFLOW, 0)
+            fault_i = fault_i | jnp.where(
+                fire & (st['n_pulses'] >= cfg.max_pulses),
+                FAULT_PULSE_OVERFLOW, 0)
             if cfg.record_pulses:
                 rec_vals = jnp.stack(
                     [j(f['cmd_time']) * jnp.ones_like(trig), trig,
@@ -1686,6 +1802,9 @@ def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
             err_i = err_i | jnp.where(
                 is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
                 ERR_MEAS_OVERFLOW, 0)
+            fault_i = fault_i | jnp.where(
+                is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
+                FAULT_MEAS_OVERFLOW, 0)
             oh_mslot = _onehot(jnp.minimum(st['n_meas'],
                                            cfg.max_meas - 1), cfg.max_meas)
             meas_avail = jnp.where(
@@ -1736,6 +1855,9 @@ def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
                                cfg.max_resets)
             st['rst_time'] = jnp.where((oh_rslot == 1) & is_rst[..., None],
                                        time[..., None], st['rst_time'])
+            fault_i = fault_i | jnp.where(
+                is_rst & (st['n_resets'] >= cfg.max_resets),
+                FAULT_RESET_OVERFLOW, 0)
             st['n_resets'] = st['n_resets'] + is_rst.astype(jnp.int32)
         if has(m_idle):
             is_idle = active & j(m_idle)
@@ -1762,6 +1884,15 @@ def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
             pc_next = jnp.where(j(m_jmpi), j(f['jump_addr']), pc_next)
             pc_next = jnp.where(j(m_jcond | m_jfp)
                                 & branch, j(f['jump_addr']), pc_next)
+            # taken forward jump past the program end: the lane matches
+            # no later index, retires nothing, and is left undone —
+            # trap it here rather than as a bare budget fault
+            m_oob = (f['jump_addr'] < 0) | (f['jump_addr'] >= N)
+            if has(m_oob & (m_jmpi | m_jcond | m_jfp)):
+                taken_oob = (j(m_jmpi & m_oob)
+                             | (j((m_jcond | m_jfp) & m_oob) & branch))
+                st['fault'] = st['fault'] | jnp.where(
+                    active & taken_oob, FAULT_JUMP_OOB, 0)
         st['pc'] = jnp.where(active & ~j(m_done), pc_next, st['pc'])
         time_next = time
         if has(m_pt):
@@ -1789,6 +1920,7 @@ def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
             st['offset'] = jnp.where(active & j(m_incq), time - alu_res,
                                      offset)
         st['err'] = st['err'] | jnp.where(active, err_i, 0)
+        st['fault'] = st['fault'] | jnp.where(active, fault_i, 0)
         st['done'] = st['done'] | (active & j(m_done))
 
     # every non-stalled lane retired at its DONE (forward-only, DONE-
@@ -1834,6 +1966,11 @@ def _exec_block_body(st: dict, act, rows_np, spc, interp,
         active = act & ~st['done']
         time, offset, regs = st['time'], st['offset'], st['regs']
         err_i = jnp.zeros((B, C), jnp.int32)
+        fault_i = jnp.zeros((B, C), jnp.int32)
+        m_badkind = (kind < 0) | (kind >= isa.N_KINDS)
+        if has(m_badkind):
+            fault_i = fault_i | jnp.where(j(m_badkind), FAULT_ILLEGAL_OP,
+                                          0)
 
         def reg_read_static(addr_c):
             oh = (np.asarray(addr_c)[:, None]
@@ -1899,6 +2036,9 @@ def _exec_block_body(st: dict, act, rows_np, spc, interp,
             err_i = err_i | jnp.where(
                 fire & (st['n_pulses'] >= cfg.max_pulses),
                 ERR_PULSE_OVERFLOW, 0)
+            fault_i = fault_i | jnp.where(
+                fire & (st['n_pulses'] >= cfg.max_pulses),
+                FAULT_PULSE_OVERFLOW, 0)
             if cfg.record_pulses:
                 rec_vals = jnp.stack(
                     [j(f['cmd_time']) * jnp.ones_like(trig), trig,
@@ -1919,6 +2059,9 @@ def _exec_block_body(st: dict, act, rows_np, spc, interp,
             err_i = err_i | jnp.where(
                 is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
                 ERR_MEAS_OVERFLOW, 0)
+            fault_i = fault_i | jnp.where(
+                is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
+                FAULT_MEAS_OVERFLOW, 0)
             oh_mslot = _onehot(jnp.minimum(st['n_meas'],
                                            cfg.max_meas - 1), cfg.max_meas)
             meas_avail = jnp.where(
@@ -1968,6 +2111,9 @@ def _exec_block_body(st: dict, act, rows_np, spc, interp,
                                cfg.max_resets)
             st['rst_time'] = jnp.where((oh_rslot == 1) & is_rst[..., None],
                                        time[..., None], st['rst_time'])
+            fault_i = fault_i | jnp.where(
+                is_rst & (st['n_resets'] >= cfg.max_resets),
+                FAULT_RESET_OVERFLOW, 0)
             st['n_resets'] = st['n_resets'] + is_rst.astype(jnp.int32)
         if has(m_idle):
             is_idle = active & j(m_idle)
@@ -2004,6 +2150,7 @@ def _exec_block_body(st: dict, act, rows_np, spc, interp,
             st['offset'] = jnp.where(active & j(m_incq), time - alu_res,
                                      offset)
         st['err'] = st['err'] | jnp.where(active, err_i, 0)
+        st['fault'] = st['fault'] | jnp.where(active, fault_i, 0)
         st['done'] = st['done'] | (active & j(m_done))
 
     return st
@@ -2069,6 +2216,10 @@ def _exec_blocks(st0: dict, blk: tuple, spc, interp, sync_part, meas_bits,
         sup = block_id(st['pc']) >= 0
         st2 = _step(st, steps, soa, spc, interp, sync_part, meas_bits,
                     meas_valid, cfg, dev, traits)
+        # transient: popped before the keep()/exactness dict sweeps
+        # (st_in has no such key); suppressed cores were not really at
+        # their instruction this iteration, so their flag is masked
+        stall_sync = st2.pop('_stall_sync') & ~sup
 
         def keep(old, new):
             m = sup.reshape(sup.shape + (1,) * (new.ndim - 2))
@@ -2095,8 +2246,12 @@ def _exec_blocks(st0: dict, blk: tuple, spc, interp, sync_part, meas_bits,
             hard = same & ~pending
         else:
             hard = same
-        st2['err'] = jnp.where(hard[:, None] & ~st2['done'],
-                               st2['err'] | ERR_FPROC_DEADLOCK, st2['err'])
+        undone = hard[:, None] & ~st2['done']
+        st2['err'] = jnp.where(undone, st2['err'] | ERR_FPROC_DEADLOCK,
+                               st2['err'])
+        st2['fault'] = st2['fault'] \
+            | jnp.where(undone & stall_sync, FAULT_SYNC_DEADLOCK, 0) \
+            | jnp.where(undone & ~stall_sync, FAULT_FPROC_STARVED, 0)
         st2['done'] = st2['done'] | hard[:, None]
         settled_in = jnp.all(st_in['done'], axis=-1)
         if cfg.physics:
@@ -2121,6 +2276,11 @@ def _finalize(st: dict, cfg: InterpreterConfig) -> dict:
     st['qclk'] = st['time'] - st['offset']
     st['steps'] = steps
     st['incomplete'] = ~jnp.all(st['done'])
+    # a lane still live after every engine/epoch loop has returned ran
+    # out of execution budget (max_steps, or the physics epoch cap) —
+    # the one trap no step body can see locally
+    st['fault'] = st['fault'] | jnp.where(~st['done'],
+                                          FAULT_BUDGET_EXHAUSTED, 0)
     return st
 
 
@@ -2364,6 +2524,7 @@ def simulate_multi_batch(mps, meas_bits, init_regs=None,
     if cfg.straightline is None or cfg.engine is not None:
         # normalize 'auto'/'generic' to the one legacy cache key
         cfg = replace(cfg, straightline=False, engine=None)
+    cfg, strict = _fault_policy(cfg)
     # _program_constants/program_traits consume the soa/tables attribute
     # surface, which MultiMachineProgram mirrors with a program axis;
     # traits become the UNION of instruction kinds over the ensemble
@@ -2394,8 +2555,37 @@ def simulate_multi_batch(mps, meas_bits, init_regs=None,
                     f'form); got {tuple(init_regs.shape)}')
             init_regs = jnp.broadcast_to(
                 init_regs[:, None], (P, B) + tuple(init_regs.shape[1:]))
-    return _run_multi_batch_jit(soa, spc, interp, sync_part, meas_bits,
-                                cfg, C, init_regs, program_traits(mmp))
+    return _check_strict(
+        _run_multi_batch_jit(soa, spc, interp, sync_part, meas_bits,
+                             cfg, C, init_regs, program_traits(mmp)),
+        strict)
+
+
+def _fault_policy(cfg: InterpreterConfig):
+    """Split ``cfg.fault_mode`` into (jit cfg, strict flag).
+
+    'strict' is purely a HOST-side policy — the cfg that reaches a jit
+    is normalized to 'count' so both modes share one compiled
+    executable (fault_mode is a static field; leaving it would split
+    the cache for identical machine code)."""
+    if cfg.fault_mode not in ('count', 'strict'):
+        raise ValueError(
+            f"fault_mode must be 'count' or 'strict'; got "
+            f"{cfg.fault_mode!r}")
+    if cfg.fault_mode == 'strict':
+        return replace(cfg, fault_mode='count'), True
+    return cfg, False
+
+
+def _check_strict(out: dict, strict: bool) -> dict:
+    """Raise :class:`FaultError` when strict and any lane trapped.
+    Blocks on the device result — fail-fast trades away dispatch
+    pipelining, which is why 'count' is the default."""
+    if strict:
+        counts = np.asarray(fault_shot_counts(out['fault']))
+        if counts.any():
+            raise FaultError(counts)
+    return out
 
 
 def _pad_meas(meas_bits, max_meas: int):
@@ -2422,6 +2612,7 @@ def simulate(mp, meas_bits=None, init_regs=None,
     registers, qclk values, per-core error bits, and completion flags.
     """
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    cfg, strict = _fault_policy(cfg)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     if meas_bits is None:
         meas_bits = jnp.zeros((mp.n_cores, cfg.max_meas), jnp.int32)
@@ -2439,10 +2630,12 @@ def simulate(mp, meas_bits=None, init_regs=None,
                                  cfg, mp.n_cores, init_regs[None],
                                  blk=_soa_static(mp))
     else:
-        return _run_jit(soa, spc, interp, sync_part, meas_bits, cfg,
-                        mp.n_cores, init_regs, program_traits(mp))
-    return {k: (v if k in ('steps', 'incomplete', 'op_hist') else v[0])
-            for k, v in out.items()}
+        return _check_strict(
+            _run_jit(soa, spc, interp, sync_part, meas_bits, cfg,
+                     mp.n_cores, init_regs, program_traits(mp)), strict)
+    return _check_strict(
+        {k: (v if k in ('steps', 'incomplete', 'op_hist') else v[0])
+         for k, v in out.items()}, strict)
 
 
 def simulate_batch(mp, meas_bits, init_regs=None,
@@ -2452,6 +2645,7 @@ def simulate_batch(mp, meas_bits, init_regs=None,
     host; here shots are the leading axis of every state array on the
     accelerator.  ``init_regs`` may also carry the shot/sweep-point axis."""
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    cfg, strict = _fault_policy(cfg)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)
     init_regs = jnp.zeros((mp.n_cores, isa.N_REGS), jnp.int32) \
@@ -2462,11 +2656,14 @@ def simulate_batch(mp, meas_bits, init_regs=None,
             (meas_bits.shape[0],) + tuple(init_regs.shape))
     eng = resolve_engine(mp, cfg)
     if eng == 'straightline':
-        return _run_batch_sl_jit(spc, interp, meas_bits, cfg, mp.n_cores,
-                                 init_regs, sl=_soa_static(mp))
+        return _check_strict(
+            _run_batch_sl_jit(spc, interp, meas_bits, cfg, mp.n_cores,
+                              init_regs, sl=_soa_static(mp)), strict)
     if eng == 'block':
-        return _run_batch_blk_jit(spc, interp, sync_part, meas_bits, cfg,
-                                  mp.n_cores, init_regs,
-                                  blk=_soa_static(mp))
-    return _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
-                          mp.n_cores, init_regs, program_traits(mp))
+        return _check_strict(
+            _run_batch_blk_jit(spc, interp, sync_part, meas_bits, cfg,
+                               mp.n_cores, init_regs,
+                               blk=_soa_static(mp)), strict)
+    return _check_strict(
+        _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
+                       mp.n_cores, init_regs, program_traits(mp)), strict)

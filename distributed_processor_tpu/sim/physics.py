@@ -66,7 +66,7 @@ from .device import DeviceModel, STATEVEC_MAX_CORES
 from .interpreter import (InterpreterConfig, _program_constants, _init_state,
                           _exec_loop, _finalize, _check_fabric,
                           program_traits, use_straightline, _soa_static,
-                          resolve_engine)
+                          resolve_engine, _fault_policy, _check_strict)
 
 
 def _engine_static(mp, cfg: InterpreterConfig):
@@ -1163,6 +1163,7 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     # triggers — worst case one core per step)
     explicit_steps = 'max_steps' in kw or cfg is not None
     cfg = physics_config(cfg, model, **kw)
+    cfg, strict_faults = _fault_policy(cfg)
     _check_fabric(cfg, mp.n_cores)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     env_stack, freq_stack, spc_m, interp_m, w_auto = \
@@ -1291,7 +1292,7 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
                                    model.resolve_chunk, interps, rows,
                                    _tables_meta(model, W, interps, mp))
     eng_sl, eng_blk = _engine_static(mp, cfg)
-    return _run_physics_jit(
+    return _check_strict(_run_physics_jit(
         soa, spc, interp, sync_part, init_states, init_regs, tables,
         freq_stack, as_iq(model.g0), as_iq(model.g1),
         jnp.float32(model.sigma), inv_ring, key_noise, dev_params, meas_u,
@@ -1304,4 +1305,4 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         jnp.float32(model.noise_ar1),
         g2=as_iq(model.g2) if model.g2 is not None else None,
         classify3=bool(model.classify3),
-        sl=eng_sl, blk=eng_blk)
+        sl=eng_sl, blk=eng_blk), strict_faults)

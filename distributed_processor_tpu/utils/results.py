@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import json
 import os
+import zipfile
+import zlib
 
 import numpy as np
 
@@ -39,6 +41,21 @@ def load_results(path: str) -> tuple[dict, dict]:
         if '__meta__' in z.files:
             meta = json.loads(bytes(z['__meta__']).decode())
     return arrays, meta
+
+
+def quarantine_checkpoint(path: str) -> str:
+    """Move an unreadable checkpoint aside as ``<path>.corrupt-<n>``.
+
+    The rename keeps the evidence (for post-mortem CRC inspection)
+    while freeing ``path`` for a clean restart; ``<n>`` counts up so
+    repeated corruption never overwrites an earlier specimen.
+    """
+    n = 0
+    while os.path.exists(f'{path}.corrupt-{n}'):
+        n += 1
+    dest = f'{path}.corrupt-{n}'
+    os.replace(path, dest)
+    return dest
 
 
 class SweepAccumulator:
@@ -103,6 +120,13 @@ class SweepAccumulator:
         fields whose representation changed between fingerprint versions
         (and would otherwise be skipped with a warning) can never smuggle
         a different sweep past validation.
+
+        A checkpoint that cannot be PARSED at all (truncated zip,
+        bit-flipped npz member, mangled manifest) is quarantined: the
+        file is renamed to ``<path>.corrupt-<n>`` and a fresh
+        accumulator is returned with a warning, so a long campaign
+        restarts cleanly instead of crashing on unreadable state.
+        ``strict=True`` raises instead (nothing is renamed).
         """
         if strict and meta is None:
             raise ValueError(
@@ -111,7 +135,26 @@ class SweepAccumulator:
                 'no-op')
         acc = cls(path, checkpoint_every, meta=meta)
         if os.path.exists(path):
-            arrays, stored = load_results(path)
+            try:
+                arrays, stored = load_results(path)
+            except (zipfile.BadZipFile, zlib.error, ValueError, KeyError,
+                    OSError, EOFError, json.JSONDecodeError) as e:
+                # torn/bit-flipped checkpoint (atomic writes make this
+                # rare — disk corruption, not interruption): losing the
+                # accumulated batches is recoverable, crashing a
+                # million-shot campaign on an unreadable file is not
+                if strict:
+                    raise ValueError(
+                        f'strict resume: checkpoint {path} is unreadable '
+                        f'({type(e).__name__}: {e})') from e
+                import warnings
+                dest = quarantine_checkpoint(path)
+                warnings.warn(
+                    f'checkpoint {path} is unreadable '
+                    f'({type(e).__name__}: {e}); quarantined to {dest} '
+                    f'and restarting the sweep from batch 0',
+                    stacklevel=2)
+                return acc
             acc.state = dict(arrays)
             acc.n_batches = int(stored.pop('n_batches', 0))
             if meta is not None:

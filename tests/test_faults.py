@@ -1,0 +1,394 @@
+"""Trap-and-report runtime: per-lane fault codes, the static validator,
+and the fault-injection harness.
+
+The contract: a fault-free program's outputs are BIT-IDENTICAL with the
+fault carry in place (zero-cost ORs in the while-loop state), every
+injected defect is rejected statically or trapped with the right code
+(never silent), fault counts aggregate through every sweep driver and
+survive checkpoint/resume, and an unreadable checkpoint is quarantined
+instead of crashing the campaign.  docs/ROBUSTNESS.md is the prose
+spec.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.decoder import (ProgramValidationError,
+                                               machine_program_from_cmds,
+                                               validate_program)
+from distributed_processor_tpu.models import active_reset
+from distributed_processor_tpu.parallel import (make_mesh,
+                                                run_multi_sweep,
+                                                run_physics_sweep)
+from distributed_processor_tpu.sim import faultinject as fi
+from distributed_processor_tpu.sim.interpreter import (FAULT_CODES,
+                                                       FaultError,
+                                                       InterpreterConfig,
+                                                       fault_shot_counts,
+                                                       simulate_batch)
+from distributed_processor_tpu.sim.physics import ReadoutPhysics
+from distributed_processor_tpu.simulator import Simulator
+from distributed_processor_tpu.utils.results import (SweepAccumulator,
+                                                     save_results)
+
+
+def _fault_names(fault):
+    counts = np.asarray(fault_shot_counts(fault))
+    return {name for (name, _), c in zip(FAULT_CODES, counts) if c}
+
+
+def _loop_mp(iters=1000):
+    """Counted loop whose iteration count dwarfs any small step budget."""
+    core = [isa.alu_cmd('reg_alu', 'i', iters, 'id0', write_reg_addr=0),
+            isa.pulse_cmd(amp_word=1000, cfg_word=0, env_word=3,
+                          cmd_time=10),
+            isa.alu_cmd('reg_alu', 'i', -1, 'add', 0, write_reg_addr=0),
+            isa.alu_cmd('jump_cond', 'i', 0, 'le', 0, jump_cmd_ptr=1),
+            isa.done_cmd()]
+    return machine_program_from_cmds([core])
+
+
+# ---------------------------------------------------------------------------
+# fault-free bit-identity
+# ---------------------------------------------------------------------------
+
+def test_fault_free_zero_on_all_engines():
+    """A valid branch-free program reports an all-zero fault word on
+    every engine, and the engines agree bit-for-bit on the outputs."""
+    cmds = [[isa.pulse_cmd(amp_word=1000, cfg_word=0, env_word=3,
+                           cmd_time=10 + 20 * i) for i in range(3)]
+            + [isa.done_cmd()]] * 2
+    mp = machine_program_from_cmds(cmds)
+    mb = np.zeros((4, mp.n_cores, 2), np.int32)
+    outs = {}
+    for eng in fi.ENGINES:
+        out = simulate_batch(mp, mb, cfg=InterpreterConfig(
+            max_steps=64, max_meas=2, engine=eng))
+        assert _fault_names(out['fault']) == set(), eng
+        outs[eng] = out
+    for eng in ('block', 'straightline'):
+        np.testing.assert_array_equal(outs['generic']['n_pulses'],
+                                      outs[eng]['n_pulses'], eng)
+        np.testing.assert_array_equal(outs['generic']['regs'],
+                                      outs[eng]['regs'], eng)
+
+
+def test_fault_free_simulator_run():
+    sim = Simulator(n_qubits=2)
+    out = sim.run(active_reset(['Q0', 'Q1']), shots=8, p1=0.5)
+    assert _fault_names(out['fault']) == set()
+
+
+# ---------------------------------------------------------------------------
+# BUDGET_EXHAUSTED through every execution path (acceptance criterion:
+# single, multi-program, spanned, mesh-sharded; checkpoint round-trip)
+# ---------------------------------------------------------------------------
+
+def test_budget_exhaustion_single():
+    mp = _loop_mp()
+    mb = np.zeros((4, 1, 2), np.int32)
+    out = simulate_batch(mp, mb, cfg=InterpreterConfig(max_steps=6,
+                                                       max_meas=2))
+    assert _fault_names(out['fault']) == {'budget_exhausted'}
+    counts = np.asarray(fault_shot_counts(out['fault']))
+    assert counts[0] == 4           # every shot trapped
+
+
+def test_budget_exhaustion_multi_span_mesh_checkpoint(tmp_path):
+    """The counted-loop budget trap reports identically through the
+    ensemble driver's host loop, span path, and dp=2 mesh path, and the
+    counts survive a checkpoint/resume round-trip bit-identically."""
+    mps = [_loop_mp(), _loop_mp(1)]     # [0] traps, [1] finishes
+    kw = dict(total_shots=16, batch=4, p1=0.5, key=3, max_steps=8,
+              max_meas=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore', UserWarning)
+        full = run_multi_sweep(mps, **kw)
+        assert full['fault_shots']['budget_exhausted'].tolist() == [16, 0]
+        for name, _ in FAULT_CODES[1:]:
+            assert full['fault_shots'][name].tolist() == [0, 0], name
+        spanned = run_multi_sweep(mps, span=2, **kw)
+        mesh = run_multi_sweep(mps, mesh=make_mesh(n_dp=2), **kw)
+        # interrupted at half the shots, then resumed to the full count
+        ck = str(tmp_path / 'faults.npz')
+        run_multi_sweep(mps, checkpoint=ck, checkpoint_every=1,
+                        **{**kw, 'total_shots': 8})
+        resumed = run_multi_sweep(mps, checkpoint=ck, checkpoint_every=1,
+                                  **kw)
+    for name, _ in FAULT_CODES:
+        ref = full['fault_shots'][name].tolist()
+        assert spanned['fault_shots'][name].tolist() == ref, name
+        assert mesh['fault_shots'][name].tolist() == ref, name
+        assert resumed['fault_shots'][name].tolist() == ref, name
+
+
+def test_budget_exhaustion_physics_sweep():
+    """The physics driver exposes summed per-code counts and its strict
+    mode raises AFTER completing (counts preserved on the error)."""
+    sim = Simulator(n_qubits=2)
+    mp = sim.compile(active_reset(['Q0', 'Q1']))
+    model = ReadoutPhysics(sigma=0.01, p1_init=0.5)
+    kw = dict(max_steps=3, max_pulses=8, max_meas=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore', UserWarning)
+        out = run_physics_sweep(mp, model, 32, 16, key=5, **kw)
+        assert out['fault_shots']['budget_exhausted'] == 32
+        with pytest.raises(FaultError) as ei:
+            run_physics_sweep(mp, model, 32, 16, key=5,
+                              fault_mode='strict', **kw)
+    assert np.asarray(ei.value.counts)[0] == 32
+
+
+def test_strict_mode_simulator_run():
+    mp = _loop_mp()
+    sim = Simulator(n_qubits=1)
+    with pytest.raises(FaultError):
+        sim.run(mp, shots=4, p1=0.5, max_steps=6, max_meas=2,
+                fault_mode='strict')
+
+
+# ---------------------------------------------------------------------------
+# static validator
+# ---------------------------------------------------------------------------
+
+def test_validator_jump_oob():
+    cmds = [[isa.pulse_cmd(amp_word=100, cfg_word=0, env_word=3,
+                           cmd_time=10),
+             isa.jump_i(99), isa.done_cmd()]]
+    with pytest.raises(ProgramValidationError) as ei:
+        validate_program(machine_program_from_cmds(cmds))
+    assert 'jump_oob' in ei.value.codes
+    (code, core, instr, msg), = [e for e in ei.value.errors
+                                 if e[0] == 'jump_oob']
+    assert (core, instr) == (0, 1) and '99' in msg
+
+
+def test_validator_no_done_and_infinite_loop():
+    pulse = isa.pulse_cmd(amp_word=100, cfg_word=0, env_word=3,
+                          cmd_time=10)
+    with pytest.raises(ProgramValidationError) as ei:
+        validate_program(machine_program_from_cmds([[pulse, pulse]]))
+    assert 'no_done' in ei.value.codes
+    with pytest.raises(ProgramValidationError) as ei:
+        validate_program(machine_program_from_cmds(
+            [[pulse, isa.jump_i(0), isa.done_cmd()]]))
+    assert 'infinite_loop' in ei.value.codes
+
+
+def test_validator_sync_mismatch_and_coordinates():
+    """Branch-free participants with diverging barrier sequences are a
+    static reject; the error names both cores."""
+    pulse = isa.pulse_cmd(amp_word=100, cfg_word=0, env_word=3,
+                          cmd_time=10)
+    cmds = [[pulse, isa.sync(0), isa.done_cmd()],
+            [pulse, isa.sync(1), isa.done_cmd()]]
+    with pytest.raises(ProgramValidationError) as ei:
+        validate_program(machine_program_from_cmds(cmds))
+    assert 'sync_mismatch' in ei.value.codes
+
+
+def test_validator_accepts_valid_programs():
+    sim = Simulator(n_qubits=2)
+    mp = sim.compile(active_reset(['Q0', 'Q1']))
+    validate_program(mp, sim.interpreter_config(mp))   # no raise
+    validate_program(_loop_mp())                       # counted loop ok
+
+
+# ---------------------------------------------------------------------------
+# checkpoint quarantine (satellite 1)
+# ---------------------------------------------------------------------------
+
+def _write_checkpoint(path):
+    save_results(path, {'x': np.arange(64, dtype=np.int64)},
+                 meta={'n_batches': 3, 'fingerprint_version': 5})
+
+
+def test_quarantine_truncated_checkpoint(tmp_path):
+    ck = str(tmp_path / 'acc.npz')
+    _write_checkpoint(ck)
+    data = open(ck, 'rb').read()
+    with open(ck, 'wb') as f:
+        f.write(data[:len(data) // 2])
+    with pytest.warns(UserWarning, match='quarantined'):
+        acc = SweepAccumulator.resume(ck, checkpoint_every=1)
+    assert acc.n_batches == 0 and acc.state == {}
+    assert not os.path.exists(ck)
+    assert os.path.exists(ck + '.corrupt-0')
+    # a second corruption gets its own specimen number
+    _write_checkpoint(ck)
+    with open(ck, 'r+b') as f:
+        f.truncate(10)
+    with pytest.warns(UserWarning, match='quarantined'):
+        SweepAccumulator.resume(ck)
+    assert os.path.exists(ck + '.corrupt-1')
+
+
+def test_quarantine_bitflipped_checkpoint(tmp_path):
+    import struct
+    import zipfile
+    ck = str(tmp_path / 'acc.npz')
+    _write_checkpoint(ck)
+    with zipfile.ZipFile(ck) as z:
+        info = z.getinfo('x.npy')
+    data = bytearray(open(ck, 'rb').read())
+    # flip one bit INSIDE the member's compressed payload (the local
+    # header's own name/extra lengths locate it; zip slack bytes would
+    # be silently ignored)
+    ho = info.header_offset
+    fnlen, eflen = struct.unpack('<HH', bytes(data[ho + 26:ho + 30]))
+    data[ho + 30 + fnlen + eflen + info.compress_size // 2] ^= 0xff
+    with open(ck, 'wb') as f:
+        f.write(bytes(data))
+    with pytest.warns(UserWarning, match='quarantined'):
+        acc = SweepAccumulator.resume(ck)
+    assert acc.n_batches == 0
+    assert os.path.exists(ck + '.corrupt-0')
+
+
+def test_quarantine_strict_raises(tmp_path):
+    ck = str(tmp_path / 'acc.npz')
+    _write_checkpoint(ck)
+    with open(ck, 'r+b') as f:
+        f.truncate(8)
+    with pytest.raises(ValueError, match='unreadable'):
+        SweepAccumulator.resume(ck, meta={'fingerprint_version': 5},
+                                strict=True)
+    assert os.path.exists(ck)       # strict quarantines nothing
+    assert not os.path.exists(ck + '.corrupt-0')
+
+
+# ---------------------------------------------------------------------------
+# CLI surface (satellite 4)
+# ---------------------------------------------------------------------------
+
+def _cli_prog(tmp_path):
+    prog = tmp_path / 'prog.json'
+    prog.write_text(json.dumps([{'name': 'X90', 'qubit': ['Q0']},
+                                {'name': 'read', 'qubit': ['Q0']}]))
+    return str(prog)
+
+
+def test_cli_run_fault_table_and_strict(tmp_path, capsys):
+    from distributed_processor_tpu.cli import main
+    prog = _cli_prog(tmp_path)
+    main(['--qubits', '1', 'run', prog, '--shots', '4',
+          '--max-steps', '2'])
+    cap = capsys.readouterr()
+    out = json.loads(cap.out)
+    assert out['fault_shots']['budget_exhausted'] == 4
+    assert 'fault summary' in cap.err
+    with pytest.raises(SystemExit) as ei:
+        main(['--qubits', '1', 'run', prog, '--shots', '4',
+              '--max-steps', '2', '--strict-faults'])
+    assert ei.value.code == 2
+    capsys.readouterr()
+    # fault-free: no table, no nonzero counts
+    main(['--qubits', '1', 'run', prog, '--shots', '4'])
+    cap = capsys.readouterr()
+    assert 'fault summary' not in cap.err
+    assert not any(json.loads(cap.out)['fault_shots'].values())
+
+
+def test_cli_sweep_fault_table_and_strict(tmp_path, capsys):
+    from distributed_processor_tpu.cli import main
+    prog = _cli_prog(tmp_path)
+    argv = ['--qubits', '1', 'sweep', prog, '--shots', '16',
+            '--batch', '8', '--sigma', '0.01', '--p1-init', '0.5',
+            '--max-steps', '2']
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore', UserWarning)
+        main(argv)
+        cap = capsys.readouterr()
+        assert json.loads(cap.out)['fault_shots']['budget_exhausted'] == 16
+        assert 'fault summary' in cap.err
+        with pytest.raises(SystemExit) as ei:
+            main(argv + ['--strict-faults'])
+    assert ei.value.code == 2
+    assert 'budget_exhausted' in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# preemption safety (satellite 2): SIGKILL a checkpointed sweep mid-run,
+# resume, and the final statistics are bit-identical
+# ---------------------------------------------------------------------------
+
+_SWEEP_CHILD = '''
+import sys
+from distributed_processor_tpu.cli import main
+main(sys.argv[1:])
+'''
+
+
+def test_sweep_survives_sigkill(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = _cli_prog(tmp_path)
+    ck = str(tmp_path / 'kill.npz')
+    argv = ['--qubits', '1', 'sweep', prog, '--shots', '64',
+            '--batch', '4', '--sigma', '0.01', '--p1-init', '0.5',
+            '--key', '7']
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get('PYTHONPATH', ''))
+    # uninterrupted reference, in this process (compile cache warm)
+    from distributed_processor_tpu.cli import main
+    import io, contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        main(argv)
+    ref = json.loads(buf.getvalue())
+
+    child = subprocess.Popen(
+        [sys.executable, '-c', _SWEEP_CHILD] + argv
+        + ['--checkpoint', ck, '--checkpoint-every', '1'],
+        env=env, cwd=repo, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    # kill -9 as soon as the first checkpoint lands (mid-run for any
+    # interesting interleaving; if the child wins the race the resume
+    # below still must reproduce the reference exactly)
+    deadline = time.time() + 120
+    while time.time() < deadline and child.poll() is None \
+            and not os.path.exists(ck):
+        time.sleep(0.05)
+    if child.poll() is None:
+        child.send_signal(signal.SIGKILL)
+    child.wait()
+    assert os.path.exists(ck), 'child never wrote a checkpoint'
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        main(argv + ['--checkpoint', ck, '--checkpoint-every', '1'])
+    resumed = json.loads(buf.getvalue())
+    assert resumed == ref
+
+
+# ---------------------------------------------------------------------------
+# fault-injection harness (tier-1 slice of tools/faultfuzz.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_fuzz_quick_slice():
+    """One (base x mutator) cycle: every mutant rejected, trapped, or
+    provably benign — no SILENT/MISTRAPPED/INCONSISTENT verdicts."""
+    rep = fi.run_fuzz(seed=0, n=28)
+    assert rep.ok, rep.failures
+    assert rep.n == 28
+
+
+@pytest.mark.faults
+def test_fuzz_vmap_consistency():
+    assert fi.check_vmap_consistency(seed=0, n=4) == 0
+
+
+@pytest.mark.faults
+def test_fuzz_mesh_consistency():
+    bad = fi.check_mesh_consistency(seed=0, n=2)
+    assert bad <= 0                 # -1 = skipped (<2 devices)

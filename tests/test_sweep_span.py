@@ -48,8 +48,11 @@ def _ensemble(n_seqs, seed):
 def _assert_same(a: dict, b: dict, ctx=''):
     assert set(a) == set(b), ctx
     for k in a:
-        np.testing.assert_array_equal(
-            np.asarray(a[k]), np.asarray(b[k]), err_msg=f'{ctx}{k}')
+        if isinstance(a[k], dict):
+            _assert_same(a[k], b[k], ctx=f'{ctx}{k}.')
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(a[k]), np.asarray(b[k]), err_msg=f'{ctx}{k}')
 
 
 def test_physics_span_parity():

@@ -10,6 +10,18 @@ suite green.
 import sys
 import xml.etree.ElementTree as ET
 
+# the stderr marker cli._fault_table / the sweep drivers print when any
+# shot trapped a runtime fault: a GREEN testcase whose captured output
+# carries it means a test exercised faulting execution without
+# asserting on it — only fault-injection tests (named/marked 'fault')
+# may trip the trap machinery
+FAULT_MARK = 'fault summary (trapped shots'
+
+
+def _is_fault_test(tc) -> bool:
+    ident = f'{tc.get("classname", "")}.{tc.get("name", "")}'.lower()
+    return 'fault' in ident
+
 
 def main(path: str) -> int:
     root = ET.parse(path).getroot()
@@ -24,7 +36,20 @@ def main(path: str) -> int:
     if n_tests == 0:
         print('FAILURE: no tests ran')
         return 1
-    print(f'junit OK: {n_tests} tests, no failures')
+    leaks = []
+    for tc in root.iter('testcase'):
+        if _is_fault_test(tc):
+            continue
+        for out in (tc.findall('system-out') + tc.findall('system-err')):
+            if out.text and FAULT_MARK in out.text:
+                leaks.append(f'{tc.get("classname")}.{tc.get("name")}')
+                break
+    if leaks:
+        for name in leaks:
+            print(f'FAULT LEAK: {name}: nonzero fault_shots from a '
+                  f'non-fault-injection test (see docs/ROBUSTNESS.md)')
+        return 1
+    print(f'junit OK: {n_tests} tests, no failures, no fault leaks')
     return 0
 
 
