@@ -34,7 +34,9 @@ see docs/PERF.md for the budget), BENCH_DEPTH (RB depth, default 12),
 BENCH_SIGMA (ADC noise, default 0.05), BENCH_CHUNK (matched-filter
 resolve chunk in samples, default 256 — smaller trades speed for peak
 memory), BENCH_SWEEP_SHOTS/BENCH_SWEEP_BATCH/BENCH_SWEEP_SPAN (the
-dispatch-amortization row's sweep shape, defaults 131072/2048/16).
+dispatch-amortization row's sweep shape, defaults 131072/2048/16),
+BENCH_SERVE_REQS/BENCH_SERVE_SHOTS (the continuous-batching row's
+request count and shots per request, defaults 32/32).
 
 Besides the final stdout line, every completed row is written
 incrementally and atomically to BENCH_ARTIFACT (default
@@ -112,6 +114,8 @@ import jax.numpy as jnp
 from distributed_processor_tpu.pipeline import compile_to_machine
 from distributed_processor_tpu.models import (
     active_reset, rb_program, make_default_qchip, couplings_from_qchip)
+from distributed_processor_tpu.serve.benchmark import (
+    continuous_batching_comparison)
 from distributed_processor_tpu.sim.interpreter import InterpreterConfig
 from distributed_processor_tpu.sim.physics import (
     ReadoutPhysics, run_physics_batch, prepare_physics_tables)
@@ -834,7 +838,8 @@ def _degraded_rerun(attempts):
                  ('BENCH_MODE', 'persample'), ('BENCH_PROBE_ROUNDS', '2'),
                  ('BENCH_MULTI_SEQS', '4'), ('BENCH_MULTI_SHOTS', '256'),
                  ('BENCH_SWEEP_SHOTS', '8192'), ('BENCH_SWEEP_BATCH', '1024'),
-                 ('BENCH_SWEEP_SPAN', '4'), ('BENCH_LADDER_DEPTH', '12')):
+                 ('BENCH_SWEEP_SPAN', '4'), ('BENCH_LADDER_DEPTH', '12'),
+                 ('BENCH_SERVE_REQS', '8'), ('BENCH_SERVE_SHOTS', '16')):
         env.setdefault(k, v)
     print('preflight failed on the accelerator backend; rerunning the '
           'bench DEGRADED on CPU (JAX_PLATFORMS=cpu)', file=sys.stderr)
@@ -1235,6 +1240,20 @@ def main():
     else:
         ladder = None
     artifact.row('engine_ladder', ladder)
+    # continuous-batching row: N concurrent single-program service
+    # submissions (coalesced into shape-bucketed multi dispatches) vs N
+    # sequential per-program simulate_batch calls, both warm, results
+    # asserted bit-identical — guarded like every secondary
+    try:
+        serve_row = _timed_row(lambda: continuous_batching_comparison(
+            n_reqs=int(os.environ.get('BENCH_SERVE_REQS', 32)),
+            shots=int(os.environ.get('BENCH_SERVE_SHOTS', 32)))) \
+            if secondaries else None
+    except _RowTimeout as e:
+        serve_row = {'error': 'timeout', 'detail': str(e)}
+    except Exception as e:      # pragma: no cover - defensive
+        serve_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+    artifact.row('continuous_batching', serve_row)
 
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
@@ -1281,6 +1300,7 @@ def main():
             'multi_sequence_rb': multi_rb,
             'sweep_span': sweep_span,
             'engine_ladder': ladder,
+            'continuous_batching': serve_row,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

@@ -15,6 +15,9 @@ Layers:
   discrimination (Pallas on TPU)
 * :mod:`.parallel` — shot/sweep sharding over the TPU mesh
 * :mod:`.models` — canned experiments (randomized benchmarking, sweeps)
+* :mod:`.serve` — continuous-batching execution service: async
+  submission, shape-bucketed coalescing, per-request futures (imported
+  explicitly — it pulls in jax)
 """
 
 __version__ = '0.1.0'

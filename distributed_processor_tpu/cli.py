@@ -356,6 +356,15 @@ def cmd_trace(args):
             print(f'  step {s:4d}  t={t:8d}  pc={pc}')
 
 
+def cmd_serve_bench(args):
+    from .serve.benchmark import continuous_batching_comparison
+    row = continuous_batching_comparison(
+        n_reqs=args.requests, n_qubits=args.qubits, depth=args.depth,
+        shots=args.shots, seed=args.seed,
+        max_wait_ms=args.max_wait_ms)
+    print(json.dumps(row, indent=2))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog='dproc-tpu',
                                  description=__doc__.split('\n')[0])
@@ -525,6 +534,22 @@ def main(argv=None):
                    help='interpreter step budget override (see '
                         '`run --help`)')
     p.set_defaults(fn=cmd_sweep)
+
+    p = sub.add_parser('serve-bench',
+                       help='continuous-batching service benchmark: N '
+                            'concurrent submissions vs N sequential '
+                            'dispatches, warm, bit-identity checked')
+    p.add_argument('--requests', type=int, default=32,
+                   help='concurrent single-program requests')
+    p.add_argument('--shots', type=int, default=32,
+                   help='shots per request')
+    p.add_argument('--depth', type=int, default=2,
+                   help='RB depth of each random program')
+    p.add_argument('--seed', type=int, default=0,
+                   help='ensemble seed')
+    p.add_argument('--max-wait-ms', type=float, default=100.0,
+                   help='coalescing deadline passed to the service')
+    p.set_defaults(fn=cmd_serve_bench)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')
     p.add_argument('program')

@@ -438,24 +438,29 @@ def stack_machine_programs(mps: list, pad_to: int = None,
     share one compiled executable (``pad_to`` raises the floor further).
     Programs must agree on core count and element geometry: the
     ensemble shares one set of per-core sample-rate constants, and a
-    mismatch would silently mistime pulses.
+    mismatch would silently mistime pulses.  A mismatch raises
+    ``ValueError`` naming the offending program INDEX, so batching
+    callers (the serving runtime's coalescer) can reject the one bad
+    submission instead of surfacing a shape error from deep inside a
+    jit.
     """
     if not mps:
         raise ValueError('need at least one MachineProgram to stack')
     first = mps[0]
     geom = [(ec.samples_per_clk, ec.interp_ratio)
             for t in first.tables for ec in t.elem_cfgs]
-    for mp in mps[1:]:
+    for i, mp in enumerate(mps[1:], start=1):
         if mp.n_cores != first.n_cores:
             raise ValueError(
-                f'core-count mismatch in ensemble: {mp.n_cores} != '
-                f'{first.n_cores}')
+                f'core-count mismatch in ensemble: program {i} has '
+                f'{mp.n_cores} cores != program 0\'s {first.n_cores}')
         g = [(ec.samples_per_clk, ec.interp_ratio)
              for t in mp.tables for ec in t.elem_cfgs]
         if g != geom:
             raise ValueError(
-                'element geometry differs across the ensemble — stacked '
-                'programs share per-core sample-rate constants')
+                f'element geometry of program {i} differs from program '
+                f'0\'s — stacked programs share per-core sample-rate '
+                f'constants')
     n = max(mp.n_instr for mp in mps)
     if pad_to is not None:
         n = max(n, pad_to)

@@ -1,0 +1,27 @@
+"""Continuous-batching execution service (docs/SERVING.md).
+
+The serving tier sits above the multi-program interpreter: many
+independent callers submit compiled machine programs asynchronously;
+one dispatcher coalesces them into shape-bucketed batches so they share
+``simulate_multi_batch``'s warm jit cache, then demuxes per-request
+stats back onto future-like handles.  The QubiC reference serves one
+FPGA board per user; the TPU port serves many users per chip by making
+batch occupancy a scheduling decision instead of a caller obligation.
+"""
+
+from .batcher import Coalescer, bucket_key
+from .request import (CancelledError, DeadlineError, QueueFullError,
+                      RequestHandle, ServiceClosedError)
+from .service import DISPATCH_THREAD_PREFIX, ExecutionService
+
+__all__ = [
+    'CancelledError',
+    'Coalescer',
+    'DISPATCH_THREAD_PREFIX',
+    'DeadlineError',
+    'ExecutionService',
+    'QueueFullError',
+    'RequestHandle',
+    'ServiceClosedError',
+    'bucket_key',
+]

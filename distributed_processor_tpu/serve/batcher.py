@@ -1,0 +1,170 @@
+"""Shape-bucketed coalescing: which requests may share one dispatch.
+
+The whole point of the service is that co-batched requests hit the
+multi-program engine's WARM jit cache (``simulate_multi_batch`` keys on
+the bucket SHAPE, not program content — PR 1's amortization).  So the
+coalescing key is exactly the set of things that pick a compiled
+executable or change its semantics:
+
+* ``n_cores`` — the stacked tensor's core axis;
+* ``isa.shape_bucket(n_instr)`` — the power-of-two instruction bucket
+  every member is DONE-padded into;
+* the element geometry tuple — stacked programs share one set of
+  per-core sample-rate constants (``stack_machine_programs`` would
+  reject a mismatch; keying on it means mismatched submissions simply
+  land in different buckets instead of failing a batch);
+* the normalized :class:`InterpreterConfig` — a static jit argument.
+
+Shot counts are deliberately NOT part of the key: short requests are
+padded up to the batch's shot count by replicating their own rows
+(deterministic execution makes replica lanes observationally inert;
+``demux_multi_batch`` trims them back off).
+
+Inside a bucket, requests order by priority lane (higher first) with
+FIFO arrival as the tiebreak; a bucket becomes ripe when it holds
+``max_batch_programs`` requests or its oldest member has waited
+``max_wait_ms`` — the classic continuous-batching latency/throughput
+dial (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import time
+
+from .. import isa
+from .request import DeadlineError, Request
+
+
+def bucket_key(mp, cfg) -> tuple:
+    """The coalescing key: requests with equal keys may share a batch."""
+    geom = tuple((ec.samples_per_clk, ec.interp_ratio)
+                 for t in mp.tables for ec in t.elem_cfgs)
+    return (mp.n_cores, isa.shape_bucket(mp.n_instr), geom, cfg)
+
+
+class Coalescer:
+    """Per-bucket pending queues.  NOT thread-safe on its own: every
+    method is called under the service's lock — the coalescer is the
+    data structure, the service owns the concurrency."""
+
+    def __init__(self, max_batch_programs: int, max_wait_s: float):
+        self.max_batch_programs = max_batch_programs
+        self.max_wait_s = max_wait_s
+        self._buckets: dict = {}     # key -> list[Request], arrival order
+        self._depth = 0
+        # requests observed leaving via handle.cancel() (dropped during
+        # pruning or lost the claim race) — the service folds this into
+        # its stats() 'cancelled' count
+        self.dropped_cancelled = 0
+
+    def __len__(self) -> int:
+        return self._depth
+
+    def push(self, key: tuple, req: Request) -> None:
+        self._buckets.setdefault(key, []).append(req)
+        self._depth += 1
+
+    def cancel_all(self, exc: BaseException) -> int:
+        """Fail every queued request (non-draining shutdown)."""
+        n = 0
+        for reqs in self._buckets.values():
+            for req in reqs:
+                if req.handle._fail(exc):
+                    n += 1
+        self._buckets.clear()
+        self._depth = 0
+        return n
+
+    def _prune(self, now: float) -> list:
+        """Drop cancelled requests; fail expired ones (batch-boundary
+        deadline semantics).  Returns the expired requests so the
+        service can count them."""
+        expired = []
+        for key in list(self._buckets):
+            kept = []
+            for req in self._buckets[key]:
+                if req.handle.done():           # cancelled meanwhile
+                    self._depth -= 1
+                    if req.handle.cancelled():
+                        self.dropped_cancelled += 1
+                elif req.deadline is not None and now >= req.deadline:
+                    self._depth -= 1
+                    if req.handle._fail(DeadlineError(
+                            f'deadline passed while queued '
+                            f'({now - req.submit_t:.3f} s after '
+                            f'submission)')):
+                        expired.append(req)
+                else:
+                    kept.append(req)
+            if kept:
+                self._buckets[key] = kept
+            else:
+                del self._buckets[key]
+        return expired
+
+    def _ripe(self, reqs: list, now: float, flush: bool) -> bool:
+        if flush or len(reqs) >= self.max_batch_programs:
+            return True
+        return (now - min(r.submit_t for r in reqs)) >= self.max_wait_s
+
+    def pop_batch(self, now: float = None, flush: bool = False):
+        """Claim and return the next batch:
+        ``(key, [Request, ...], expired)`` — ``key`` is None when
+        nothing is ripe (``expired`` lists deadline-failed requests
+        either way).
+
+        Among ripe buckets the one whose best request has the highest
+        priority wins (oldest arrival breaks the tie); within the
+        bucket, up to ``max_batch_programs`` requests leave in
+        (priority desc, arrival asc) order.  Every returned request has
+        been atomically claimed — ``cancel()`` on it returns False from
+        here on.
+        """
+        if now is None:
+            now = time.monotonic()
+        expired = self._prune(now)
+        best_key, best_rank = None, None
+        for key, reqs in self._buckets.items():
+            if not self._ripe(reqs, now, flush):
+                continue
+            head = min(reqs, key=lambda r: (-r.priority, r.seq))
+            rank = (-head.priority, head.seq)
+            if best_rank is None or rank < best_rank:
+                best_key, best_rank = key, rank
+        if best_key is None:
+            return None, [], expired
+        reqs = sorted(self._buckets[best_key],
+                      key=lambda r: (-r.priority, r.seq))
+        take, leave = (reqs[:self.max_batch_programs],
+                       reqs[self.max_batch_programs:])
+        batch = []
+        for r in take:
+            if r.handle._claim():
+                batch.append(r)
+            elif r.handle.cancelled():   # lost the race to cancel()
+                self.dropped_cancelled += 1
+        if leave:
+            self._buckets[best_key] = sorted(leave, key=lambda r: r.seq)
+        else:
+            del self._buckets[best_key]
+        self._depth -= len(take)
+        if not batch:       # every candidate was cancelled in the race
+            return None, [], expired
+        return best_key, batch, expired
+
+    def next_event(self, now: float = None) -> float:
+        """Seconds until the next scheduled wake-up (a bucket ripening
+        or a deadline expiring), or None when the queue is empty — the
+        dispatcher's condition-wait timeout."""
+        if not self._buckets:
+            return None
+        if now is None:
+            now = time.monotonic()
+        horizon = None
+        for reqs in self._buckets.values():
+            oldest = min(r.submit_t for r in reqs)
+            events = [oldest + self.max_wait_s]
+            events += [r.deadline for r in reqs if r.deadline is not None]
+            t = min(events)
+            horizon = t if horizon is None else min(horizon, t)
+        return max(horizon - now, 0.0)

@@ -1,0 +1,111 @@
+"""Continuous-batching benchmark: the service's reason to exist, timed.
+
+One workload, two executions: ``n_reqs`` independent single-program
+requests (distinct random RB sequences — the realistic many-users
+shape) run (a) sequentially, one ``simulate_batch`` dispatch per
+program, and (b) through :class:`~.service.ExecutionService`, which
+coalesces them into shape-bucketed multi-program dispatches.  Both
+sides use the same normalized generic-engine cfg and both rounds are
+timed WARM (a cold round runs first to pay the one-per-bucket
+compile), so the ratio isolates the dispatch economics: N host
+round-trips vs ~1.  Results are asserted bit-identical before any
+number is reported.
+
+Shared by the ``continuous_batching`` row in bench.py and the
+``serve-bench`` CLI subcommand.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+
+from .. import isa
+from ..models import active_reset, make_default_qchip, rb_ensemble
+from ..pipeline import compile_to_machine
+from ..sim.interpreter import (InterpreterConfig, multi_trace_count,
+                               simulate_batch)
+from .service import ExecutionService
+
+
+def continuous_batching_comparison(n_reqs: int = 32, n_qubits: int = 2,
+                                   depth: int = 2, shots: int = 32,
+                                   seed: int = 0,
+                                   max_wait_ms: float = 100.0) -> dict:
+    """Warm throughput of ``n_reqs`` service submissions vs the same
+    requests dispatched sequentially; returns a JSON-able row."""
+    qubits = [f'Q{i}' for i in range(n_qubits)]
+    qchip = make_default_qchip(n_qubits)
+    mps = [compile_to_machine(active_reset(qubits) + prog, qchip,
+                              n_qubits=n_qubits)
+           for prog in rb_ensemble(qubits, depth, n_reqs, seed=seed)]
+    C = mps[0].n_cores
+    bucket = max(isa.shape_bucket(mp.n_instr) for mp in mps)
+    cfg = InterpreterConfig(max_steps=2 * bucket + 64,
+                            max_pulses=bucket + 2, max_meas=2,
+                            max_resets=2, record_pulses=False)
+    rng = np.random.default_rng(11)
+    bits = [rng.integers(0, 2, size=(shots, C, 2)).astype(np.int32)
+            for _ in mps]
+
+    def run_sequential():
+        outs = []
+        t0 = time.perf_counter()
+        for mp, b in zip(mps, bits):
+            # np transfer per call mirrors what the service hands back
+            outs.append(jax.tree.map(
+                np.asarray, simulate_batch(mp, b, cfg=cfg)))
+        return outs, time.perf_counter() - t0
+
+    def run_service():
+        svc = ExecutionService(cfg, max_batch_programs=n_reqs,
+                               max_wait_ms=max_wait_ms,
+                               max_queue=4 * n_reqs)
+        try:
+            t0 = time.perf_counter()
+            handles = [svc.submit(mp, b) for mp, b in zip(mps, bits)]
+            res = [h.result(timeout=600) for h in handles]
+            dt = time.perf_counter() - t0
+            stats = svc.stats()
+        finally:
+            svc.shutdown()
+        return res, dt, stats
+
+    # cold round pays the per-bucket compiles on both sides
+    run_sequential()
+    run_service()
+    # warm round is the measurement
+    seq_outs, t_seq = run_sequential()
+    traces0 = multi_trace_count()
+    svc_res, t_svc, stats = run_service()
+    warm_retraces = multi_trace_count() - traces0
+
+    mismatch = []
+    for i, (a, b) in enumerate(zip(svc_res, seq_outs)):
+        for k in b:
+            if not np.array_equal(np.asarray(a[k]), np.asarray(b[k])):
+                mismatch.append(f'{i}:{k}')
+    if mismatch:
+        raise AssertionError(
+            f'service results diverged from sequential dispatch: '
+            f'{mismatch[:8]}')
+
+    return {
+        'n_reqs': n_reqs, 'n_qubits': n_qubits, 'depth': depth,
+        'shots_per_req': shots, 'bucket_n_instr': bucket,
+        'sequential_warm_s': round(t_seq, 4),
+        'service_warm_s': round(t_svc, 4),
+        'throughput_ratio': round(t_seq / t_svc, 2),
+        'dispatches': stats['dispatches'],
+        'mean_batch_occupancy': round(stats['coalesce_efficiency'], 2),
+        'latency_p50_ms': round(stats['latency_p50_ms'], 3),
+        'latency_p99_ms': round(stats['latency_p99_ms'], 3),
+        'warm_retraces': warm_retraces,
+        'bit_identical': True,
+        'note': 'both sides warm, same generic-engine cfg; ratio is '
+                'N per-program dispatches vs coalesced multi-program '
+                'dispatch(es); results asserted bit-identical first',
+    }

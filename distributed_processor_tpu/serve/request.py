@@ -1,0 +1,163 @@
+"""Request lifecycle for the execution service: the future-like handle.
+
+A submission is decoupled from its execution: :meth:`ExecutionService.
+submit` returns a :class:`RequestHandle` immediately and the dispatcher
+thread fulfils it after the request rides a coalesced batch through the
+interpreter.  The handle is the only object a submitter touches, so its
+state machine is deliberately small and fully lock-guarded:
+
+``queued``      in the coalescer, cancellable, deadline armed
+``dispatched``  claimed by the dispatcher for the batch being built —
+                cancellation no longer possible (the batch boundary IS
+                the cancellation point)
+``done``        result or exception set, ``result()`` unblocked
+
+The states only move forward, and every transition happens under the
+handle's own lock, so ``cancel()`` racing the dispatcher's claim has
+exactly one winner.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class QueueFullError(RuntimeError):
+    """Admission control: the service's bounded queue is full.
+
+    Raised by ``submit`` (never stored on a handle) — backpressure is
+    synchronous so the caller can shed load or retry, instead of the
+    queue growing without bound under overload.
+    """
+
+
+class CancelledError(RuntimeError):
+    """The request was cancelled (``handle.cancel()`` or a non-draining
+    shutdown) before it was dispatched."""
+
+
+class DeadlineError(RuntimeError):
+    """The request's deadline passed before a batch picked it up.
+
+    Deadlines are honored at BATCH BOUNDARIES: a request already
+    claimed for a batch runs to completion (the interpreter cannot be
+    interrupted mid-dispatch); one still queued when its deadline
+    expires is failed with this error at the next dispatch opportunity.
+    """
+
+
+class ServiceClosedError(RuntimeError):
+    """``submit`` after ``shutdown`` began."""
+
+
+_QUEUED, _DISPATCHED, _DONE = 'queued', 'dispatched', 'done'
+
+
+class RequestHandle:
+    """Future-like handle for one submitted program.
+
+    ``result(timeout)`` blocks for the per-request stats dict (the
+    exact :func:`~...sim.interpreter.simulate_batch` schema, demuxed
+    from the coalesced batch), re-raising the request's failure —
+    :class:`~...sim.interpreter.FaultError` under strict fault mode,
+    :class:`CancelledError`, :class:`DeadlineError` — and raising
+    :class:`TimeoutError` if nothing arrived within ``timeout``
+    seconds (the request itself stays live).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._state = _QUEUED
+        self._result = None
+        self._exception = None
+
+    # -- submitter side -------------------------------------------------
+
+    def result(self, timeout: float = None) -> dict:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f'request not completed within {timeout!r} s '
+                f'(still {self._state})')
+        if self._exception is not None:
+            raise self._exception
+        return self._result
+
+    def exception(self, timeout: float = None):
+        """The stored failure (or None), same blocking as ``result``."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f'request not completed within {timeout!r} s')
+        return self._exception
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def cancelled(self) -> bool:
+        return isinstance(self._exception, CancelledError)
+
+    def cancel(self) -> bool:
+        """Cancel if still queued.  Returns True when this call won —
+        the request will never execute and ``result()`` raises
+        :class:`CancelledError`.  Returns False when the request was
+        already claimed by a batch or already done: past the batch
+        boundary there is nothing left to cancel."""
+        return self._fail(CancelledError('request cancelled'),
+                          only_queued=True)
+
+    # -- service side ---------------------------------------------------
+
+    def _claim(self) -> bool:
+        """Dispatcher: move queued -> dispatched; False if the request
+        was cancelled/failed first (the batch must skip it)."""
+        with self._lock:
+            if self._state != _QUEUED:
+                return False
+            self._state = _DISPATCHED
+            return True
+
+    def _fulfill(self, result: dict) -> None:
+        with self._lock:
+            if self._state == _DONE:        # pragma: no cover - defensive
+                return
+            self._state = _DONE
+            self._result = result
+        self._event.set()
+
+    def _fail(self, exc: BaseException, only_queued: bool = False) -> bool:
+        with self._lock:
+            if self._state == _DONE or \
+                    (only_queued and self._state != _QUEUED):
+                return False
+            self._state = _DONE
+            self._exception = exc
+        self._event.set()
+        return True
+
+
+@dataclass
+class Request:
+    """One normalized submission, as the batcher sees it.
+
+    ``meas_bits`` / ``init_regs`` are already validated and in their
+    full per-shot forms (``[n_shots, n_cores, n_meas]`` /
+    ``[n_shots, n_cores, N_REGS]`` or None); ``cfg`` is the normalized
+    count-mode :class:`InterpreterConfig` that is part of the bucket
+    key; ``strict`` records whether THIS request (not its batch-mates)
+    wants ``FaultError`` on trapped shots.  ``deadline`` is an absolute
+    ``time.monotonic()`` value or None; ``seq`` is the service-wide
+    arrival number used as the FIFO tiebreak inside a priority lane.
+    """
+    mp: object
+    meas_bits: object
+    init_regs: object
+    cfg: object
+    strict: bool
+    n_shots: int
+    priority: int
+    deadline: float
+    seq: int
+    handle: RequestHandle = field(default_factory=RequestHandle)
+    submit_t: float = field(default_factory=time.monotonic)

@@ -1,0 +1,412 @@
+"""The continuous-batching execution service.
+
+:class:`ExecutionService` is the in-process serving runtime over the
+interpreter: any thread calls :meth:`~ExecutionService.submit` with one
+compiled :class:`~..decoder.MachineProgram` and gets a
+:class:`~.request.RequestHandle` back immediately; a single dispatcher
+thread drains the queue, coalesces compatible requests into
+shape-bucketed batches (``batcher.bucket_key``), runs each batch
+through :func:`~..sim.interpreter.simulate_multi_batch` — hitting the
+warm jit cache keyed on the bucket SHAPE — and demuxes per-request
+stats back onto the handles.  The classic continuous-batching contract
+(vLLM-style, transplanted from token generation to shot execution):
+
+* latency/throughput dial: a bucket dispatches when it reaches
+  ``max_batch_programs`` or its oldest member has waited
+  ``max_wait_ms``;
+* admission control: a bounded queue (``max_queue``) makes overload a
+  synchronous :class:`QueueFullError` at submit, not unbounded growth;
+* isolation: ``fault_mode='strict'`` raises
+  :class:`~..sim.interpreter.FaultError` on the OFFENDING request's
+  handle only — batch-mates are fulfilled normally (per-request fault
+  slices are checked after demux, never batch-wide);
+* cancellation/deadlines honored at batch boundaries — the claim into
+  a batch is the point of no return;
+* graceful ``shutdown(drain=True)`` flushes everything queued, then
+  joins the dispatcher.
+
+Bit-identity guarantee (tests/test_serve.py): a demuxed result equals
+the solo ``simulate_batch`` run of the same request under the same
+normalized cfg, per stat including ``fault_shots`` — the multi path is
+the generic engine vmapped over programs, each program's step counter
+freezes independently, and short requests are padded by replicating
+their OWN shot rows (inert under deterministic execution, trimmed off
+in :func:`~..sim.interpreter.demux_multi_batch`).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from dataclasses import replace
+
+import numpy as np
+
+import jax
+
+from .. import isa
+from ..decoder import stack_machine_programs
+from ..sim.interpreter import (InterpreterConfig, FaultError,
+                               demux_multi_batch, fault_shot_counts,
+                               simulate_batch, simulate_multi_batch)
+from ..utils import profiling
+from .batcher import Coalescer, bucket_key
+from .request import (CancelledError, QueueFullError, Request,
+                      ServiceClosedError)
+
+# dispatcher threads carry this prefix so the test harness can detect
+# leaked services (tests/conftest.py prints the junit-gated marker —
+# tools/check_junit.py — when one survives a test)
+DISPATCH_THREAD_PREFIX = 'dproc-serve-dispatch'
+
+_SERVICE_SEQ = itertools.count()
+
+
+def _normalize_cfg(cfg: InterpreterConfig, n_instr_bucket: int):
+    """One request cfg -> (bucket-keyed jit cfg, strict flag).
+
+    Budgets default from the BUCKET shape exactly like
+    ``simulate_multi_batch`` derives them (content-derived budgets
+    would fragment the buckets and retrace per ensemble); the engine
+    selector is normalized away (multi path is generic-only) and
+    'strict' is split out as the per-request host policy.
+    """
+    if cfg is None:
+        cfg = InterpreterConfig(max_steps=2 * n_instr_bucket + 64,
+                                max_pulses=n_instr_bucket + 2)
+    if cfg.straightline or cfg.engine in ('straightline', 'block'):
+        raise ValueError(
+            'the execution service coalesces onto the multi-program '
+            'generic engine; straightline/block engines key on program '
+            'content and cannot serve a shared batch (use '
+            'singleton_engine= for 1-program fallback dispatch)')
+    if cfg.opcode_histogram:
+        raise ValueError(
+            'opcode_histogram=True cannot be served: op_hist is summed '
+            'over shot lanes inside the jit, so the shot-replication '
+            'padding used to coalesce unequal shot counts would '
+            'contaminate it (run simulate_batch directly instead)')
+    strict = cfg.fault_mode == 'strict'
+    if cfg.fault_mode not in ('count', 'strict'):
+        raise ValueError(
+            f"fault_mode must be 'count' or 'strict'; got "
+            f"{cfg.fault_mode!r}")
+    if strict or cfg.straightline is None or cfg.engine is not None:
+        cfg = replace(cfg, fault_mode='count', straightline=False,
+                      engine=None)
+    return cfg, strict
+
+
+def _pad_shots(arr: np.ndarray, n_shots: int) -> np.ndarray:
+    """Pad the leading shot axis up to ``n_shots`` by replicating the
+    last row — the inert-lane padding ``demux_multi_batch`` trims."""
+    if arr.shape[0] == n_shots:
+        return arr
+    reps = np.repeat(arr[-1:], n_shots - arr.shape[0], axis=0)
+    return np.concatenate([arr, reps], axis=0)
+
+
+class ExecutionService:
+    """In-process continuous-batching front end over the interpreter.
+
+    Parameters
+    ----------
+    cfg:
+        Default :class:`InterpreterConfig` for submissions that do not
+        bring their own.  ``None`` (default) derives per-bucket budgets
+        the same way ``simulate_multi_batch`` does.
+    max_batch_programs:
+        Coalescing ceiling — a bucket dispatches as soon as it holds
+        this many requests.
+    max_wait_ms:
+        Coalescing deadline — a bucket with fewer requests dispatches
+        once its oldest member has waited this long.  The
+        latency/throughput dial: 0 approximates per-request dispatch,
+        large values maximize occupancy.
+    max_queue:
+        Admission bound on TOTAL queued requests across buckets;
+        ``submit`` raises :class:`QueueFullError` beyond it.
+    singleton_engine:
+        Optional engine selector ('auto' / 'straightline' / 'block' /
+        'generic') for batches that end up with a single program: those
+        gain nothing from the multi path, so they may ride
+        :func:`simulate_batch` and the PR 3 engine ladder instead.
+        Default None keeps everything on the one shared multi-program
+        cache (the right call for compile-bound fleets).
+    """
+
+    def __init__(self, cfg: InterpreterConfig = None, *,
+                 max_batch_programs: int = 16, max_wait_ms: float = 2.0,
+                 max_queue: int = 256, singleton_engine: str = None,
+                 name: str = None):
+        if max_batch_programs < 1:
+            raise ValueError('max_batch_programs must be >= 1')
+        if max_queue < 1:
+            raise ValueError('max_queue must be >= 1')
+        self._default_cfg = cfg
+        self.max_queue = max_queue
+        self.singleton_engine = singleton_engine
+        self.name = name or f'svc{next(_SERVICE_SEQ)}'
+        self._cv = threading.Condition()
+        self._q = Coalescer(max_batch_programs, max_wait_ms / 1e3)
+        self._seq = itertools.count()
+        self._closing = False
+        self._drain = True
+        # stats (guarded by _cv's lock)
+        self._submitted = 0
+        self._completed = 0
+        self._failed = 0          # FaultError / batch execution errors
+        self._cancelled = 0
+        self._expired = 0
+        self._rejected = 0        # QueueFullError at admission
+        self._dispatches = 0
+        self._programs_dispatched = 0
+        self._occupancy = collections.Counter()   # batch size -> count
+        self._latency_s = collections.deque(maxlen=4096)
+        self._thread = threading.Thread(
+            target=self._dispatch_loop,
+            name=f'{DISPATCH_THREAD_PREFIX}-{self.name}', daemon=True)
+        self._thread.start()
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, mp, meas_bits=None, *, shots: int = None,
+               init_regs=None, cfg: InterpreterConfig = None,
+               priority: int = 0, deadline_ms: float = None,
+               fault_mode: str = None):
+        """Queue one program for execution; returns its
+        :class:`RequestHandle` immediately.
+
+        ``meas_bits`` is ``[n_shots, n_cores, n_meas]`` (or None with
+        ``shots=`` for all-zero measurement feeds); ``init_regs`` is
+        None, ``[n_cores, N_REGS]`` (shared across shots) or
+        ``[n_shots, n_cores, N_REGS]``.  ``priority`` picks the lane
+        (higher dispatches first); ``deadline_ms`` arms a
+        relative-to-now deadline enforced at batch boundaries;
+        ``fault_mode`` overrides the cfg's ('strict' raises
+        :class:`FaultError` on THIS handle only, batch-mates are
+        unaffected).
+        """
+        if meas_bits is None:
+            if shots is None:
+                raise ValueError('provide meas_bits or shots=')
+            n_shots = int(shots)
+            if n_shots < 1:
+                raise ValueError('shots must be >= 1')
+        else:
+            meas_bits = np.asarray(meas_bits, np.int32)
+            if meas_bits.ndim != 3 or meas_bits.shape[1] != mp.n_cores:
+                raise ValueError(
+                    f'meas_bits must be [n_shots, n_cores='
+                    f'{mp.n_cores}, n_meas]; got '
+                    f'{tuple(meas_bits.shape)}')
+            if shots is not None and shots != meas_bits.shape[0]:
+                raise ValueError(
+                    f'shots={shots} contradicts meas_bits shot axis '
+                    f'{meas_bits.shape[0]}')
+            n_shots = meas_bits.shape[0]
+            if n_shots < 1:
+                raise ValueError('meas_bits must carry >= 1 shot')
+        cfg = cfg if cfg is not None else self._default_cfg
+        if fault_mode is not None:
+            base = cfg if cfg is not None else InterpreterConfig(
+                max_steps=2 * isa.shape_bucket(mp.n_instr) + 64,
+                max_pulses=isa.shape_bucket(mp.n_instr) + 2)
+            cfg = replace(base, fault_mode=fault_mode)
+        cfg, strict = _normalize_cfg(cfg, isa.shape_bucket(mp.n_instr))
+        if meas_bits is None:
+            meas_bits = np.zeros((n_shots, mp.n_cores, cfg.max_meas),
+                                 np.int32)
+        elif meas_bits.shape[-1] != cfg.max_meas:
+            # normalize the measurement width here (same truncate/zero-
+            # pad as the interpreter's _pad_meas) so every member of a
+            # bucket stacks into one [P, B, C, max_meas] tensor
+            if meas_bits.shape[-1] > cfg.max_meas:
+                meas_bits = meas_bits[..., :cfg.max_meas]
+            else:
+                meas_bits = np.pad(meas_bits, [
+                    (0, 0), (0, 0),
+                    (0, cfg.max_meas - meas_bits.shape[-1])])
+        if init_regs is not None:
+            init_regs = np.asarray(init_regs, np.int32)
+            if init_regs.ndim == 2:
+                init_regs = np.broadcast_to(
+                    init_regs[None],
+                    (n_shots,) + init_regs.shape).copy()
+            if init_regs.ndim != 3 or init_regs.shape != (
+                    n_shots, mp.n_cores, isa.N_REGS):
+                raise ValueError(
+                    f'init_regs must be [n_cores, {isa.N_REGS}] or '
+                    f'[n_shots={n_shots}, n_cores={mp.n_cores}, '
+                    f'{isa.N_REGS}]; got {tuple(init_regs.shape)}')
+        deadline = None if deadline_ms is None \
+            else time.monotonic() + deadline_ms / 1e3
+        key = bucket_key(mp, cfg)
+        with self._cv:
+            if self._closing:
+                raise ServiceClosedError(
+                    f'service {self.name!r} is shut down')
+            if len(self._q) >= self.max_queue:
+                self._rejected += 1
+                profiling.counter_inc('serve.rejected')
+                raise QueueFullError(
+                    f'queue full ({self.max_queue} requests pending)')
+            req = Request(mp=mp, meas_bits=meas_bits,
+                          init_regs=init_regs, cfg=cfg, strict=strict,
+                          n_shots=n_shots, priority=priority,
+                          deadline=deadline, seq=next(self._seq))
+            self._q.push(key, req)
+            self._submitted += 1
+            profiling.counter_inc('serve.submitted')
+            self._cv.notify_all()
+        return req.handle
+
+    # -- dispatcher ------------------------------------------------------
+
+    def _dispatch_loop(self):
+        while True:
+            with self._cv:
+                while True:
+                    flush = self._closing and self._drain
+                    key, batch, expired = self._q.pop_batch(flush=flush)
+                    if expired:
+                        self._expired += len(expired)
+                        profiling.counter_inc('serve.expired',
+                                              len(expired))
+                    if key is not None:
+                        break
+                    if self._closing and (not self._drain
+                                          or len(self._q) == 0):
+                        return
+                    timeout = self._q.next_event()
+                    if timeout is None or timeout > 0:
+                        self._cv.wait(timeout)
+                    # timeout == 0.0: a bucket is already ripe, loop
+            self._execute(key, batch)
+
+    def _execute(self, key, batch):
+        cfg = key[-1]
+        t0 = time.monotonic()
+        try:
+            results = self._run_batch(batch, cfg)
+        except Exception as exc:      # noqa: BLE001 - fail the batch, live on
+            with self._cv:
+                self._failed += len(batch)
+            profiling.counter_inc('serve.batch_failures')
+            for req in batch:
+                req.handle._fail(exc)
+            return
+        completed = failed = 0
+        for req, res in zip(batch, results):
+            if req.strict:
+                counts = np.asarray(fault_shot_counts(res['fault']))
+                if counts.any():
+                    req.handle._fail(FaultError(counts))
+                    failed += 1
+                    continue
+            req.handle._fulfill(res)
+            completed += 1
+        now = time.monotonic()
+        with self._cv:
+            self._dispatches += 1
+            self._programs_dispatched += len(batch)
+            self._occupancy[len(batch)] += 1
+            self._completed += completed
+            self._failed += failed
+            for req in batch:
+                self._latency_s.append(now - req.submit_t)
+        profiling.counter_inc('serve.dispatches')
+        profiling.counter_inc('serve.programs_dispatched', len(batch))
+        profiling.counter_inc('serve.batch_ms',
+                              int((now - t0) * 1e3))
+
+    def _run_batch(self, batch, cfg):
+        """Execute one coalesced batch; returns per-request stats dicts
+        in batch order (host numpy, padding trimmed)."""
+        if len(batch) == 1 and self.singleton_engine is not None:
+            req = batch[0]
+            out = simulate_batch(
+                req.mp, req.meas_bits, req.init_regs,
+                cfg=replace(cfg, engine=self.singleton_engine))
+            return [jax.tree.map(np.asarray, out)]
+        B = max(r.n_shots for r in batch)
+        meas = np.stack([_pad_shots(r.meas_bits, B) for r in batch])
+        if any(r.init_regs is not None for r in batch):
+            init = np.stack([
+                _pad_shots(r.init_regs, B) if r.init_regs is not None
+                else np.zeros((B, r.mp.n_cores, isa.N_REGS), np.int32)
+                for r in batch])
+        else:
+            init = None
+        mmp = stack_machine_programs([r.mp for r in batch],
+                                     pad_to=key_bucket(batch))
+        out = simulate_multi_batch(mmp, meas, init, cfg=cfg)
+        host = jax.tree.map(np.asarray, out)
+        return [demux_multi_batch(host, i, n_shots=r.n_shots)
+                for i, r in enumerate(batch)]
+
+    # -- introspection / lifecycle ---------------------------------------
+
+    def stats(self) -> dict:
+        """Snapshot of the service counters: queue depth, batch
+        occupancy histogram, coalescing efficiency (programs per
+        dispatch), and p50/p99 submit-to-done latency in ms."""
+        with self._cv:
+            lat = np.asarray(self._latency_s, np.float64)
+            occ = dict(sorted(self._occupancy.items()))
+            snap = {
+                'queue_depth': len(self._q),
+                'submitted': self._submitted,
+                'completed': self._completed,
+                'failed': self._failed,
+                'cancelled': self._cancelled + self._q.dropped_cancelled,
+                'expired': self._expired,
+                'rejected': self._rejected,
+                'dispatches': self._dispatches,
+                'programs_dispatched': self._programs_dispatched,
+                'batch_occupancy': occ,
+                'coalesce_efficiency': (
+                    self._programs_dispatched / self._dispatches
+                    if self._dispatches else 0.0),
+            }
+        if lat.size:
+            snap['latency_p50_ms'] = float(np.percentile(lat, 50) * 1e3)
+            snap['latency_p99_ms'] = float(np.percentile(lat, 99) * 1e3)
+        else:
+            snap['latency_p50_ms'] = snap['latency_p99_ms'] = 0.0
+        snap['latency_samples'] = int(lat.size)
+        return snap
+
+    def shutdown(self, drain: bool = True, timeout: float = None):
+        """Stop the service.  ``drain=True`` (default) flushes every
+        queued request through dispatch first; ``drain=False`` fails
+        queued requests with :class:`CancelledError` (in-flight batches
+        still complete).  Joins the dispatcher thread (up to
+        ``timeout`` seconds); idempotent."""
+        with self._cv:
+            if not self._closing:
+                self._closing = True
+                self._drain = drain
+                if not drain:
+                    n = self._q.cancel_all(CancelledError(
+                        f'service {self.name!r} shut down without '
+                        f'draining'))
+                    self._cancelled += n
+                    profiling.counter_inc('serve.cancelled', n)
+            self._cv.notify_all()
+        self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.shutdown(drain=exc_info[0] is None)
+
+
+def key_bucket(batch) -> int:
+    """The instruction bucket every member of a coalesced batch pads
+    into — identical across the batch by construction (it is part of
+    the coalescing key)."""
+    return isa.shape_bucket(batch[0].mp.n_instr)

@@ -2561,6 +2561,34 @@ def simulate_multi_batch(mps, meas_bits, init_regs=None,
         strict)
 
 
+# per-program scalars of the simulate_multi_batch result: every other
+# leaf carries a shot axis after the program axis is sliced away
+_MULTI_SCALAR_KEYS = ('steps', 'incomplete', 'op_hist')
+
+
+def demux_multi_batch(out: dict, prog: int, n_shots: int = None) -> dict:
+    """Per-program view of a :func:`simulate_multi_batch` result.
+
+    Slices program ``prog`` off the leading axis of every leaf,
+    restoring the exact :func:`simulate_batch` schema (``steps`` /
+    ``incomplete`` become scalars again).  ``n_shots`` additionally
+    trims the shot axis to the first ``n_shots`` lanes — the serving
+    runtime pads short requests up to the coalesced batch's shot count
+    by REPLICATING their own rows (execution is deterministic per lane,
+    so replica lanes change nothing observable), and this is where the
+    padding comes back off.  ``op_hist`` is the one aggregate a shot
+    slice cannot demux (it is summed over lanes inside the jit); it is
+    passed through per program, replica lanes included.
+    """
+    res = {}
+    for k, v in out.items():
+        vi = v[prog]
+        if n_shots is not None and k not in _MULTI_SCALAR_KEYS:
+            vi = vi[:n_shots]
+        res[k] = vi
+    return res
+
+
 def _fault_policy(cfg: InterpreterConfig):
     """Split ``cfg.fault_mode`` into (jit cfg, strict flag).
 

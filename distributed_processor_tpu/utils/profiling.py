@@ -8,6 +8,7 @@ instruction trace and (b) the JAX/XLA device profiler wrapped below.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 import numpy as np
@@ -24,24 +25,33 @@ import jax
 # Counters are ints incremented at Python (trace) time — NOT inside traced
 # code — so they count host events (jit cache misses, dispatches), which
 # is exactly what the retrace-contract tests assert on.
+#
+# The registry is thread-safe: the serving runtime (serve/) increments
+# from its dispatcher thread while submitters read snapshots, and a bare
+# dict read-modify-write would drop increments under that interleaving
+# (and let trace-count asserts misfire on torn snapshots).
 
 _COUNTERS: dict = {}
+_COUNTERS_LOCK = threading.Lock()
 
 
 def counter_inc(name: str, amount: int = 1) -> int:
     """Increment (and return) the named counter."""
-    _COUNTERS[name] = _COUNTERS.get(name, 0) + amount
-    return _COUNTERS[name]
+    with _COUNTERS_LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + amount
+        return _COUNTERS[name]
 
 
 def counter_get(name: str) -> int:
     """Current value of the named counter (0 if never incremented)."""
-    return _COUNTERS.get(name, 0)
+    with _COUNTERS_LOCK:
+        return _COUNTERS.get(name, 0)
 
 
 def counters() -> dict:
-    """Snapshot of every named counter."""
-    return dict(_COUNTERS)
+    """Consistent snapshot of every named counter."""
+    with _COUNTERS_LOCK:
+        return dict(_COUNTERS)
 
 
 @contextlib.contextmanager

@@ -36,6 +36,24 @@ import pytest
 REFERENCE_ROOT = os.environ.get('DPROC_REFERENCE_ROOT', '/root/reference')
 
 
+@pytest.fixture(autouse=True)
+def _serve_thread_leak_probe():
+    """Print the junit-gated marker when a test leaks an execution-
+    service dispatcher thread (tools/check_junit.py fails CI on it).
+
+    A leaked dispatcher outlives its test, keeps a jit cache reference
+    alive, and can dispatch into a torn-down fixture — the serving
+    analog of the fault-leak gate: tests must shut their services down
+    (ExecutionService is a context manager)."""
+    import threading
+    yield
+    leaked = sorted(t.name for t in threading.enumerate()
+                    if t.name.startswith('dproc-serve-dispatch')
+                    and t.is_alive())
+    if leaked:
+        print(f'SERVICE THREAD LEAK: {leaked}')
+
+
 @pytest.fixture(autouse=True, scope='module')
 def _clear_jax_caches_between_modules():
     """Free compiled executables between test FILES.

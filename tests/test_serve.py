@@ -1,0 +1,370 @@
+"""Continuous-batching execution service (serve/): the contract.
+
+The load-bearing property is BIT-IDENTITY: a request's demuxed stats
+equal the solo ``simulate_batch`` run of the same program under the
+same cfg, per stat including the fault word — coalescing is a pure
+scheduling optimization, never a semantic one.  Around that: strict
+faults stay on the offending handle (batch-mates unharmed),
+cancellation/deadlines act at batch boundaries, admission control is
+synchronous, shutdown drains or cancels cleanly, and many submitter
+threads can hammer one service (the slow stress test).  Every test
+shuts its service down — tests/conftest.py prints the junit-gated
+thread-leak marker if a dispatcher survives.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.decoder import (machine_program_from_cmds,
+                                               stack_machine_programs)
+from distributed_processor_tpu.models import (active_reset,
+                                              make_default_qchip,
+                                              rb_ensemble)
+from distributed_processor_tpu.pipeline import compile_to_machine
+from distributed_processor_tpu.serve import (CancelledError, Coalescer,
+                                             DeadlineError,
+                                             ExecutionService,
+                                             QueueFullError,
+                                             ServiceClosedError,
+                                             bucket_key)
+from distributed_processor_tpu.serve.request import Request
+from distributed_processor_tpu.sim.interpreter import (FaultError,
+                                                       InterpreterConfig,
+                                                       demux_multi_batch,
+                                                       simulate_batch,
+                                                       simulate_multi_batch)
+
+pytestmark = pytest.mark.serve
+
+
+def _ensemble(n_qubits, depth, n_seqs, seed):
+    qubits = [f'Q{i}' for i in range(n_qubits)]
+    qchip = make_default_qchip(n_qubits)
+    return [compile_to_machine(active_reset(qubits) + prog, qchip,
+                               n_qubits=n_qubits)
+            for prog in rb_ensemble(qubits, depth, n_seqs, seed=seed)]
+
+
+def _cfg_for(mps, **kw):
+    bucket = max(isa.shape_bucket(mp.n_instr) for mp in mps)
+    base = dict(max_steps=2 * bucket + 64, max_pulses=bucket + 2,
+                max_meas=2, max_resets=2)
+    base.update(kw)
+    return InterpreterConfig(**base)
+
+
+def _solo(mp, bits, cfg, **kw):
+    return jax.tree.map(np.asarray, simulate_batch(mp, bits, cfg=cfg,
+                                                   **kw))
+
+
+def _assert_same(got, want, label=''):
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(want[k]),
+                                      err_msg=f'{label}:{k}')
+
+
+def _loop_mp(iters=1000):
+    """Counted loop that exhausts any small step budget (traps)."""
+    core = [isa.alu_cmd('reg_alu', 'i', iters, 'id0', write_reg_addr=0),
+            isa.pulse_cmd(amp_word=1000, cfg_word=0, env_word=3,
+                          cmd_time=10),
+            isa.alu_cmd('reg_alu', 'i', -1, 'add', 0, write_reg_addr=0),
+            isa.alu_cmd('jump_cond', 'i', 0, 'le', 0, jump_cmd_ptr=1),
+            isa.done_cmd()]
+    return machine_program_from_cmds([core])
+
+
+def _clean_mp():
+    """Branch-free single-core program in _loop_mp's shape bucket."""
+    core = [isa.pulse_cmd(amp_word=1000, cfg_word=0, env_word=3,
+                          cmd_time=10 + 20 * i) for i in range(3)] \
+        + [isa.done_cmd()]
+    return machine_program_from_cmds([core])
+
+
+# ---------------------------------------------------------------------------
+# demux helper + stacking validation (the satellites the service rides on)
+# ---------------------------------------------------------------------------
+
+def test_demux_matches_direct_multi():
+    mps = _ensemble(2, 2, 3, seed=5)
+    cfg = _cfg_for(mps)
+    rng = np.random.default_rng(1)
+    bits = rng.integers(0, 2, (len(mps), 8, mps[0].n_cores, 2)) \
+        .astype(np.int32)
+    out = jax.tree.map(np.asarray,
+                       simulate_multi_batch(mps, bits, cfg=cfg))
+    for i, mp in enumerate(mps):
+        got = demux_multi_batch(out, i)
+        want = _solo(mp, bits[i], cfg)
+        _assert_same(got, want, f'prog{i}')
+
+
+def test_demux_trims_replication_padding():
+    mps = _ensemble(2, 2, 2, seed=6)
+    cfg = _cfg_for(mps)
+    rng = np.random.default_rng(2)
+    short = rng.integers(0, 2, (5, mps[0].n_cores, 2)).astype(np.int32)
+    # pad request 0 up to 8 shots by replicating its own last row
+    padded = np.concatenate([short, np.repeat(short[-1:], 3, 0)])
+    full = rng.integers(0, 2, (8, mps[0].n_cores, 2)).astype(np.int32)
+    out = jax.tree.map(np.asarray, simulate_multi_batch(
+        mps, np.stack([padded, full]), cfg=cfg))
+    got = demux_multi_batch(out, 0, n_shots=5)
+    _assert_same(got, _solo(mps[0], short, cfg), 'trimmed')
+
+
+def test_stack_mismatch_names_program_index():
+    mps = _ensemble(2, 2, 2, seed=7) + [_loop_mp()]   # 1 core vs many
+    with pytest.raises(ValueError, match=r'program 2'):
+        stack_machine_programs(mps)
+
+
+# ---------------------------------------------------------------------------
+# the service: bit-identity through coalesced dispatch
+# ---------------------------------------------------------------------------
+
+def test_service_bit_identity_mixed_buckets_and_shots():
+    """Requests with unequal shot counts and DIFFERENT shape buckets
+    (depth 2 vs depth 12) coalesce into per-bucket batches, and every
+    demuxed result equals the solo run."""
+    small = _ensemble(2, 2, 3, seed=8)
+    big = _ensemble(2, 12, 2, seed=9)
+    cfg_s, cfg_b = _cfg_for(small), _cfg_for(big)
+    assert isa.shape_bucket(small[0].n_instr) \
+        != isa.shape_bucket(big[0].n_instr)
+    rng = np.random.default_rng(3)
+    reqs = [(mp, cfg_s, rng.integers(0, 2, (4 + 3 * i, mp.n_cores, 2))
+             .astype(np.int32)) for i, mp in enumerate(small)]
+    reqs += [(mp, cfg_b, rng.integers(0, 2, (6, mp.n_cores, 2))
+              .astype(np.int32)) for mp in big]
+    with ExecutionService(max_batch_programs=8, max_wait_ms=25.0) as svc:
+        handles = [svc.submit(mp, bits, cfg=cfg)
+                   for mp, cfg, bits in reqs]
+        results = [h.result(timeout=300) for h in handles]
+        stats = svc.stats()
+    assert stats['completed'] == len(reqs)
+    assert stats['dispatches'] >= 2          # one per bucket at least
+    assert stats['queue_depth'] == 0
+    assert sum(n * c for n, c in stats['batch_occupancy'].items()) \
+        == len(reqs)
+    for (mp, cfg, bits), got in zip(reqs, results):
+        _assert_same(got, _solo(mp, bits, cfg), 'serve')
+
+
+def test_service_init_regs_and_shots_only():
+    mps = _ensemble(2, 2, 2, seed=10)
+    cfg = _cfg_for(mps)
+    regs = np.arange(mps[0].n_cores * isa.N_REGS, dtype=np.int32) \
+        .reshape(mps[0].n_cores, isa.N_REGS) % 7
+    with ExecutionService(cfg, max_batch_programs=2,
+                          max_wait_ms=25.0) as svc:
+        h0 = svc.submit(mps[0], shots=4, init_regs=regs)
+        h1 = svc.submit(mps[1], shots=4)
+        r0, r1 = h0.result(timeout=300), h1.result(timeout=300)
+    zeros = np.zeros((4, mps[0].n_cores, cfg.max_meas), np.int32)
+    _assert_same(r0, _solo(mps[0], zeros, cfg, init_regs=regs), 'regs')
+    _assert_same(r1, _solo(mps[1], zeros, cfg), 'zero-bits')
+
+
+def test_strict_fault_isolation():
+    """One coalesced batch: a strict faulting request raises on ITS
+    handle only; the count-mode faulting mate reports counts in-band;
+    the clean mates are fulfilled bit-identically."""
+    faulty_strict, faulty_count = _loop_mp(), _loop_mp()
+    clean_a, clean_b = _clean_mp(), _clean_mp()
+    cfg = InterpreterConfig(max_steps=6, max_pulses=8, max_meas=2)
+    bits = np.zeros((4, 1, 2), np.int32)
+    with ExecutionService(cfg, max_batch_programs=4,
+                          max_wait_ms=50.0) as svc:
+        hs = svc.submit(faulty_strict, bits, fault_mode='strict')
+        hc = svc.submit(faulty_count, bits)
+        h1 = svc.submit(clean_a, bits)
+        h2 = svc.submit(clean_b, bits)
+        with pytest.raises(FaultError) as ei:
+            hs.result(timeout=300)
+        out_c = hc.result(timeout=300)
+        out_1 = h1.result(timeout=300)
+        out_2 = h2.result(timeout=300)
+        stats = svc.stats()
+    # strict+count normalize to the same bucket cfg -> ONE batch: the
+    # isolation below happened between batch-mates, not across batches
+    assert stats['dispatches'] == 1
+    assert stats['batch_occupancy'] == {4: 1}
+    assert stats['completed'] == 3 and stats['failed'] == 1
+    assert np.asarray(ei.value.counts)[0] == 4      # budget_exhausted x4
+    assert np.asarray(out_c['fault']).all()         # in-band counts
+    for out in (out_1, out_2):
+        assert not np.asarray(out['fault']).any()
+    _assert_same(out_1, _solo(clean_a, bits, cfg), 'clean-mate')
+
+
+def test_cancel_timeout_deadline():
+    mps = _ensemble(2, 2, 3, seed=11)
+    cfg = _cfg_for(mps)
+    bits = np.zeros((2, mps[0].n_cores, cfg.max_meas), np.int32)
+    # max_batch_programs never reached + long wait -> requests sit queued
+    with ExecutionService(cfg, max_batch_programs=64,
+                          max_wait_ms=60_000.0) as svc:
+        h_cancel = svc.submit(mps[0], bits)
+        h_wait = svc.submit(mps[1], bits)
+        h_dead = svc.submit(mps[2], bits, deadline_ms=80.0)
+        assert h_cancel.cancel()
+        assert h_cancel.cancelled() and h_cancel.done()
+        with pytest.raises(CancelledError):
+            h_cancel.result()
+        assert not h_cancel.cancel()        # second call lost
+        with pytest.raises(TimeoutError):
+            h_wait.result(timeout=0.05)
+        with pytest.raises(DeadlineError):
+            h_dead.result(timeout=30)       # dispatcher wakes at deadline
+        assert h_wait.cancel()
+        stats = svc.stats()
+        assert stats['cancelled'] >= 1 or stats['queue_depth'] >= 1
+        svc.shutdown(drain=False)
+    final = svc.stats()
+    assert final['expired'] == 1
+    # h_cancel is observed during pruning; h_wait's cancel may race the
+    # shutdown's queue clear, so the count is a lower bound
+    assert final['cancelled'] >= 1
+    assert final['completed'] == 0
+
+
+def test_queue_full_admission_then_drain():
+    mps = _ensemble(2, 2, 3, seed=12)
+    cfg = _cfg_for(mps)
+    bits = np.zeros((2, mps[0].n_cores, cfg.max_meas), np.int32)
+    svc = ExecutionService(cfg, max_batch_programs=64,
+                           max_wait_ms=60_000.0, max_queue=2)
+    try:
+        h0 = svc.submit(mps[0], bits)
+        h1 = svc.submit(mps[1], bits)
+        with pytest.raises(QueueFullError):
+            svc.submit(mps[2], bits)
+        svc.shutdown(drain=True, timeout=300)   # flushes the queue
+        _assert_same(h0.result(), _solo(mps[0], bits, cfg), 'drained0')
+        _assert_same(h1.result(), _solo(mps[1], bits, cfg), 'drained1')
+        stats = svc.stats()
+        assert stats['rejected'] == 1 and stats['completed'] == 2
+        with pytest.raises(ServiceClosedError):
+            svc.submit(mps[0], bits)
+    finally:
+        svc.shutdown()
+
+
+def test_shutdown_drain_under_load():
+    mps = _ensemble(2, 2, 6, seed=13)
+    cfg = _cfg_for(mps)
+    bits = np.zeros((3, mps[0].n_cores, cfg.max_meas), np.int32)
+    svc = ExecutionService(cfg, max_batch_programs=3, max_wait_ms=5.0)
+    handles = [svc.submit(mp, bits) for mp in mps]
+    svc.shutdown(drain=True, timeout=300)
+    for mp, h in zip(mps, handles):
+        assert h.done()
+        _assert_same(h.result(), _solo(mp, bits, cfg), 'drain')
+    assert svc.stats()['completed'] == len(mps)
+    assert not any(t.name.startswith('dproc-serve-dispatch')
+                   and t.is_alive() for t in threading.enumerate())
+
+
+def test_submit_rejects_unservable_cfgs():
+    mp = _ensemble(2, 2, 1, seed=14)[0]
+    with ExecutionService(max_wait_ms=1.0) as svc:
+        for bad in (dict(engine='straightline'), dict(engine='block'),
+                    dict(straightline=True),
+                    dict(opcode_histogram=True)):
+            with pytest.raises(ValueError):
+                svc.submit(mp, shots=2, cfg=InterpreterConfig(
+                    max_steps=64, max_meas=2, **bad))
+        with pytest.raises(ValueError):
+            svc.submit(mp)                   # neither meas_bits nor shots
+        with pytest.raises(ValueError):
+            svc.submit(mp, np.zeros((2, mp.n_cores + 3, 2), np.int32))
+
+
+def test_coalescer_priority_and_ripening():
+    """Batcher unit semantics, no threads: priority lanes order the
+    batch, count threshold and wait deadline both ripen a bucket."""
+    mp = _clean_mp()
+    cfg = InterpreterConfig(max_steps=64, max_meas=2)
+    key = bucket_key(mp, cfg)
+    bits = np.zeros((2, 1, 2), np.int32)
+
+    def req(seq, priority=0, deadline=None):
+        return Request(mp=mp, meas_bits=bits, init_regs=None, cfg=cfg,
+                       strict=False, n_shots=2, priority=priority,
+                       deadline=deadline, seq=seq)
+
+    co = Coalescer(max_batch_programs=2, max_wait_s=60.0)
+    for r in (req(0), req(1, priority=5), req(2)):
+        co.push(key, r)
+    assert len(co) == 3
+    k, batch, expired = co.pop_batch()      # 3 >= ... no: cap is 2
+    assert k == key and not expired
+    assert [r.seq for r in batch] == [1, 0]   # priority 5 first, FIFO next
+    # leftover bucket (1 request) is not ripe until the wait deadline
+    k2, batch2, _ = co.pop_batch()
+    assert k2 is None and len(co) == 1
+    assert 0 < co.next_event() <= 60.0
+    k3, batch3, _ = co.pop_batch(now=time.monotonic() + 61.0)
+    assert k3 == key and [r.seq for r in batch3] == [2]
+    # expired requests are failed during pruning, not dispatched
+    dead = req(3, deadline=time.monotonic() - 1.0)
+    co.push(key, dead)
+    k4, _, expired4 = co.pop_batch()
+    assert k4 is None and expired4 == [dead]
+    with pytest.raises(DeadlineError):
+        dead.handle.result()
+
+
+@pytest.mark.slow
+def test_concurrent_submitter_stress():
+    """8 submitter threads x 6 requests each against one service:
+    every result bit-identical to its solo run, counters consistent."""
+    mps = _ensemble(2, 2, 4, seed=15)
+    cfg = _cfg_for(mps)
+    rng = np.random.default_rng(4)
+    n_threads, per_thread = 8, 6
+    jobs = [[(mps[rng.integers(len(mps))],
+              rng.integers(0, 2, (int(rng.integers(2, 9)),
+                                  mps[0].n_cores, 2)).astype(np.int32))
+             for _ in range(per_thread)] for _ in range(n_threads)]
+    results = [[None] * per_thread for _ in range(n_threads)]
+    errors = []
+    with ExecutionService(cfg, max_batch_programs=8,
+                          max_wait_ms=5.0, max_queue=512) as svc:
+        def worker(tid):
+            try:
+                hs = [svc.submit(mp, bits) for mp, bits in jobs[tid]]
+                for j, h in enumerate(hs):
+                    results[tid][j] = h.result(timeout=600)
+            except Exception as e:      # pragma: no cover - surfaced below
+                errors.append((tid, e))
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        stats = svc.stats()
+    assert not errors, errors
+    assert stats['submitted'] == n_threads * per_thread
+    assert stats['completed'] == n_threads * per_thread
+    assert stats['coalesce_efficiency'] >= 1.0
+    assert stats['latency_samples'] == n_threads * per_thread
+    solo_cache = {}
+    for tid in range(n_threads):
+        for (mp, bits), got in zip(jobs[tid], results[tid]):
+            ck = (id(mp), bits.shape[0], bits.tobytes())
+            if ck not in solo_cache:
+                solo_cache[ck] = _solo(mp, bits, cfg)
+            _assert_same(got, solo_cache[ck], f't{tid}')

@@ -17,6 +17,12 @@ import xml.etree.ElementTree as ET
 # may trip the trap machinery
 FAULT_MARK = 'fault summary (trapped shots'
 
+# the marker tests/conftest.py's autouse probe prints when an execution
+# service dispatcher thread (serve/) survives a test: a green testcase
+# carrying it left a live thread behind — services must be shut down
+# (no exemptions: even serve tests may not leak their dispatchers)
+LEAK_MARK = 'SERVICE THREAD LEAK'
+
 
 def _is_fault_test(tc) -> bool:
     ident = f'{tc.get("classname", "")}.{tc.get("name", "")}'.lower()
@@ -36,20 +42,30 @@ def main(path: str) -> int:
     if n_tests == 0:
         print('FAILURE: no tests ran')
         return 1
-    leaks = []
+    leaks, thread_leaks = [], []
     for tc in root.iter('testcase'):
-        if _is_fault_test(tc):
-            continue
+        ident = f'{tc.get("classname")}.{tc.get("name")}'
         for out in (tc.findall('system-out') + tc.findall('system-err')):
-            if out.text and FAULT_MARK in out.text:
-                leaks.append(f'{tc.get("classname")}.{tc.get("name")}')
-                break
+            if not out.text:
+                continue
+            if FAULT_MARK in out.text and not _is_fault_test(tc) \
+                    and ident not in leaks:
+                leaks.append(ident)
+            if LEAK_MARK in out.text and ident not in thread_leaks:
+                thread_leaks.append(ident)
     if leaks:
         for name in leaks:
             print(f'FAULT LEAK: {name}: nonzero fault_shots from a '
                   f'non-fault-injection test (see docs/ROBUSTNESS.md)')
+    if thread_leaks:
+        for name in thread_leaks:
+            print(f'THREAD LEAK: {name}: execution-service dispatcher '
+                  f'thread survived the test (shut the service down — '
+                  f'see docs/SERVING.md)')
+    if leaks or thread_leaks:
         return 1
-    print(f'junit OK: {n_tests} tests, no failures, no fault leaks')
+    print(f'junit OK: {n_tests} tests, no failures, no fault leaks, '
+          f'no leaked service threads')
     return 0
 
 
