@@ -299,14 +299,19 @@ def engine_ladder(n_qubits: int, depth: int, batch: int = 256):
     """Engine-ladder row (docs/PERF.md "The engine ladder"): outer-loop
     iteration counts and warm per-batch times for the generic
     fetch-dispatch engine vs the block engine (CFG superinstructions
-    between branch points) on the depth-``depth`` active-reset RB
-    program — the workload whose active-reset feedback loop is
-    straight-line-INeligible but whose RB body is one giant block.
-    Iteration counts are exact ('steps' counts while_loop trips), so
-    the reduction ratio is backend-independent; times are medians of 3
-    warmed host-synced batches per engine."""
+    between branch points) vs the pallas megastep engine (each block
+    body one kernel call, carry resident in VMEM) on the
+    depth-``depth`` active-reset RB program — the workload whose
+    active-reset feedback loop is straight-line-INeligible but whose
+    RB body is one giant block.  Iteration counts are exact ('steps'
+    counts while_loop trips), so the reduction ratio is
+    backend-independent; times are medians of 3 warmed host-synced
+    batches per engine.  An engine the backend/program cannot run
+    records ``{'ineligible': reason}`` (off-TPU the pallas rung runs
+    under the kernel interpreter — correct but slow; the degraded
+    rerun exercises exactly that path)."""
     from distributed_processor_tpu.sim.interpreter import (
-        _block_plan, _soa_static, simulate_batch)
+        _block_plan, _soa_static, resolve_engine, simulate_batch)
     mp = build_machine_program(n_qubits, depth)
     _, bodies = _block_plan(_soa_static(mp))
     rng = np.random.default_rng(5)
@@ -315,11 +320,16 @@ def engine_ladder(n_qubits: int, depth: int, batch: int = 256):
     out = {'n_qubits': n_qubits, 'depth': depth, 'batch': batch,
            'n_instr': mp.n_instr, 'n_blocks': len(bodies),
            'unrolled_rows': sum(L for _, L in bodies)}
-    for eng in ('generic', 'block'):
+    for eng in ('generic', 'block', 'pallas'):
         cfg = InterpreterConfig(
             max_steps=2 * mp.n_instr + 64,
             max_pulses=int(mp.max_pulses_per_core(1)) + 4,
             max_meas=2, max_resets=2, record_pulses=False, engine=eng)
+        try:
+            resolve_engine(mp, cfg)
+        except ValueError as e:
+            out[eng] = {'ineligible': str(e)[:200]}
+            continue
         t0 = time.perf_counter()
         r = simulate_batch(mp, bits, cfg=cfg)
         steps = int(jax.block_until_ready(r['steps']))
@@ -338,9 +348,11 @@ def engine_ladder(n_qubits: int, depth: int, batch: int = 256):
                     'warm_batch_s': round(sorted(ts)[1], 4)}
     out['iteration_reduction'] = round(
         out['generic']['iterations'] / out['block']['iterations'], 1)
-    out['note'] = ('same injected-bits batch both engines; iterations '
+    out['note'] = ('same injected-bits batch all engines; iterations '
                    'are while_loop trips (exact), reduction holds on '
-                   'any backend')
+                   'any backend; pallas runs whole spans as single '
+                   'kernel calls (span mode) or rides the block '
+                   'iteration structure with each body as one kernel')
     return out
 
 
@@ -839,7 +851,11 @@ def _degraded_rerun(attempts):
                  ('BENCH_MULTI_SEQS', '4'), ('BENCH_MULTI_SHOTS', '256'),
                  ('BENCH_SWEEP_SHOTS', '8192'), ('BENCH_SWEEP_BATCH', '1024'),
                  ('BENCH_SWEEP_SPAN', '4'), ('BENCH_LADDER_DEPTH', '12'),
-                 ('BENCH_SERVE_REQS', '8'), ('BENCH_SERVE_SHOTS', '16')):
+                 ('BENCH_SERVE_REQS', '8'), ('BENCH_SERVE_SHOTS', '16'),
+                 # exec_profile row under the kernel interpreter: tiny
+                 # batches, one rep — the (a, b) fit is still real
+                 ('PROFILE_BATCHES', '64,128,256'),
+                 ('PROFILE_REPS', '1')):
         env.setdefault(k, v)
     print('preflight failed on the accelerator backend; rerunning the '
           'bench DEGRADED on CPU (JAX_PLATFORMS=cpu)', file=sys.stderr)
@@ -1240,6 +1256,35 @@ def main():
     else:
         ladder = None
     artifact.row('engine_ladder', ladder)
+    # exec-profile row: the per-engine (a, b) overhead decomposition
+    # (tools/exec_profile.py decompose_engines) — the measured claim
+    # that the pallas megastep deletes fixed per-step cost a.  Knobs
+    # PROFILE_BATCHES / PROFILE_REPS / PROFILE_ENGINES match the
+    # standalone tool; the degraded rerun shrinks them so the fit runs
+    # under the kernel interpreter in seconds.  BENCH_EXEC_PROFILE=0
+    # skips it.
+    if secondaries and os.environ.get('BENCH_EXEC_PROFILE', '1') != '0':
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), 'tools'))
+            from exec_profile import (DEFAULT_BATCHES, DEFAULT_ENGINES,
+                                      decompose_engines)
+            profile_row = _timed_row(lambda: decompose_engines(
+                n_qubits, depth,
+                batches=[int(x) for x in os.environ.get(
+                    'PROFILE_BATCHES',
+                    ','.join(map(str, DEFAULT_BATCHES))).split(',')],
+                reps=int(os.environ.get('PROFILE_REPS', 3)),
+                engines=tuple(os.environ.get(
+                    'PROFILE_ENGINES',
+                    ','.join(DEFAULT_ENGINES)).split(','))))
+        except _RowTimeout as e:
+            profile_row = {'error': 'timeout', 'detail': str(e)}
+        except Exception as e:  # pragma: no cover - defensive
+            profile_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+    else:
+        profile_row = None
+    artifact.row('exec_profile', profile_row)
     # continuous-batching row: N concurrent single-program service
     # submissions (coalesced into shape-bucketed multi dispatches) vs N
     # sequential per-program simulate_batch calls, both warm, results
@@ -1300,6 +1345,7 @@ def main():
             'multi_sequence_rb': multi_rb,
             'sweep_span': sweep_span,
             'engine_ladder': ladder,
+            'exec_profile': profile_row,
             'continuous_batching': serve_row,
             'preflight': preflight,
             'utilization': utilization,

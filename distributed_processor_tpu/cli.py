@@ -453,15 +453,17 @@ def main(argv=None):
                    help='statevec + --leak-iq: 3-class nearest-centroid '
                         'discrimination; reports per-core class-2 rates')
     p.add_argument('--engine',
-                   choices=('auto', 'generic', 'block', 'straightline'),
+                   choices=('auto', 'generic', 'block', 'straightline',
+                            'pallas'),
                    default=None,
                    help='interpreter engine ladder (docs/PERF.md "Engine '
-                        'ladder"): auto picks straightline for small '
-                        'branch-free programs, else block '
-                        '(CFG-superinstruction) when eligible, else '
-                        'generic fetch-dispatch; block/straightline '
-                        'raise with the reason when ineligible '
-                        '(default: generic)')
+                        'ladder"): auto picks the pallas megastep '
+                        'kernel on TPU backends when eligible, else '
+                        'straightline for small branch-free programs, '
+                        'else block (CFG-superinstruction) when '
+                        'eligible, else generic fetch-dispatch; '
+                        'pallas/block/straightline raise with the '
+                        'reason when ineligible (default: generic)')
     p.add_argument('--strict-faults', action='store_true',
                    help='exit nonzero (status 2) if any shot trapped a '
                         'runtime fault (budget exhaustion, record '
@@ -518,7 +520,8 @@ def main(argv=None):
     p.add_argument('--depol', type=float, default=0.0,
                    help='bloch/statevec: 1q depolarization per pulse')
     p.add_argument('--engine',
-                   choices=('auto', 'generic', 'block', 'straightline'),
+                   choices=('auto', 'generic', 'block', 'straightline',
+                            'pallas'),
                    default=None,
                    help='interpreter engine ladder (see `run --help`); '
                         'the chosen engine is reported in the result '

@@ -1,0 +1,46 @@
+"""Shared Pallas plumbing for every kernel in :mod:`..ops`.
+
+Three things used to be copy-pasted between ``resolve_pallas.py``,
+``waveform_pallas.py``, ``demod.py`` (and now ``exec_pallas.py``):
+
+* the guarded ``jax.experimental.pallas`` import (:data:`HAS_PALLAS`,
+  with ``pl`` / ``pltpu`` re-exported so kernels import one module);
+* the interpret-mode NORMALIZATION: ``interpret=True`` becomes
+  ``pltpu.InterpretParams()`` where this jax ships it — the TPU
+  interpreter simulates VMEM/SMEM + grid pipelining on CPU, and on
+  those versions plain ``interpret=True`` has no lowering for SMEM
+  scalars in some mosaic primitives.  On older jax (no
+  ``InterpretParams``) ``True`` passes through to the generic pallas
+  interpreter, which handles every construct these kernels use;
+* the interpret-mode DEFAULT: kernels compile on TPU backends and fall
+  back to the interpreter everywhere else (:func:`default_interpret`),
+  so tier-1 CPU runs exercise the same kernel code paths.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAS_PALLAS = True
+except ImportError:      # pragma: no cover - pallas ships with jax
+    pl = None
+    pltpu = None
+    HAS_PALLAS = False
+
+
+def default_interpret() -> bool:
+    """Whether a Pallas kernel dispatched NOW should run under the
+    interpreter: only a real TPU backend lowers mosaic kernels."""
+    return jax.default_backend() != 'tpu'
+
+
+def normalize_interpret(interpret):
+    """Map ``interpret=True`` to ``pltpu.InterpretParams()`` (the TPU
+    interpreter) when this jax provides it; ``False`` / an explicit
+    params object / ``True`` on older jax pass through."""
+    if interpret is True and hasattr(pltpu, 'InterpretParams'):
+        return pltpu.InterpretParams()
+    return interpret

@@ -29,11 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-try:
-    from jax.experimental import pallas as pl
-    _HAS_PALLAS = True
-except ImportError:      # pragma: no cover
-    _HAS_PALLAS = False
+from ._pallas_common import HAS_PALLAS as _HAS_PALLAS, pl
 
 
 def _as_iq_centers(c):

@@ -1,0 +1,184 @@
+"""Pallas TPU "megastep" execution kernel: one custom call per span.
+
+docs/PERF.md's overhead decomposition (``t/step = a + b*B``) pins the
+batched interpreter at a ≈ 5.3-5.8 ms of per-step FIXED cost — dozens
+of small VPU kernels per instruction step, each round-tripping the
+``[B, C]`` lane carry through HBM.  This module removes the
+round-trips: the whole per-shot machine state (registers, clocks,
+measurement slots, pulse params, fault word — ~1.6 KB/shot) is loaded
+into VMEM ONCE, a straight-line span of K instructions is applied as an
+in-kernel loop specialized on the trace-time instruction stream, and
+the carry is stored once — K × (dozens of kernels + HBM traffic)
+becomes one launch.  It is the TPU analogue of the reference's
+``proc.sv`` stepping its instruction loop without ever leaving the
+core (PAPER.md's north star).
+
+Layering: this module owns NO instruction semantics.  The interpreter
+(:mod:`..sim.interpreter`) passes its per-instruction apply functions
+in as a traced ``body`` callable, so the kernel computes bit-for-bit
+the same int32 arithmetic as the XLA engines by construction — and
+``ops`` never imports ``sim`` (``sim.physics`` already imports
+``ops``; the dependency must stay one-way).
+
+The state keeps its host layout ``[tile_b, C, ...]`` inside the kernel
+(shot tile on sublanes).  That is lane-inefficient for small core
+counts on a real TPU — a lane-major ``[C, 1, B]`` relayout like
+``resolve_pallas.py``'s is the obvious next lever — but it is correct
+on every backend and already deletes the per-instruction fixed cost,
+which is what the decomposition says dominates.
+
+CPU fallback follows the idiom proven in ``resolve_pallas.py`` /
+``waveform_pallas.py``: ``interpret=True`` runs the kernel under
+``pltpu.InterpretParams()`` (see :mod:`._pallas_common`), which is how
+tier-1 CPU tests exercise this code path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._pallas_common import HAS_PALLAS, pl, normalize_interpret
+
+# VMEM budget for one resident state tile (input + output double-count
+# is absorbed by the factor-2 headroom in _pick_tile's doubling test);
+# v5e has 128 MB of VMEM per core, so 2 MB leaves the pipeliner room
+_TILE_VMEM_BYTES = 2 << 20
+
+
+def _per_shot_bytes(shapes) -> int:
+    """Bytes one shot lane contributes across all ``[B, ...]`` leaves
+    (every carry is a 4-byte int32/bool-as-int32)."""
+    return sum(4 * int(np.prod(s[1:], dtype=np.int64)) for s in shapes)
+
+
+def _pick_tile(B: int, per_shot: int) -> int:
+    """Largest power-of-two shot tile within the VMEM budget; the whole
+    batch rides one tile (grid of 1, no padding) when it fits."""
+    if B * per_shot <= _TILE_VMEM_BYTES:
+        return B
+    tb = 1
+    while 2 * tb * per_shot <= _TILE_VMEM_BYTES:
+        tb *= 2
+    return tb
+
+
+def span_call(state: dict, consts: dict, shared: dict, body, *,
+              interpret):
+    """Run ``body(state, consts, shared) -> state`` as ONE pallas call
+    over shot tiles of the leading batch axis.
+
+    ``state``: the mutable machine-state dict — every leaf ``[B, ...]``
+    int32 (or bool, converted to int32 at the kernel boundary and back).
+    ``consts``: read-only int32 inputs tiled alongside the state (the
+    injected ``meas_bits``, a block engine's lane-activity mask).
+    ``shared``: small read-only arrays every tile loads whole (the
+    per-core ``spc`` / ``interp`` element constants).  ``body`` must be
+    a pure jnp function of those three dicts (the interpreter's
+    specialized instruction loop).
+
+    When ``B`` is not a tile multiple, the batch is padded by
+    REPLICATING real shot rows (``arange(B_pad) % B`` — the same inert
+    clone-lane trick the serving tier uses): execution is deterministic
+    per lane, so replicas retire identically and slicing them back off
+    is exact.
+    """
+    if not HAS_PALLAS:   # pragma: no cover - pallas ships with jax
+        raise RuntimeError("jax.experimental.pallas unavailable; use "
+                           "engine='generic'")
+    skeys = sorted(state)
+    ckeys = sorted(consts)
+    hkeys = sorted(shared)
+    bools = frozenset(k for k in skeys if state[k].dtype == jnp.bool_)
+    B = state[skeys[0]].shape[0]
+    tb = _pick_tile(B, _per_shot_bytes(
+        [state[k].shape for k in skeys]
+        + [consts[k].shape for k in ckeys]))
+    b_pad = -(-B // tb) * tb
+    if b_pad != B:
+        rep = jnp.arange(b_pad, dtype=jnp.int32) % B
+        pad = lambda a: jnp.take(a, rep, axis=0)
+    else:
+        pad = lambda a: a
+
+    consts = {k: jnp.asarray(consts[k], jnp.int32) for k in ckeys}
+    shared = {k: jnp.asarray(shared[k]) for k in hkeys}
+    ins = [pad(state[k].astype(jnp.int32) if k in bools else state[k])
+           for k in skeys]
+    ins += [pad(consts[k]) for k in ckeys]
+    ins += [shared[k] for k in hkeys]
+
+    # the body closes over its instruction stream as numpy-derived
+    # constants; pallas forbids non-scalar constants inside a kernel
+    # jaxpr, so trace the body ONCE here, lift the jaxpr's consts into
+    # explicit kernel inputs (bools and scalars re-packed as >=1-D
+    # int32 at the boundary), and replay the jaxpr inside the kernel
+    ex_args = (
+        {k: jax.ShapeDtypeStruct((tb,) + tuple(state[k].shape[1:]),
+                                 state[k].dtype) for k in skeys},
+        {k: jax.ShapeDtypeStruct((tb,) + tuple(consts[k].shape[1:]),
+                                 jnp.int32) for k in ckeys},
+        {k: jax.ShapeDtypeStruct(shared[k].shape, shared[k].dtype)
+         for k in hkeys})
+    flat_ex, in_tree = jax.tree.flatten(ex_args)
+    out_trees = []
+
+    def body_flat(*flat):
+        s, c, h = jax.tree.unflatten(in_tree, flat)
+        leaves, tree = jax.tree.flatten(body(s, c, h))
+        out_trees.append(tree)
+        return leaves
+
+    closed = jax.make_jaxpr(body_flat)(*flat_ex)
+    out_tree = out_trees[0]
+    hmeta = []
+    for c in closed.consts:
+        c = jnp.asarray(c)
+        hb = c.dtype == jnp.bool_
+        hmeta.append((hb, c.shape))
+        a = c.astype(jnp.int32) if hb else c
+        ins.append(a.reshape(1) if a.ndim == 0 else a)
+
+    def tile_spec(shape):
+        nz = len(shape) - 1
+        return pl.BlockSpec((tb,) + tuple(shape[1:]),
+                            lambda t, _nz=nz: (t,) + (0,) * _nz)
+
+    def full_spec(shape):
+        nd = len(shape)
+        return pl.BlockSpec(tuple(shape),
+                            lambda t, _nd=nd: (0,) * _nd)
+
+    n_s, n_c, n_h = len(skeys), len(ckeys), len(hkeys)
+    n_in = n_s + n_c + n_h + len(hmeta)
+
+    def kernel(*refs):
+        inr, outr = refs[:n_in], refs[n_in:]
+        st = {k: ((r[...] != 0) if k in bools else r[...])
+              for k, r in zip(skeys, inr[:n_s])}
+        cc = {k: r[...] for k, r in zip(ckeys, inr[n_s:n_s + n_c])}
+        hh = {k: r[...] for k, r in zip(hkeys, inr[n_s + n_c:
+                                                   n_s + n_c + n_h])}
+        extras = [r[...].reshape(sh).astype(jnp.bool_) if hb
+                  else r[...].reshape(sh)
+                  for (hb, sh), r in zip(hmeta, inr[n_s + n_c + n_h:])]
+        res = jax.core.eval_jaxpr(closed.jaxpr, extras,
+                                  *jax.tree.leaves((st, cc, hh)))
+        st = jax.tree.unflatten(out_tree, res)
+        for k, r in zip(skeys, outr):
+            r[...] = st[k].astype(jnp.int32) if k in bools else st[k]
+
+    outs = pl.pallas_call(
+        kernel,
+        grid=(b_pad // tb,),
+        in_specs=[tile_spec(a.shape) for a in ins[:n_s + n_c]]
+        + [full_spec(a.shape) for a in ins[n_s + n_c:]],
+        out_specs=[tile_spec(state[k].shape) for k in skeys],
+        out_shape=[jax.ShapeDtypeStruct(
+            (b_pad,) + tuple(state[k].shape[1:]), jnp.int32)
+            for k in skeys],
+        interpret=normalize_interpret(interpret),
+    )(*ins)
+    return {k: ((v[:B] != 0) if k in bools else v[:B])
+            for k, v in zip(skeys, outs)}

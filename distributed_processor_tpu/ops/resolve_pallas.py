@@ -54,12 +54,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-try:
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    _HAS_PALLAS = True
-except ImportError:      # pragma: no cover - pallas ships with jax
-    _HAS_PALLAS = False
+from ._pallas_common import (HAS_PALLAS as _HAS_PALLAS, pl, pltpu,
+                             normalize_interpret)
 
 
 def _kernel(amp_ref, cosa_ref, sina_ref, gsi_ref, gsq_ref,
@@ -192,11 +188,9 @@ def _resolve_call(amp, cosa, sina, gs_i, gs_q, f_idx, addr, nsamp,
     n_chunks = w_pad // ck
     R = t_dac.shape[2]
     F = basis.shape[2]
-    if interpret:
-        # TPU interpret mode simulates VMEM/SMEM + grid pipelining on
-        # CPU (plain interpret=True has no lowering for SMEM scalars in
-        # some mosaic primitives); the kernel itself is backend-pure
-        interpret = pltpu.InterpretParams()
+    # True -> pltpu.InterpretParams() (see ops/_pallas_common.py); the
+    # kernel itself is backend-pure
+    interpret = normalize_interpret(interpret)
     lane_spec = pl.BlockSpec((1, 1, tb), lambda c, t: (c, 0, t))
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     call = pl.pallas_call(

@@ -3,9 +3,11 @@
 guarantee can't silently diverge.
 
 The reference implementations being checked against are the pure-XLA
-:func:`.demod.demod_iq` and :func:`.waveform.synthesize_element`; the
-kernels are :func:`.demod.demod_iq_pallas` and
-:func:`.waveform_pallas.synthesize_element_pallas`.
+:func:`.demod.demod_iq` and :func:`.waveform.synthesize_element`, and
+the generic interpreter engine; the kernels are
+:func:`.demod.demod_iq_pallas`,
+:func:`.waveform_pallas.synthesize_element_pallas`, and the
+:mod:`.exec_pallas` megastep engine (``engine='pallas'``).
 """
 
 from __future__ import annotations
@@ -51,7 +53,55 @@ def check_waveform_parity(interpret: bool):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def check_exec_parity(interpret: bool):
+    """Megastep exec kernel vs the generic engine; raises on mismatch.
+
+    Exact int32 equality on every retired stat (records, registers,
+    clocks, fault word), in both kernel modes: a forward-only program
+    (one span call) and a counted loop (block path, kernels inside the
+    outer while_loop).
+    """
+    # deferred import: ops stays import-time independent of sim
+    # (sim.physics imports ops); by selftest call time both are loaded
+    from .. import isa
+    from ..decoder import machine_program_from_cmds
+    from ..sim.interpreter import InterpreterConfig, simulate_batch
+
+    span = [[isa.pulse_cmd(amp_word=1000, cfg_word=0,
+                           env_word=(8 << 12) | 3, cmd_time=10),
+             isa.alu_cmd('reg_alu', 'i', 5, 'add', alu_in1=1,
+                         write_reg_addr=1),
+             isa.pulse_cmd(amp_word=2000, cfg_word=2,
+                           env_word=(4 << 12) | 1, cmd_time=40),
+             isa.done_cmd()]]
+    loop = [[isa.alu_cmd('reg_alu', 'i', 0, 'add', write_reg_addr=2),
+             isa.pulse_cmd(amp_word=500, cfg_word=1,
+                           env_word=(4 << 12) | 2, cmd_time=12),
+             isa.alu_cmd('reg_alu', 'i', 1, 'add', alu_in1=2,
+                         write_reg_addr=2),
+             isa.alu_cmd('jump_cond', 'i', 3, 'ge', alu_in1=2,
+                         jump_cmd_ptr=1),
+             isa.done_cmd()]]
+    rng = np.random.default_rng(2)
+    for cmds in (span, loop):
+        mp = machine_program_from_cmds(cmds)
+        kw = dict(max_steps=2 * mp.n_instr + 64, max_pulses=8,
+                  max_meas=2, max_resets=2)
+        bits = rng.integers(0, 2, size=(4, mp.n_cores, 2))
+        want = simulate_batch(mp, bits,
+                              cfg=InterpreterConfig(engine='generic',
+                                                    **kw))
+        got = simulate_batch(mp, bits, cfg=InterpreterConfig(
+            engine='pallas', pallas_interpret=interpret, **kw))
+        for k in want:
+            if k == 'steps':
+                continue
+            np.testing.assert_array_equal(np.asarray(got[k]),
+                                          np.asarray(want[k]), err_msg=k)
+
+
 def pallas_parity_check(interpret: bool) -> None:
-    """Run both kernel parity checks; raises AssertionError on mismatch."""
+    """Run every kernel parity check; raises AssertionError on mismatch."""
     check_demod_parity(interpret)
     check_waveform_parity(interpret)
+    check_exec_parity(interpret)

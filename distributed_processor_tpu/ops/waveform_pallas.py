@@ -29,12 +29,7 @@ import jax.numpy as jnp
 
 from ..elements import ENV_CW_SENTINEL
 
-try:
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    _HAS_PALLAS = True
-except ImportError:      # pragma: no cover
-    _HAS_PALLAS = False
+from ._pallas_common import HAS_PALLAS as _HAS_PALLAS, pl, pltpu
 
 _TWO_PI_OVER_2_32 = float(2 * np.pi / 2 ** 32)
 

@@ -48,9 +48,10 @@ import jax
 
 from .. import isa
 from ..decoder import stack_machine_programs
-from ..sim.interpreter import (InterpreterConfig, FaultError,
+from ..sim.interpreter import (ENGINES, InterpreterConfig, FaultError,
                                demux_multi_batch, fault_shot_counts,
-                               simulate_batch, simulate_multi_batch)
+                               resolve_engine, simulate_batch,
+                               simulate_multi_batch)
 from ..utils import profiling
 from .batcher import Coalescer, bucket_key
 from .request import (CancelledError, QueueFullError, Request,
@@ -76,12 +77,15 @@ def _normalize_cfg(cfg: InterpreterConfig, n_instr_bucket: int):
     if cfg is None:
         cfg = InterpreterConfig(max_steps=2 * n_instr_bucket + 64,
                                 max_pulses=n_instr_bucket + 2)
-    if cfg.straightline or cfg.engine in ('straightline', 'block'):
+    if cfg.straightline or cfg.engine in ('straightline', 'block',
+                                          'pallas'):
         raise ValueError(
             'the execution service coalesces onto the multi-program '
-            'generic engine; straightline/block engines key on program '
-            'content and cannot serve a shared batch (use '
-            'singleton_engine= for 1-program fallback dispatch)')
+            'generic engine; of the engine ladder (auto / generic / '
+            'block / straightline / pallas) the straightline, block '
+            'and pallas engines key on program content and cannot '
+            'serve a shared batch (use singleton_engine= for '
+            '1-program fallback dispatch)')
     if cfg.opcode_histogram:
         raise ValueError(
             'opcode_histogram=True cannot be served: op_hist is summed '
@@ -130,9 +134,9 @@ class ExecutionService:
         ``submit`` raises :class:`QueueFullError` beyond it.
     singleton_engine:
         Optional engine selector ('auto' / 'straightline' / 'block' /
-        'generic') for batches that end up with a single program: those
-        gain nothing from the multi path, so they may ride
-        :func:`simulate_batch` and the PR 3 engine ladder instead.
+        'pallas' / 'generic') for batches that end up with a single
+        program: those gain nothing from the multi path, so they may
+        ride :func:`simulate_batch` and the full engine ladder instead.
         Default None keeps everything on the one shared multi-program
         cache (the right call for compile-bound fleets).
     """
@@ -145,6 +149,10 @@ class ExecutionService:
             raise ValueError('max_batch_programs must be >= 1')
         if max_queue < 1:
             raise ValueError('max_queue must be >= 1')
+        if singleton_engine is not None and singleton_engine not in ENGINES:
+            raise ValueError(
+                f'singleton_engine must be one of {ENGINES} or None; '
+                f'got {singleton_engine!r}')
         self._default_cfg = cfg
         self.max_queue = max_queue
         self.singleton_engine = singleton_engine
@@ -164,6 +172,7 @@ class ExecutionService:
         self._dispatches = 0
         self._programs_dispatched = 0
         self._occupancy = collections.Counter()   # batch size -> count
+        self._engine_dispatches = collections.Counter()  # engine -> count
         self._latency_s = collections.deque(maxlen=4096)
         self._thread = threading.Thread(
             target=self._dispatch_loop,
@@ -327,9 +336,10 @@ class ExecutionService:
         in batch order (host numpy, padding trimmed)."""
         if len(batch) == 1 and self.singleton_engine is not None:
             req = batch[0]
-            out = simulate_batch(
-                req.mp, req.meas_bits, req.init_regs,
-                cfg=replace(cfg, engine=self.singleton_engine))
+            scfg = replace(cfg, engine=self.singleton_engine)
+            self._count_engine(resolve_engine(req.mp, scfg))
+            out = simulate_batch(req.mp, req.meas_bits, req.init_regs,
+                                 cfg=scfg)
             return [jax.tree.map(np.asarray, out)]
         B = max(r.n_shots for r in batch)
         meas = np.stack([_pad_shots(r.meas_bits, B) for r in batch])
@@ -342,10 +352,19 @@ class ExecutionService:
             init = None
         mmp = stack_machine_programs([r.mp for r in batch],
                                      pad_to=key_bucket(batch))
+        self._count_engine('generic')
         out = simulate_multi_batch(mmp, meas, init, cfg=cfg)
         host = jax.tree.map(np.asarray, out)
         return [demux_multi_batch(host, i, n_shots=r.n_shots)
                 for i, r in enumerate(batch)]
+
+    def _count_engine(self, eng: str):
+        """Record which ladder rung a dispatch actually ran on (the
+        multi path is generic by construction; the singleton path
+        resolves 'auto' the same way ``simulate_batch`` will)."""
+        with self._cv:
+            self._engine_dispatches[eng] += 1
+        profiling.counter_inc(f'serve.engine.{eng}')
 
     # -- introspection / lifecycle ---------------------------------------
 
@@ -367,6 +386,8 @@ class ExecutionService:
                 'dispatches': self._dispatches,
                 'programs_dispatched': self._programs_dispatched,
                 'batch_occupancy': occ,
+                'engine_dispatches': dict(sorted(
+                    self._engine_dispatches.items())),
                 'coalesce_efficiency': (
                     self._programs_dispatched / self._dispatches
                     if self._dispatches else 0.0),

@@ -238,15 +238,24 @@ class InterpreterConfig:
     straightline: bool = False
     # engine ladder selector (resolve_engine): None (default) keeps the
     # legacy ``straightline`` tri-state semantics above; 'generic' /
-    # 'straightline' / 'block' force an engine ('straightline' and
-    # 'block' raise with the reason when the program is ineligible);
-    # 'auto' walks the ladder — straightline if eligible and small
-    # enough to unroll, else block if eligible and the deduped body
-    # total is under BLOCK_AUTO_MAX_UNROLL, else generic.  The block
-    # engine (:func:`_exec_blocks`) keys the jit cache on program
-    # CONTENT like the straight-line engine, so compile-bound workloads
+    # 'straightline' / 'block' / 'pallas' force an engine (the
+    # specialized engines raise with the reason when the program is
+    # ineligible); 'auto' walks the ladder — pallas first on TPU
+    # backends where eligible (the megastep kernel keeps the lane carry
+    # in VMEM across a whole span — ops/exec_pallas.py), else
+    # straightline if eligible and small enough to unroll, else block
+    # if eligible and the deduped body total is under
+    # BLOCK_AUTO_MAX_UNROLL, else generic.  The specialized engines key
+    # the jit cache on program CONTENT, so compile-bound workloads
     # should stay on 'generic'.
     engine: str = None
+    # engine='pallas' interpret override: None (default) compiles the
+    # megastep kernel on TPU backends and runs it under the Pallas TPU
+    # interpreter elsewhere (ops/_pallas_common.default_interpret);
+    # True/False force the choice (ops/selftest.py pins compiled-kernel
+    # parity on the bench host with interpret=False; tier-1 CPU tests
+    # ride the default).
+    pallas_interpret: bool = None
     # per-opcode executed-instruction histogram: adds an
     # ``op_hist[N_KINDS]`` output counting retired instructions per
     # kind (summed over shots and cores).  Engine-invariant — the same
@@ -284,8 +293,13 @@ class InterpreterConfig:
 
 
 def _onehot(idx, n: int) -> jnp.ndarray:
-    """``[...] -> [..., n]`` int32 one-hot (TPU-friendly select mask)."""
-    return (idx[..., None] == jnp.arange(n, dtype=jnp.int32)).astype(jnp.int32)
+    """``[...] -> [..., n]`` int32 one-hot (TPU-friendly select mask).
+
+    Built with ``broadcasted_iota`` rather than ``jnp.arange`` so the
+    same code traces inside a Pallas kernel body (mosaic has no
+    lowering for 1-D iota) — values are identical either way."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, idx.shape + (n,), idx.ndim)
+    return (idx[..., None] == iota).astype(jnp.int32)
 
 
 def _ohsel(table, oh):
@@ -1505,27 +1519,35 @@ def straightline_ineligible(mp, cfg: InterpreterConfig) -> str:
     (loops, LUT/fresh fabrics, cross-core feedback, the statevec event
     gate, trace mode) runs on the generic fetch-dispatch engine.
     """
-    kind = np.asarray(mp.soa.kind)
-    C, N = kind.shape
     if cfg.trace:
         return 'trace mode records per-step state'
     if cfg.physics and cfg.device == 'statevec':
         return 'statevec device (event-ordering gate needs the ' \
                'generic engine)'
+    return _sl_ineligible_fields(np.asarray(mp.soa.kind),
+                                 np.asarray(mp.soa.jump_addr),
+                                 np.asarray(mp.soa.func_id), cfg)
+
+
+def _sl_ineligible_fields(kind, jump_addr, func_id,
+                          cfg: InterpreterConfig) -> str:
+    """The straight-line SHAPE checks of :func:`straightline_ineligible`
+    on packed field arrays — shared with the pallas dispatch, which
+    re-derives span-vs-block mode from the jit-static program
+    (:func:`_pallas_mode`) so the two decisions cannot drift."""
+    C, N = kind.shape
     if np.any(kind == isa.K_SYNC):
         return 'SYNC barrier'
     idx = np.arange(N)[None, :]
     jmask = (kind == isa.K_JUMP_I) | (kind == isa.K_JUMP_COND) \
         | (kind == isa.K_JUMP_FPROC)
-    if np.any(jmask & (np.asarray(mp.soa.jump_addr) <= idx)):
+    if np.any(jmask & (jump_addr <= idx)):
         return 'backward jump (loop)'
     fmask = (kind == isa.K_ALU_FPROC) | (kind == isa.K_JUMP_FPROC)
     if np.any(fmask):
         if cfg.fabric != 'sticky':
             return f'fabric {cfg.fabric!r} with fproc reads'
-        if np.any(fmask
-                  & (np.asarray(mp.soa.func_id)
-                     != np.arange(C)[:, None])):
+        if np.any(fmask & (func_id != np.arange(C)[:, None])):
             return 'cross-core fproc read'
     if np.any(kind[:, -1] != isa.K_DONE):
         return 'program not DONE-terminated'
@@ -1538,7 +1560,13 @@ def straightline_ineligible(mp, cfg: InterpreterConfig) -> str:
 # sum — past it, the generic engine's shared step body wins back
 BLOCK_AUTO_MAX_UNROLL = 512
 
-ENGINES = ('auto', 'generic', 'block', 'straightline')
+ENGINES = ('auto', 'generic', 'block', 'straightline', 'pallas')
+
+# backends where 'auto' considers the pallas megastep engine: mosaic
+# kernels only COMPILE on real TPUs — elsewhere they would run under
+# the pallas interpreter, which is strictly slower than the XLA
+# engines (tests monkeypatch this to exercise the auto rung on CPU)
+_PALLAS_AUTO_BACKENDS = ('tpu',)
 
 
 def block_ineligible(mp, cfg: InterpreterConfig) -> str:
@@ -1576,6 +1604,37 @@ def block_ineligible(mp, cfg: InterpreterConfig) -> str:
     return None
 
 
+def pallas_ineligible(mp, cfg: InterpreterConfig) -> str:
+    """Why ``(mp, cfg)`` cannot run on the Pallas megastep engine
+    (``engine='pallas'``) — ``None`` when it can.
+
+    The megastep kernel executes straight-line instruction runs with
+    the carry resident in VMEM, in one of two modes picked per program
+    (:func:`_pallas_mode`): a forward-jump-only program runs WHOLE as
+    one span kernel; anything else runs on the block engine's outer
+    loop with each superinstruction body lowered to a kernel.  So
+    eligibility is the straight-line rules OR the block rules, minus
+    what the kernel itself cannot host:
+
+    * no pallas support in this jax build;
+    * trace mode (per-step trace writes, as for the other rungs);
+    * physics mode — the device co-state and the epoch resolver's
+      pause/validate loop are float/host-side machinery; the XLA
+      engines keep that path.
+    """
+    from ..ops._pallas_common import HAS_PALLAS
+    if not HAS_PALLAS:
+        return 'jax.experimental.pallas unavailable in this jax build'
+    if cfg.trace:
+        return 'trace mode records per-step state'
+    if cfg.physics:
+        return 'physics mode (device co-state + epoch resolver run ' \
+               'on the XLA engines)'
+    if straightline_ineligible(mp, cfg) is None:
+        return None
+    return block_ineligible(mp, cfg)
+
+
 @functools.lru_cache(maxsize=128)
 def _block_plan(blk: tuple):
     """Cached block table for a static program: ``(bid_at, bodies)``
@@ -1599,12 +1658,15 @@ def resolve_engine(mp, cfg: InterpreterConfig) -> str:
 
     ``None`` preserves the legacy ``cfg.straightline`` tri-state
     (straightline vs generic only); ``'generic'`` / ``'straightline'``
-    / ``'block'`` force an engine (the specialized engines raise with
-    the ineligibility reason); ``'auto'`` walks the ladder —
-    straight-line when eligible and small enough to unroll, else block
-    when eligible and the deduped body total is under
-    :data:`BLOCK_AUTO_MAX_UNROLL` (and at least one body exists), else
-    generic.  Returns one of ``'generic' | 'block' | 'straightline'``.
+    / ``'block'`` / ``'pallas'`` force an engine (the specialized
+    engines raise with the ineligibility reason); ``'auto'`` walks the
+    ladder — pallas first on TPU backends
+    (:data:`_PALLAS_AUTO_BACKENDS`) where eligible under the same size
+    caps as the XLA rung it subsumes, then straight-line when eligible
+    and small enough to unroll, then block when eligible and the
+    deduped body total is under :data:`BLOCK_AUTO_MAX_UNROLL` (and at
+    least one body exists), else generic.  Returns one of
+    ``'generic' | 'block' | 'straightline' | 'pallas'``.
     """
     eng = cfg.engine
     if eng is None:
@@ -1623,9 +1685,26 @@ def resolve_engine(mp, cfg: InterpreterConfig) -> str:
             raise ValueError(f"engine='block' but the program is "
                              f"ineligible: {reason}")
         return 'block'
+    if eng == 'pallas':
+        reason = pallas_ineligible(mp, cfg)
+        if reason:
+            raise ValueError(f"engine='pallas' but the program is "
+                             f"ineligible: {reason}")
+        return 'pallas'
     if eng == 'auto':
-        if straightline_ineligible(mp, cfg) is None \
-                and mp.n_instr <= SL_AUTO_MAX_INSTR:
+        sl_ok = straightline_ineligible(mp, cfg) is None
+        if jax.default_backend() in _PALLAS_AUTO_BACKENDS \
+                and pallas_ineligible(mp, cfg) is None:
+            # same size caps as the XLA rung the kernel would subsume:
+            # past them, trace/compile cost dominates either way
+            if sl_ok and mp.n_instr <= SL_AUTO_MAX_INSTR:
+                return 'pallas'
+            if not sl_ok:
+                _, bodies = _block_plan(_soa_static(mp))
+                if bodies and sum(L for _, L in bodies) \
+                        <= BLOCK_AUTO_MAX_UNROLL:
+                    return 'pallas'
+        if sl_ok and mp.n_instr <= SL_AUTO_MAX_INSTR:
             return 'straightline'
         if block_ineligible(mp, cfg) is None:
             _, bodies = _block_plan(_soa_static(mp))
@@ -1660,268 +1739,15 @@ def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
     device-co-state semantics match :func:`_step` exactly — pinned by
     tests/test_straightline.py engine-equality on shared programs.
     """
-    B, C = st0['pc'].shape
     N = soa_np.shape[1]
     st = dict(st0)
-    stalled = jnp.zeros((B, C), bool)
-    pmask_np = _PMASKS
+    stalled = jnp.zeros(st0['pc'].shape, bool)
 
     for i in range(N):
         f = {name: np.asarray(soa_np[:, i, _F[name]])
              for name in _FIELDS}
-        kind = f['kind']
-        m_pw, m_pt = kind == isa.K_PULSE_WRITE, kind == isa.K_PULSE_TRIG
-        m_rst, m_idle = kind == isa.K_PULSE_RESET, kind == isa.K_IDLE
-        m_regalu, m_incq = kind == isa.K_REG_ALU, kind == isa.K_INC_QCLK
-        m_jmpi, m_jcond = kind == isa.K_JUMP_I, kind == isa.K_JUMP_COND
-        m_jfp, m_afp = kind == isa.K_JUMP_FPROC, kind == isa.K_ALU_FPROC
-        m_done = kind == isa.K_DONE
-        m_fproc = m_jfp | m_afp
-        m_alu = m_regalu | m_incq | m_jcond | m_jfp | m_afp
-        has = lambda m: bool(np.any(m))
-        j = lambda a: jnp.asarray(np.asarray(a))[None]       # [1, C]
-
-        active = (st['pc'] == i) & ~st['done'] & ~stalled
-        time, offset, regs = st['time'], st['offset'], st['regs']
-        err_i = jnp.zeros((B, C), jnp.int32)
-        fault_i = jnp.zeros((B, C), jnp.int32)
-        # out-of-ISA kind at this index retires as a silent no-op in
-        # every emitted block below — trap it (static mask, free when
-        # the program is well-formed)
-        m_badkind = (kind < 0) | (kind >= isa.N_KINDS)
-        if has(m_badkind):
-            fault_i = fault_i | jnp.where(j(m_badkind), FAULT_ILLEGAL_OP,
-                                          0)
-
-        def reg_read_static(addr_c):
-            oh = (np.asarray(addr_c)[:, None]
-                  == np.arange(isa.N_REGS)[None, :]).astype(np.int32)
-            return jnp.sum(regs * jnp.asarray(oh)[None], axis=-1)
-
-        # ---- fproc: own-core sticky read (eligibility guarantees) ---
-        if has(m_fproc):
-            req = time
-            mavail, bitsq = st['meas_avail'], meas_bits
-            m_cnt = jnp.sum((mavail <= req[..., None]).astype(jnp.int32),
-                            -1)
-            oh_latest = _onehot(jnp.maximum(m_cnt - 1, 0), cfg.max_meas)
-            latest_valid = (m_cnt == 0) | (_ohsel(
-                meas_valid.astype(jnp.int32), oh_latest) == 1)
-            f_data = jnp.where(m_cnt > 0, _ohsel(bitsq, oh_latest), 0)
-            f_race = jnp.any(
-                (mavail > (req - STICKY_RACE_MARGIN)[..., None])
-                & (mavail <= (req + STICKY_RACE_MARGIN)[..., None]), -1)
-            f_ready = latest_valid
-            stall_i = active & j(m_fproc) & ~f_ready
-            stalled = stalled | stall_i
-            active = active & ~stall_i
-        else:
-            f_data = jnp.int32(0)
-
-        # ---- ALU -----------------------------------------------------
-        if has(m_alu):
-            in0 = jnp.where(j(f['in0_is_reg'] == 1),
-                            reg_read_static(f['in0_reg']), j(f['imm'])) \
-                if np.any(f['in0_is_reg'][m_alu]) else j(f['imm'])
-            in1 = jnp.int32(0)
-            if np.any(m_regalu | m_jcond):
-                in1 = reg_read_static(f['in1_reg'])
-            if has(m_incq):
-                in1 = jnp.where(j(m_incq), time - offset, in1)
-            if has(m_fproc):
-                in1 = jnp.where(j(m_fproc), f_data, in1)
-            alu_res = _alu_vec(j(f['alu_op']), in0, in1)
-            if np.any(m_regalu | m_afp):
-                wr = active & j(m_regalu | m_afp)
-                wr_oh = (np.asarray(f['out_reg'])[:, None]
-                         == np.arange(isa.N_REGS)[None, :])
-                regs = jnp.where(wr[..., None] & jnp.asarray(wr_oh)[None],
-                                 alu_res[..., None], regs)
-                st['regs'] = regs
-        else:
-            alu_res = jnp.int32(0)
-
-        # ---- pulse latch + trigger ----------------------------------
-        pp = st['pp']
-        if has(m_pw | m_pt):
-            is_pulse = active & j(m_pw | m_pt)
-            imm_vals = np.stack([f['p_env'], f['p_phase'], f['p_freq'],
-                                 f['p_amp'], f['p_cfg']], -1)   # [C, 5]
-            wen = ((f['p_wen'][:, None] >> np.arange(5)) & 1) == 1
-            if np.any(f['p_regsel']):
-                rsel = ((f['p_regsel'][:, None] >> np.arange(5)) & 1)
-                regval = reg_read_static(f['p_reg'])
-                cand = jnp.where(jnp.asarray(rsel == 1)[None],
-                                 regval[..., None],
-                                 jnp.asarray(imm_vals)[None]) \
-                    & jnp.asarray(pmask_np)
-            else:
-                cand = jnp.asarray((imm_vals & pmask_np))[None]
-            pp = jnp.where(is_pulse[..., None] & jnp.asarray(wen)[None],
-                           cand, pp)
-            st['pp'] = pp
-
-        trig = offset + j(f['cmd_time'])
-        if has(m_pt):
-            fire = active & j(m_pt)
-            err_i = err_i | jnp.where(fire & (trig < time),
-                                      ERR_MISSED_TRIG, 0)
-            trig = jnp.maximum(trig, time)
-            elem = pp[..., 4] & 0b11
-            oh_elem = _onehot(jnp.minimum(elem, spc.shape[1] - 1),
-                              spc.shape[1])
-            spc_e = _ohsel(spc[None], oh_elem)
-            interp_e = _ohsel(interp[None], oh_elem)
-            env_len = (pp[..., 0] >> 12) & 0xfff
-            nsamp = env_len * 4 * interp_e
-            dur = jnp.where(env_len == 0xfff, 0,
-                            (nsamp + spc_e - 1) // spc_e)
-            err_i = err_i | jnp.where(
-                fire & (st['n_pulses'] >= cfg.max_pulses),
-                ERR_PULSE_OVERFLOW, 0)
-            fault_i = fault_i | jnp.where(
-                fire & (st['n_pulses'] >= cfg.max_pulses),
-                FAULT_PULSE_OVERFLOW, 0)
-            if cfg.record_pulses:
-                rec_vals = jnp.stack(
-                    [j(f['cmd_time']) * jnp.ones_like(trig), trig,
-                     pp[..., 0], pp[..., 1], pp[..., 2], pp[..., 3],
-                     pp[..., 4], elem, dur], axis=-1)
-                oh_pslot = _onehot(
-                    jnp.minimum(st['n_pulses'], cfg.max_pulses - 1),
-                    cfg.max_pulses)
-                pwrite = (oh_pslot == 1) \
-                    & (fire & (st['n_pulses'] < cfg.max_pulses))[..., None]
-                FR, P = len(_REC_FIELDS), cfg.max_pulses
-                st['rec'] = jnp.where(
-                    pwrite[:, :, None, :], rec_vals[:, :, :, None],
-                    st['rec'].reshape(B, C, FR, P)).reshape(B, C, FR * P)
-            st['n_pulses'] = st['n_pulses'] + fire.astype(jnp.int32)
-
-            is_meas_pulse = fire & (elem == cfg.meas_elem)
-            err_i = err_i | jnp.where(
-                is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
-                ERR_MEAS_OVERFLOW, 0)
-            fault_i = fault_i | jnp.where(
-                is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
-                FAULT_MEAS_OVERFLOW, 0)
-            oh_mslot = _onehot(jnp.minimum(st['n_meas'],
-                                           cfg.max_meas - 1), cfg.max_meas)
-            meas_avail = jnp.where(
-                (oh_mslot == 1) & is_meas_pulse[..., None],
-                (trig + dur + cfg.meas_latency)[..., None],
-                st['meas_avail'])
-            cw_clks = 0
-            if cfg.physics and cfg.cw_horizon > 0:
-                cw_clks = (cfg.cw_horizon + spc_e - 1) // spc_e
-                meas_avail = jnp.where(
-                    (oh_mslot == 1) & (is_meas_pulse
-                                       & (env_len == 0xfff))[..., None],
-                    (trig + cw_clks + cfg.meas_latency)[..., None],
-                    meas_avail)
-            elif cfg.physics:
-                err_i = err_i | jnp.where(
-                    is_meas_pulse & (env_len == 0xfff), ERR_CW_MEAS, 0)
-            st['meas_avail'] = meas_avail
-            st['n_meas'] = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
-
-            # ---- physics co-state (parity / bloch; statevec is
-            # ineligible for this executor) — the SAME helper the
-            # generic engine uses, so the physics cannot drift --------
-            if cfg.physics:
-                mwr = (oh_mslot == 1) & is_meas_pulse[..., None]
-                dev_updates, state_bit = _device_1q_pulse(
-                    st, cfg, dev, fire, elem, pp, trig, oh_mslot,
-                    is_meas_pulse)
-                st.update(dev_updates)
-                st['meas_state'] = jnp.where(mwr, state_bit[..., None],
-                                             st['meas_state'])
-                st['meas_amp'] = jnp.where(mwr, pp[..., 3:4],
-                                           st['meas_amp'])
-                st['meas_phase'] = jnp.where(mwr, pp[..., 1:2],
-                                             st['meas_phase'])
-                st['meas_freq'] = jnp.where(mwr, pp[..., 2:3],
-                                            st['meas_freq'])
-                st['meas_env'] = jnp.where(mwr, pp[..., 0:1],
-                                           st['meas_env'])
-                st['meas_gtime'] = jnp.where(mwr, trig[..., None],
-                                             st['meas_gtime'])
-
-        # ---- phase reset / idle -------------------------------------
-        if has(m_rst):
-            is_rst = active & j(m_rst)
-            oh_rslot = _onehot(jnp.minimum(st['n_resets'],
-                                           cfg.max_resets - 1),
-                               cfg.max_resets)
-            st['rst_time'] = jnp.where((oh_rslot == 1) & is_rst[..., None],
-                                       time[..., None], st['rst_time'])
-            fault_i = fault_i | jnp.where(
-                is_rst & (st['n_resets'] >= cfg.max_resets),
-                FAULT_RESET_OVERFLOW, 0)
-            st['n_resets'] = st['n_resets'] + is_rst.astype(jnp.int32)
-        if has(m_idle):
-            is_idle = active & j(m_idle)
-            idle_end = offset + j(f['cmd_time'])
-            err_i = err_i | jnp.where(is_idle & (time > idle_end),
-                                      ERR_MISSED_TRIG, 0)
-            idle_end = jnp.maximum(idle_end, time)
-
-        # ---- race flag on the proceeding read -----------------------
-        if has(m_fproc):
-            err_i = err_i | jnp.where(active & j(m_fproc) & f_race,
-                                      ERR_STICKY_RACE, 0)
-
-        if 'op_hist' in st:
-            oh_kind = (kind[:, None]
-                       == np.arange(isa.N_KINDS)[None, :]).astype(np.int32)
-            st['op_hist'] = st['op_hist'] \
-                + active[..., None] * jnp.asarray(oh_kind)[None]
-
-        # ---- next pc / time / offset / done -------------------------
-        pc_next = jnp.int32(i + 1)
-        if has(m_jmpi | m_jcond | m_jfp):
-            branch = (alu_res & 1) == 1
-            pc_next = jnp.where(j(m_jmpi), j(f['jump_addr']), pc_next)
-            pc_next = jnp.where(j(m_jcond | m_jfp)
-                                & branch, j(f['jump_addr']), pc_next)
-            # taken forward jump past the program end: the lane matches
-            # no later index, retires nothing, and is left undone —
-            # trap it here rather than as a bare budget fault
-            m_oob = (f['jump_addr'] < 0) | (f['jump_addr'] >= N)
-            if has(m_oob & (m_jmpi | m_jcond | m_jfp)):
-                taken_oob = (j(m_jmpi & m_oob)
-                             | (j((m_jcond | m_jfp) & m_oob) & branch))
-                st['fault'] = st['fault'] | jnp.where(
-                    active & taken_oob, FAULT_JUMP_OOB, 0)
-        st['pc'] = jnp.where(active & ~j(m_done), pc_next, st['pc'])
-        time_next = time
-        if has(m_pt):
-            time_next = jnp.where(j(m_pt), trig + cfg.pulse_load_clks,
-                                  time_next)
-        if has(m_pw | m_rst):
-            time_next = jnp.where(j(m_pw | m_rst),
-                                  time + cfg.pulse_regwrite_clks,
-                                  time_next)
-        if has(m_idle):
-            time_next = jnp.where(j(m_idle),
-                                  idle_end + cfg.pulse_load_clks,
-                                  time_next)
-        if has(m_regalu | m_incq):
-            time_next = jnp.where(j(m_regalu | m_incq),
-                                  time + cfg.alu_instr_clks, time_next)
-        if has(m_jmpi | m_jcond):
-            time_next = jnp.where(j(m_jmpi | m_jcond),
-                                  time + cfg.jump_cond_clks, time_next)
-        if has(m_fproc):
-            time_next = jnp.where(j(m_fproc),
-                                  time + cfg.jump_fproc_clks, time_next)
-        st['time'] = jnp.where(active, time_next, time)
-        if has(m_incq):
-            st['offset'] = jnp.where(active & j(m_incq), time - alu_res,
-                                     offset)
-        st['err'] = st['err'] | jnp.where(active, err_i, 0)
-        st['fault'] = st['fault'] | jnp.where(active, fault_i, 0)
-        st['done'] = st['done'] | (active & j(m_done))
+        st, stalled = _sl_apply_instr(st, stalled, i, N, f, spc, interp,
+                                      meas_bits, meas_valid, cfg, dev)
 
     # every non-stalled lane retired at its DONE (forward-only, DONE-
     # terminated by eligibility)
@@ -1929,6 +1755,276 @@ def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
         st['phys_wait'] = stalled
     st['_steps'] = st['_steps'] + N
     return st
+
+
+def _sl_apply_instr(st: dict, stalled, i: int, N: int, f: dict, spc,
+                    interp, meas_bits, meas_valid,
+                    cfg: InterpreterConfig, dev=None):
+    """Apply instruction index ``i`` (static fields ``f``, one value
+    per core) to every lane with ``pc == i`` — the straight-line
+    engine's per-instruction step body, shared verbatim with the
+    pallas megastep kernel (:func:`_exec_span_pallas`) so the two
+    engines are bit-identical by construction.  Returns the updated
+    ``(st, stalled)`` pair; ``st`` leaves are ``[B, C, ...]`` (``B``
+    is a shot TILE inside the kernel)."""
+    st = dict(st)
+    B, C = st['pc'].shape
+    pmask_np = _PMASKS
+    kind = f['kind']
+    m_pw, m_pt = kind == isa.K_PULSE_WRITE, kind == isa.K_PULSE_TRIG
+    m_rst, m_idle = kind == isa.K_PULSE_RESET, kind == isa.K_IDLE
+    m_regalu, m_incq = kind == isa.K_REG_ALU, kind == isa.K_INC_QCLK
+    m_jmpi, m_jcond = kind == isa.K_JUMP_I, kind == isa.K_JUMP_COND
+    m_jfp, m_afp = kind == isa.K_JUMP_FPROC, kind == isa.K_ALU_FPROC
+    m_done = kind == isa.K_DONE
+    m_fproc = m_jfp | m_afp
+    m_alu = m_regalu | m_incq | m_jcond | m_jfp | m_afp
+    has = lambda m: bool(np.any(m))
+    j = lambda a: jnp.asarray(np.asarray(a))[None]       # [1, C]
+
+    active = (st['pc'] == i) & ~st['done'] & ~stalled
+    time, offset, regs = st['time'], st['offset'], st['regs']
+    err_i = jnp.zeros((B, C), jnp.int32)
+    fault_i = jnp.zeros((B, C), jnp.int32)
+    # out-of-ISA kind at this index retires as a silent no-op in
+    # every emitted block below — trap it (static mask, free when
+    # the program is well-formed)
+    m_badkind = (kind < 0) | (kind >= isa.N_KINDS)
+    if has(m_badkind):
+        fault_i = fault_i | jnp.where(j(m_badkind), FAULT_ILLEGAL_OP,
+                                      0)
+
+    def reg_read_static(addr_c):
+        oh = (np.asarray(addr_c)[:, None]
+              == np.arange(isa.N_REGS)[None, :]).astype(np.int32)
+        return jnp.sum(regs * jnp.asarray(oh)[None], axis=-1)
+
+    # ---- fproc: own-core sticky read (eligibility guarantees) ---
+    if has(m_fproc):
+        req = time
+        mavail, bitsq = st['meas_avail'], meas_bits
+        m_cnt = jnp.sum((mavail <= req[..., None]).astype(jnp.int32),
+                        -1)
+        oh_latest = _onehot(jnp.maximum(m_cnt - 1, 0), cfg.max_meas)
+        latest_valid = (m_cnt == 0) | (_ohsel(
+            meas_valid.astype(jnp.int32), oh_latest) == 1)
+        f_data = jnp.where(m_cnt > 0, _ohsel(bitsq, oh_latest), 0)
+        f_race = jnp.any(
+            (mavail > (req - STICKY_RACE_MARGIN)[..., None])
+            & (mavail <= (req + STICKY_RACE_MARGIN)[..., None]), -1)
+        f_ready = latest_valid
+        stall_i = active & j(m_fproc) & ~f_ready
+        stalled = stalled | stall_i
+        active = active & ~stall_i
+    else:
+        f_data = jnp.int32(0)
+
+    # ---- ALU -----------------------------------------------------
+    if has(m_alu):
+        in0 = jnp.where(j(f['in0_is_reg'] == 1),
+                        reg_read_static(f['in0_reg']), j(f['imm'])) \
+            if np.any(f['in0_is_reg'][m_alu]) else j(f['imm'])
+        in1 = jnp.int32(0)
+        if np.any(m_regalu | m_jcond):
+            in1 = reg_read_static(f['in1_reg'])
+        if has(m_incq):
+            in1 = jnp.where(j(m_incq), time - offset, in1)
+        if has(m_fproc):
+            in1 = jnp.where(j(m_fproc), f_data, in1)
+        alu_res = _alu_vec(j(f['alu_op']), in0, in1)
+        if np.any(m_regalu | m_afp):
+            wr = active & j(m_regalu | m_afp)
+            wr_oh = (np.asarray(f['out_reg'])[:, None]
+                     == np.arange(isa.N_REGS)[None, :])
+            regs = jnp.where(wr[..., None] & jnp.asarray(wr_oh)[None],
+                             alu_res[..., None], regs)
+            st['regs'] = regs
+    else:
+        alu_res = jnp.int32(0)
+
+    # ---- pulse latch + trigger ----------------------------------
+    pp = st['pp']
+    if has(m_pw | m_pt):
+        is_pulse = active & j(m_pw | m_pt)
+        imm_vals = np.stack([f['p_env'], f['p_phase'], f['p_freq'],
+                             f['p_amp'], f['p_cfg']], -1)   # [C, 5]
+        wen = ((f['p_wen'][:, None] >> np.arange(5)) & 1) == 1
+        if np.any(f['p_regsel']):
+            rsel = ((f['p_regsel'][:, None] >> np.arange(5)) & 1)
+            regval = reg_read_static(f['p_reg'])
+            cand = jnp.where(jnp.asarray(rsel == 1)[None],
+                             regval[..., None],
+                             jnp.asarray(imm_vals)[None]) \
+                & jnp.asarray(pmask_np)
+        else:
+            cand = jnp.asarray((imm_vals & pmask_np))[None]
+        pp = jnp.where(is_pulse[..., None] & jnp.asarray(wen)[None],
+                       cand, pp)
+        st['pp'] = pp
+
+    trig = offset + j(f['cmd_time'])
+    if has(m_pt):
+        fire = active & j(m_pt)
+        err_i = err_i | jnp.where(fire & (trig < time),
+                                  ERR_MISSED_TRIG, 0)
+        trig = jnp.maximum(trig, time)
+        elem = pp[..., 4] & 0b11
+        oh_elem = _onehot(jnp.minimum(elem, spc.shape[1] - 1),
+                          spc.shape[1])
+        spc_e = _ohsel(spc[None], oh_elem)
+        interp_e = _ohsel(interp[None], oh_elem)
+        env_len = (pp[..., 0] >> 12) & 0xfff
+        nsamp = env_len * 4 * interp_e
+        dur = jnp.where(env_len == 0xfff, 0,
+                        (nsamp + spc_e - 1) // spc_e)
+        err_i = err_i | jnp.where(
+            fire & (st['n_pulses'] >= cfg.max_pulses),
+            ERR_PULSE_OVERFLOW, 0)
+        fault_i = fault_i | jnp.where(
+            fire & (st['n_pulses'] >= cfg.max_pulses),
+            FAULT_PULSE_OVERFLOW, 0)
+        if cfg.record_pulses:
+            rec_vals = jnp.stack(
+                [j(f['cmd_time']) * jnp.ones_like(trig), trig,
+                 pp[..., 0], pp[..., 1], pp[..., 2], pp[..., 3],
+                 pp[..., 4], elem, dur], axis=-1)
+            oh_pslot = _onehot(
+                jnp.minimum(st['n_pulses'], cfg.max_pulses - 1),
+                cfg.max_pulses)
+            pwrite = (oh_pslot == 1) \
+                & (fire & (st['n_pulses'] < cfg.max_pulses))[..., None]
+            FR, P = len(_REC_FIELDS), cfg.max_pulses
+            st['rec'] = jnp.where(
+                pwrite[:, :, None, :], rec_vals[:, :, :, None],
+                st['rec'].reshape(B, C, FR, P)).reshape(B, C, FR * P)
+        st['n_pulses'] = st['n_pulses'] + fire.astype(jnp.int32)
+
+        is_meas_pulse = fire & (elem == cfg.meas_elem)
+        err_i = err_i | jnp.where(
+            is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
+            ERR_MEAS_OVERFLOW, 0)
+        fault_i = fault_i | jnp.where(
+            is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
+            FAULT_MEAS_OVERFLOW, 0)
+        oh_mslot = _onehot(jnp.minimum(st['n_meas'],
+                                       cfg.max_meas - 1), cfg.max_meas)
+        meas_avail = jnp.where(
+            (oh_mslot == 1) & is_meas_pulse[..., None],
+            (trig + dur + cfg.meas_latency)[..., None],
+            st['meas_avail'])
+        cw_clks = 0
+        if cfg.physics and cfg.cw_horizon > 0:
+            cw_clks = (cfg.cw_horizon + spc_e - 1) // spc_e
+            meas_avail = jnp.where(
+                (oh_mslot == 1) & (is_meas_pulse
+                                   & (env_len == 0xfff))[..., None],
+                (trig + cw_clks + cfg.meas_latency)[..., None],
+                meas_avail)
+        elif cfg.physics:
+            err_i = err_i | jnp.where(
+                is_meas_pulse & (env_len == 0xfff), ERR_CW_MEAS, 0)
+        st['meas_avail'] = meas_avail
+        st['n_meas'] = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
+
+        # ---- physics co-state (parity / bloch; statevec is
+        # ineligible for this executor) — the SAME helper the
+        # generic engine uses, so the physics cannot drift --------
+        if cfg.physics:
+            mwr = (oh_mslot == 1) & is_meas_pulse[..., None]
+            dev_updates, state_bit = _device_1q_pulse(
+                st, cfg, dev, fire, elem, pp, trig, oh_mslot,
+                is_meas_pulse)
+            st.update(dev_updates)
+            st['meas_state'] = jnp.where(mwr, state_bit[..., None],
+                                         st['meas_state'])
+            st['meas_amp'] = jnp.where(mwr, pp[..., 3:4],
+                                       st['meas_amp'])
+            st['meas_phase'] = jnp.where(mwr, pp[..., 1:2],
+                                         st['meas_phase'])
+            st['meas_freq'] = jnp.where(mwr, pp[..., 2:3],
+                                        st['meas_freq'])
+            st['meas_env'] = jnp.where(mwr, pp[..., 0:1],
+                                       st['meas_env'])
+            st['meas_gtime'] = jnp.where(mwr, trig[..., None],
+                                         st['meas_gtime'])
+
+    # ---- phase reset / idle -------------------------------------
+    if has(m_rst):
+        is_rst = active & j(m_rst)
+        oh_rslot = _onehot(jnp.minimum(st['n_resets'],
+                                       cfg.max_resets - 1),
+                           cfg.max_resets)
+        st['rst_time'] = jnp.where((oh_rslot == 1) & is_rst[..., None],
+                                   time[..., None], st['rst_time'])
+        fault_i = fault_i | jnp.where(
+            is_rst & (st['n_resets'] >= cfg.max_resets),
+            FAULT_RESET_OVERFLOW, 0)
+        st['n_resets'] = st['n_resets'] + is_rst.astype(jnp.int32)
+    if has(m_idle):
+        is_idle = active & j(m_idle)
+        idle_end = offset + j(f['cmd_time'])
+        err_i = err_i | jnp.where(is_idle & (time > idle_end),
+                                  ERR_MISSED_TRIG, 0)
+        idle_end = jnp.maximum(idle_end, time)
+
+    # ---- race flag on the proceeding read -----------------------
+    if has(m_fproc):
+        err_i = err_i | jnp.where(active & j(m_fproc) & f_race,
+                                  ERR_STICKY_RACE, 0)
+
+    if 'op_hist' in st:
+        oh_kind = (kind[:, None]
+                   == np.arange(isa.N_KINDS)[None, :]).astype(np.int32)
+        st['op_hist'] = st['op_hist'] \
+            + active[..., None] * jnp.asarray(oh_kind)[None]
+
+    # ---- next pc / time / offset / done -------------------------
+    pc_next = jnp.int32(i + 1)
+    if has(m_jmpi | m_jcond | m_jfp):
+        branch = (alu_res & 1) == 1
+        pc_next = jnp.where(j(m_jmpi), j(f['jump_addr']), pc_next)
+        pc_next = jnp.where(j(m_jcond | m_jfp)
+                            & branch, j(f['jump_addr']), pc_next)
+        # taken forward jump past the program end: the lane matches
+        # no later index, retires nothing, and is left undone —
+        # trap it here rather than as a bare budget fault
+        m_oob = (f['jump_addr'] < 0) | (f['jump_addr'] >= N)
+        if has(m_oob & (m_jmpi | m_jcond | m_jfp)):
+            taken_oob = (j(m_jmpi & m_oob)
+                         | (j((m_jcond | m_jfp) & m_oob) & branch))
+            st['fault'] = st['fault'] | jnp.where(
+                active & taken_oob, FAULT_JUMP_OOB, 0)
+    st['pc'] = jnp.where(active & ~j(m_done), pc_next, st['pc'])
+    time_next = time
+    if has(m_pt):
+        time_next = jnp.where(j(m_pt), trig + cfg.pulse_load_clks,
+                              time_next)
+    if has(m_pw | m_rst):
+        time_next = jnp.where(j(m_pw | m_rst),
+                              time + cfg.pulse_regwrite_clks,
+                              time_next)
+    if has(m_idle):
+        time_next = jnp.where(j(m_idle),
+                              idle_end + cfg.pulse_load_clks,
+                              time_next)
+    if has(m_regalu | m_incq):
+        time_next = jnp.where(j(m_regalu | m_incq),
+                              time + cfg.alu_instr_clks, time_next)
+    if has(m_jmpi | m_jcond):
+        time_next = jnp.where(j(m_jmpi | m_jcond),
+                              time + cfg.jump_cond_clks, time_next)
+    if has(m_fproc):
+        time_next = jnp.where(j(m_fproc),
+                              time + cfg.jump_fproc_clks, time_next)
+    st['time'] = jnp.where(active, time_next, time)
+    if has(m_incq):
+        st['offset'] = jnp.where(active & j(m_incq), time - alu_res,
+                                 offset)
+    st['err'] = st['err'] | jnp.where(active, err_i, 0)
+    st['fault'] = st['fault'] | jnp.where(active, fault_i, 0)
+    st['done'] = st['done'] | (active & j(m_done))
+
+    return st, stalled
 
 
 def _exec_block_body(st: dict, act, rows_np, spc, interp,
@@ -1947,217 +2043,310 @@ def _exec_block_body(st: dict, act, rows_np, spc, interp,
     advances RELATIVELY (``pc + 1`` per retired row) because a deduped
     body runs for segments at different start addresses.
     """
-    B, C = act.shape
-    L = rows_np.shape[1]
-    pmask_np = _PMASKS
-
-    for off in range(L):
+    for off in range(rows_np.shape[1]):
         f = {name: np.asarray(rows_np[:, off, _F[name]])
              for name in _FIELDS}
-        kind = f['kind']
-        m_pw, m_pt = kind == isa.K_PULSE_WRITE, kind == isa.K_PULSE_TRIG
-        m_rst, m_idle = kind == isa.K_PULSE_RESET, kind == isa.K_IDLE
-        m_regalu, m_incq = kind == isa.K_REG_ALU, kind == isa.K_INC_QCLK
-        m_done = kind == isa.K_DONE
-        m_alu = m_regalu | m_incq
-        has = lambda m: bool(np.any(m))
-        j = lambda a: jnp.asarray(np.asarray(a))[None]       # [1, C]
+        st = _blk_apply_row(st, act, f, spc, interp, cfg, dev)
+    return st
 
-        active = act & ~st['done']
-        time, offset, regs = st['time'], st['offset'], st['regs']
-        err_i = jnp.zeros((B, C), jnp.int32)
-        fault_i = jnp.zeros((B, C), jnp.int32)
-        m_badkind = (kind < 0) | (kind >= isa.N_KINDS)
-        if has(m_badkind):
-            fault_i = fault_i | jnp.where(j(m_badkind), FAULT_ILLEGAL_OP,
-                                          0)
 
-        def reg_read_static(addr_c):
-            oh = (np.asarray(addr_c)[:, None]
-                  == np.arange(isa.N_REGS)[None, :]).astype(np.int32)
-            return jnp.sum(regs * jnp.asarray(oh)[None], axis=-1)
+def _blk_apply_row(st: dict, act, f: dict, spc, interp,
+                   cfg: InterpreterConfig, dev=None) -> dict:
+    """Apply ONE superinstruction row (static fields ``f``, one value
+    per core) to the lanes selected by ``act`` — the block engine's
+    per-row step body, shared verbatim with the pallas block-body
+    kernel (:func:`_exec_block_body_pallas`) so the two paths are
+    bit-identical by construction.  ``pc`` advances RELATIVELY."""
+    st = dict(st)
+    B, C = act.shape
+    pmask_np = _PMASKS
+    kind = f['kind']
+    m_pw, m_pt = kind == isa.K_PULSE_WRITE, kind == isa.K_PULSE_TRIG
+    m_rst, m_idle = kind == isa.K_PULSE_RESET, kind == isa.K_IDLE
+    m_regalu, m_incq = kind == isa.K_REG_ALU, kind == isa.K_INC_QCLK
+    m_done = kind == isa.K_DONE
+    m_alu = m_regalu | m_incq
+    has = lambda m: bool(np.any(m))
+    j = lambda a: jnp.asarray(np.asarray(a))[None]       # [1, C]
 
-        # ---- ALU (REG_ALU / INC_QCLK only) --------------------------
-        if has(m_alu):
-            in0 = jnp.where(j(f['in0_is_reg'] == 1),
-                            reg_read_static(f['in0_reg']), j(f['imm'])) \
-                if np.any(f['in0_is_reg'][m_alu]) else j(f['imm'])
-            in1 = jnp.int32(0)
-            if has(m_regalu):
-                in1 = reg_read_static(f['in1_reg'])
-            if has(m_incq):
-                in1 = jnp.where(j(m_incq), time - offset, in1)
-            alu_res = _alu_vec(j(f['alu_op']), in0, in1)
-            if has(m_regalu):
-                wr = active & j(m_regalu)
-                wr_oh = (np.asarray(f['out_reg'])[:, None]
-                         == np.arange(isa.N_REGS)[None, :])
-                regs = jnp.where(wr[..., None] & jnp.asarray(wr_oh)[None],
-                                 alu_res[..., None], regs)
-                st['regs'] = regs
-        else:
-            alu_res = jnp.int32(0)
+    active = act & ~st['done']
+    time, offset, regs = st['time'], st['offset'], st['regs']
+    err_i = jnp.zeros((B, C), jnp.int32)
+    fault_i = jnp.zeros((B, C), jnp.int32)
+    m_badkind = (kind < 0) | (kind >= isa.N_KINDS)
+    if has(m_badkind):
+        fault_i = fault_i | jnp.where(j(m_badkind), FAULT_ILLEGAL_OP,
+                                      0)
 
-        # ---- pulse latch + trigger ----------------------------------
-        pp = st['pp']
-        if has(m_pw | m_pt):
-            is_pulse = active & j(m_pw | m_pt)
-            imm_vals = np.stack([f['p_env'], f['p_phase'], f['p_freq'],
-                                 f['p_amp'], f['p_cfg']], -1)   # [C, 5]
-            wen = ((f['p_wen'][:, None] >> np.arange(5)) & 1) == 1
-            if np.any(f['p_regsel']):
-                rsel = ((f['p_regsel'][:, None] >> np.arange(5)) & 1)
-                regval = reg_read_static(f['p_reg'])
-                cand = jnp.where(jnp.asarray(rsel == 1)[None],
-                                 regval[..., None],
-                                 jnp.asarray(imm_vals)[None]) \
-                    & jnp.asarray(pmask_np)
-            else:
-                cand = jnp.asarray((imm_vals & pmask_np))[None]
-            pp = jnp.where(is_pulse[..., None] & jnp.asarray(wen)[None],
-                           cand, pp)
-            st['pp'] = pp
+    def reg_read_static(addr_c):
+        oh = (np.asarray(addr_c)[:, None]
+              == np.arange(isa.N_REGS)[None, :]).astype(np.int32)
+        return jnp.sum(regs * jnp.asarray(oh)[None], axis=-1)
 
-        trig = offset + j(f['cmd_time'])
-        if has(m_pt):
-            fire = active & j(m_pt)
-            err_i = err_i | jnp.where(fire & (trig < time),
-                                      ERR_MISSED_TRIG, 0)
-            trig = jnp.maximum(trig, time)
-            elem = pp[..., 4] & 0b11
-            oh_elem = _onehot(jnp.minimum(elem, spc.shape[1] - 1),
-                              spc.shape[1])
-            spc_e = _ohsel(spc[None], oh_elem)
-            interp_e = _ohsel(interp[None], oh_elem)
-            env_len = (pp[..., 0] >> 12) & 0xfff
-            nsamp = env_len * 4 * interp_e
-            dur = jnp.where(env_len == 0xfff, 0,
-                            (nsamp + spc_e - 1) // spc_e)
-            err_i = err_i | jnp.where(
-                fire & (st['n_pulses'] >= cfg.max_pulses),
-                ERR_PULSE_OVERFLOW, 0)
-            fault_i = fault_i | jnp.where(
-                fire & (st['n_pulses'] >= cfg.max_pulses),
-                FAULT_PULSE_OVERFLOW, 0)
-            if cfg.record_pulses:
-                rec_vals = jnp.stack(
-                    [j(f['cmd_time']) * jnp.ones_like(trig), trig,
-                     pp[..., 0], pp[..., 1], pp[..., 2], pp[..., 3],
-                     pp[..., 4], elem, dur], axis=-1)
-                oh_pslot = _onehot(
-                    jnp.minimum(st['n_pulses'], cfg.max_pulses - 1),
-                    cfg.max_pulses)
-                pwrite = (oh_pslot == 1) \
-                    & (fire & (st['n_pulses'] < cfg.max_pulses))[..., None]
-                FR, P = len(_REC_FIELDS), cfg.max_pulses
-                st['rec'] = jnp.where(
-                    pwrite[:, :, None, :], rec_vals[:, :, :, None],
-                    st['rec'].reshape(B, C, FR, P)).reshape(B, C, FR * P)
-            st['n_pulses'] = st['n_pulses'] + fire.astype(jnp.int32)
-
-            is_meas_pulse = fire & (elem == cfg.meas_elem)
-            err_i = err_i | jnp.where(
-                is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
-                ERR_MEAS_OVERFLOW, 0)
-            fault_i = fault_i | jnp.where(
-                is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
-                FAULT_MEAS_OVERFLOW, 0)
-            oh_mslot = _onehot(jnp.minimum(st['n_meas'],
-                                           cfg.max_meas - 1), cfg.max_meas)
-            meas_avail = jnp.where(
-                (oh_mslot == 1) & is_meas_pulse[..., None],
-                (trig + dur + cfg.meas_latency)[..., None],
-                st['meas_avail'])
-            cw_clks = 0
-            if cfg.physics and cfg.cw_horizon > 0:
-                cw_clks = (cfg.cw_horizon + spc_e - 1) // spc_e
-                meas_avail = jnp.where(
-                    (oh_mslot == 1) & (is_meas_pulse
-                                       & (env_len == 0xfff))[..., None],
-                    (trig + cw_clks + cfg.meas_latency)[..., None],
-                    meas_avail)
-            elif cfg.physics:
-                err_i = err_i | jnp.where(
-                    is_meas_pulse & (env_len == 0xfff), ERR_CW_MEAS, 0)
-            st['meas_avail'] = meas_avail
-            st['n_meas'] = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
-
-            # physics co-state: the SAME helper as _step and the
-            # straightline engine, so the physics cannot drift
-            if cfg.physics:
-                mwr = (oh_mslot == 1) & is_meas_pulse[..., None]
-                dev_updates, state_bit = _device_1q_pulse(
-                    st, cfg, dev, fire, elem, pp, trig, oh_mslot,
-                    is_meas_pulse)
-                st.update(dev_updates)
-                st['meas_state'] = jnp.where(mwr, state_bit[..., None],
-                                             st['meas_state'])
-                st['meas_amp'] = jnp.where(mwr, pp[..., 3:4],
-                                           st['meas_amp'])
-                st['meas_phase'] = jnp.where(mwr, pp[..., 1:2],
-                                             st['meas_phase'])
-                st['meas_freq'] = jnp.where(mwr, pp[..., 2:3],
-                                            st['meas_freq'])
-                st['meas_gtime'] = jnp.where(mwr, trig[..., None],
-                                             st['meas_gtime'])
-                st['meas_env'] = jnp.where(mwr, pp[..., 0:1],
-                                           st['meas_env'])
-
-        # ---- phase reset / idle -------------------------------------
-        if has(m_rst):
-            is_rst = active & j(m_rst)
-            oh_rslot = _onehot(jnp.minimum(st['n_resets'],
-                                           cfg.max_resets - 1),
-                               cfg.max_resets)
-            st['rst_time'] = jnp.where((oh_rslot == 1) & is_rst[..., None],
-                                       time[..., None], st['rst_time'])
-            fault_i = fault_i | jnp.where(
-                is_rst & (st['n_resets'] >= cfg.max_resets),
-                FAULT_RESET_OVERFLOW, 0)
-            st['n_resets'] = st['n_resets'] + is_rst.astype(jnp.int32)
-        if has(m_idle):
-            is_idle = active & j(m_idle)
-            idle_end = offset + j(f['cmd_time'])
-            err_i = err_i | jnp.where(is_idle & (time > idle_end),
-                                      ERR_MISSED_TRIG, 0)
-            idle_end = jnp.maximum(idle_end, time)
-
-        if 'op_hist' in st:
-            oh_kind = (kind[:, None]
-                       == np.arange(isa.N_KINDS)[None, :]).astype(np.int32)
-            st['op_hist'] = st['op_hist'] \
-                + active[..., None] * jnp.asarray(oh_kind)[None]
-
-        # ---- next pc / time / offset / done (pc is RELATIVE) --------
-        st['pc'] = jnp.where(active & ~j(m_done), st['pc'] + 1, st['pc'])
-        time_next = time
-        if has(m_pt):
-            time_next = jnp.where(j(m_pt), trig + cfg.pulse_load_clks,
-                                  time_next)
-        if has(m_pw | m_rst):
-            time_next = jnp.where(j(m_pw | m_rst),
-                                  time + cfg.pulse_regwrite_clks,
-                                  time_next)
-        if has(m_idle):
-            time_next = jnp.where(j(m_idle),
-                                  idle_end + cfg.pulse_load_clks,
-                                  time_next)
-        if has(m_regalu | m_incq):
-            time_next = jnp.where(j(m_regalu | m_incq),
-                                  time + cfg.alu_instr_clks, time_next)
-        st['time'] = jnp.where(active, time_next, time)
+    # ---- ALU (REG_ALU / INC_QCLK only) --------------------------
+    if has(m_alu):
+        in0 = jnp.where(j(f['in0_is_reg'] == 1),
+                        reg_read_static(f['in0_reg']), j(f['imm'])) \
+            if np.any(f['in0_is_reg'][m_alu]) else j(f['imm'])
+        in1 = jnp.int32(0)
+        if has(m_regalu):
+            in1 = reg_read_static(f['in1_reg'])
         if has(m_incq):
-            st['offset'] = jnp.where(active & j(m_incq), time - alu_res,
-                                     offset)
-        st['err'] = st['err'] | jnp.where(active, err_i, 0)
-        st['fault'] = st['fault'] | jnp.where(active, fault_i, 0)
-        st['done'] = st['done'] | (active & j(m_done))
+            in1 = jnp.where(j(m_incq), time - offset, in1)
+        alu_res = _alu_vec(j(f['alu_op']), in0, in1)
+        if has(m_regalu):
+            wr = active & j(m_regalu)
+            wr_oh = (np.asarray(f['out_reg'])[:, None]
+                     == np.arange(isa.N_REGS)[None, :])
+            regs = jnp.where(wr[..., None] & jnp.asarray(wr_oh)[None],
+                             alu_res[..., None], regs)
+            st['regs'] = regs
+    else:
+        alu_res = jnp.int32(0)
+
+    # ---- pulse latch + trigger ----------------------------------
+    pp = st['pp']
+    if has(m_pw | m_pt):
+        is_pulse = active & j(m_pw | m_pt)
+        imm_vals = np.stack([f['p_env'], f['p_phase'], f['p_freq'],
+                             f['p_amp'], f['p_cfg']], -1)   # [C, 5]
+        wen = ((f['p_wen'][:, None] >> np.arange(5)) & 1) == 1
+        if np.any(f['p_regsel']):
+            rsel = ((f['p_regsel'][:, None] >> np.arange(5)) & 1)
+            regval = reg_read_static(f['p_reg'])
+            cand = jnp.where(jnp.asarray(rsel == 1)[None],
+                             regval[..., None],
+                             jnp.asarray(imm_vals)[None]) \
+                & jnp.asarray(pmask_np)
+        else:
+            cand = jnp.asarray((imm_vals & pmask_np))[None]
+        pp = jnp.where(is_pulse[..., None] & jnp.asarray(wen)[None],
+                       cand, pp)
+        st['pp'] = pp
+
+    trig = offset + j(f['cmd_time'])
+    if has(m_pt):
+        fire = active & j(m_pt)
+        err_i = err_i | jnp.where(fire & (trig < time),
+                                  ERR_MISSED_TRIG, 0)
+        trig = jnp.maximum(trig, time)
+        elem = pp[..., 4] & 0b11
+        oh_elem = _onehot(jnp.minimum(elem, spc.shape[1] - 1),
+                          spc.shape[1])
+        spc_e = _ohsel(spc[None], oh_elem)
+        interp_e = _ohsel(interp[None], oh_elem)
+        env_len = (pp[..., 0] >> 12) & 0xfff
+        nsamp = env_len * 4 * interp_e
+        dur = jnp.where(env_len == 0xfff, 0,
+                        (nsamp + spc_e - 1) // spc_e)
+        err_i = err_i | jnp.where(
+            fire & (st['n_pulses'] >= cfg.max_pulses),
+            ERR_PULSE_OVERFLOW, 0)
+        fault_i = fault_i | jnp.where(
+            fire & (st['n_pulses'] >= cfg.max_pulses),
+            FAULT_PULSE_OVERFLOW, 0)
+        if cfg.record_pulses:
+            rec_vals = jnp.stack(
+                [j(f['cmd_time']) * jnp.ones_like(trig), trig,
+                 pp[..., 0], pp[..., 1], pp[..., 2], pp[..., 3],
+                 pp[..., 4], elem, dur], axis=-1)
+            oh_pslot = _onehot(
+                jnp.minimum(st['n_pulses'], cfg.max_pulses - 1),
+                cfg.max_pulses)
+            pwrite = (oh_pslot == 1) \
+                & (fire & (st['n_pulses'] < cfg.max_pulses))[..., None]
+            FR, P = len(_REC_FIELDS), cfg.max_pulses
+            st['rec'] = jnp.where(
+                pwrite[:, :, None, :], rec_vals[:, :, :, None],
+                st['rec'].reshape(B, C, FR, P)).reshape(B, C, FR * P)
+        st['n_pulses'] = st['n_pulses'] + fire.astype(jnp.int32)
+
+        is_meas_pulse = fire & (elem == cfg.meas_elem)
+        err_i = err_i | jnp.where(
+            is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
+            ERR_MEAS_OVERFLOW, 0)
+        fault_i = fault_i | jnp.where(
+            is_meas_pulse & (st['n_meas'] >= cfg.max_meas),
+            FAULT_MEAS_OVERFLOW, 0)
+        oh_mslot = _onehot(jnp.minimum(st['n_meas'],
+                                       cfg.max_meas - 1), cfg.max_meas)
+        meas_avail = jnp.where(
+            (oh_mslot == 1) & is_meas_pulse[..., None],
+            (trig + dur + cfg.meas_latency)[..., None],
+            st['meas_avail'])
+        cw_clks = 0
+        if cfg.physics and cfg.cw_horizon > 0:
+            cw_clks = (cfg.cw_horizon + spc_e - 1) // spc_e
+            meas_avail = jnp.where(
+                (oh_mslot == 1) & (is_meas_pulse
+                                   & (env_len == 0xfff))[..., None],
+                (trig + cw_clks + cfg.meas_latency)[..., None],
+                meas_avail)
+        elif cfg.physics:
+            err_i = err_i | jnp.where(
+                is_meas_pulse & (env_len == 0xfff), ERR_CW_MEAS, 0)
+        st['meas_avail'] = meas_avail
+        st['n_meas'] = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
+
+        # physics co-state: the SAME helper as _step and the
+        # straightline engine, so the physics cannot drift
+        if cfg.physics:
+            mwr = (oh_mslot == 1) & is_meas_pulse[..., None]
+            dev_updates, state_bit = _device_1q_pulse(
+                st, cfg, dev, fire, elem, pp, trig, oh_mslot,
+                is_meas_pulse)
+            st.update(dev_updates)
+            st['meas_state'] = jnp.where(mwr, state_bit[..., None],
+                                         st['meas_state'])
+            st['meas_amp'] = jnp.where(mwr, pp[..., 3:4],
+                                       st['meas_amp'])
+            st['meas_phase'] = jnp.where(mwr, pp[..., 1:2],
+                                         st['meas_phase'])
+            st['meas_freq'] = jnp.where(mwr, pp[..., 2:3],
+                                        st['meas_freq'])
+            st['meas_gtime'] = jnp.where(mwr, trig[..., None],
+                                         st['meas_gtime'])
+            st['meas_env'] = jnp.where(mwr, pp[..., 0:1],
+                                       st['meas_env'])
+
+    # ---- phase reset / idle -------------------------------------
+    if has(m_rst):
+        is_rst = active & j(m_rst)
+        oh_rslot = _onehot(jnp.minimum(st['n_resets'],
+                                       cfg.max_resets - 1),
+                           cfg.max_resets)
+        st['rst_time'] = jnp.where((oh_rslot == 1) & is_rst[..., None],
+                                   time[..., None], st['rst_time'])
+        fault_i = fault_i | jnp.where(
+            is_rst & (st['n_resets'] >= cfg.max_resets),
+            FAULT_RESET_OVERFLOW, 0)
+        st['n_resets'] = st['n_resets'] + is_rst.astype(jnp.int32)
+    if has(m_idle):
+        is_idle = active & j(m_idle)
+        idle_end = offset + j(f['cmd_time'])
+        err_i = err_i | jnp.where(is_idle & (time > idle_end),
+                                  ERR_MISSED_TRIG, 0)
+        idle_end = jnp.maximum(idle_end, time)
+
+    if 'op_hist' in st:
+        oh_kind = (kind[:, None]
+                   == np.arange(isa.N_KINDS)[None, :]).astype(np.int32)
+        st['op_hist'] = st['op_hist'] \
+            + active[..., None] * jnp.asarray(oh_kind)[None]
+
+    # ---- next pc / time / offset / done (pc is RELATIVE) --------
+    st['pc'] = jnp.where(active & ~j(m_done), st['pc'] + 1, st['pc'])
+    time_next = time
+    if has(m_pt):
+        time_next = jnp.where(j(m_pt), trig + cfg.pulse_load_clks,
+                              time_next)
+    if has(m_pw | m_rst):
+        time_next = jnp.where(j(m_pw | m_rst),
+                              time + cfg.pulse_regwrite_clks,
+                              time_next)
+    if has(m_idle):
+        time_next = jnp.where(j(m_idle),
+                              idle_end + cfg.pulse_load_clks,
+                              time_next)
+    if has(m_regalu | m_incq):
+        time_next = jnp.where(j(m_regalu | m_incq),
+                              time + cfg.alu_instr_clks, time_next)
+    st['time'] = jnp.where(active, time_next, time)
+    if has(m_incq):
+        st['offset'] = jnp.where(active & j(m_incq), time - alu_res,
+                                 offset)
+    st['err'] = st['err'] | jnp.where(active, err_i, 0)
+    st['fault'] = st['fault'] | jnp.where(active, fault_i, 0)
+    st['done'] = st['done'] | (active & j(m_done))
 
     return st
 
 
+def _default_pallas_interpret() -> bool:
+    """Resolve ``cfg.pallas_interpret=None``: compile the megastep
+    kernel on TPU backends, run it under the pallas interpreter
+    everywhere else (the tier-1 CPU path)."""
+    from ..ops._pallas_common import default_interpret
+    return default_interpret()
+
+
+def _pallas_mode(prog: tuple, cfg: InterpreterConfig) -> str:
+    """Which shape the pallas engine runs ``prog`` in: ``'span'`` (the
+    whole forward-jump-only program as ONE kernel call) or ``'block'``
+    (the block engine's outer loop with pallas superinstruction
+    bodies).  Derived from the jit-static program via the same field
+    checks as :func:`straightline_ineligible`, so dispatch and
+    eligibility cannot drift."""
+    soa_np = _soa_from_static(prog)
+    span = _sl_ineligible_fields(soa_np[..., _F['kind']],
+                                 soa_np[..., _F['jump_addr']],
+                                 soa_np[..., _F['func_id']], cfg) is None
+    return 'span' if span else 'block'
+
+
+def _exec_span_pallas(st0: dict, soa_np, spc, interp, meas_bits,
+                      cfg: InterpreterConfig, interpret) -> dict:
+    """The megastep span executor: the ENTIRE forward-jump-only program
+    as one Pallas call (docs/PERF.md "megastep").
+
+    Semantically :func:`_exec_straightline` with every injected bit
+    valid — the same :func:`_sl_apply_instr` per-instruction bodies,
+    traced INSIDE the kernel over shot tiles, so the per-shot carry
+    (regs / clocks / pulse params / slots / fault word) is loaded into
+    VMEM once, K instructions retire in-register, and the carry is
+    stored once: the generic engine's per-step fixed cost ``a`` (the
+    decomposition in docs/PERF.md) collapses to one launch.
+    """
+    from ..ops import exec_pallas
+    N = soa_np.shape[1]
+    rows = [{name: np.asarray(soa_np[:, i, _F[name]])
+             for name in _FIELDS}
+            for i in range(N)]
+    st = dict(st0)
+    steps = st.pop('_steps')
+
+    def body(stt, cc, hh):
+        # injected-bits path: every bit valid, no lane ever stalls
+        mv = jnp.ones(cc['meas_bits'].shape, bool)
+        stalled = jnp.zeros(stt['pc'].shape, bool)
+        for i, f in enumerate(rows):
+            stt, stalled = _sl_apply_instr(
+                stt, stalled, i, N, f, hh['spc'], hh['interp'],
+                cc['meas_bits'], mv, cfg)
+        return stt
+
+    out = exec_pallas.span_call(st, {'meas_bits': meas_bits},
+                                {'spc': spc, 'interp': interp}, body,
+                                interpret=interpret)
+    out['_steps'] = steps + N
+    return out
+
+
+def _exec_block_body_pallas(st: dict, act, rows_np, spc, interp,
+                            cfg: InterpreterConfig, interpret) -> dict:
+    """Pallas form of :func:`_exec_block_body`: one superinstruction's
+    ``[C, L, F]`` run as ONE kernel call over shot tiles, applying the
+    same :func:`_blk_apply_row` bodies in VMEM.  ``act`` rides along
+    as a tiled const (lane-activity mask from the block dispatcher)."""
+    from ..ops import exec_pallas
+    rows = [{name: np.asarray(rows_np[:, off, _F[name]])
+             for name in _FIELDS}
+            for off in range(rows_np.shape[1])]
+
+    def body(stt, cc, hh):
+        a = cc['act'] != 0
+        for f in rows:
+            stt = _blk_apply_row(stt, a, f, hh['spc'], hh['interp'], cfg)
+        return stt
+
+    return exec_pallas.span_call(st, {'act': act},
+                                 {'spc': spc, 'interp': interp}, body,
+                                 interpret=interpret)
+
+
 def _exec_blocks(st0: dict, blk: tuple, spc, interp, sync_part, meas_bits,
-                 meas_valid, cfg: InterpreterConfig, dev=None) -> dict:
+                 meas_valid, cfg: InterpreterConfig, dev=None,
+                 pallas_interpret=None) -> dict:
     """The block-compiled engine: an outer while_loop over CFG blocks.
 
     Per iteration, each core either (a) takes ONE generic :func:`_step`
@@ -2233,9 +2422,17 @@ def _exec_blocks(st0: dict, blk: tuple, spc, interp, sync_part, meas_bits,
         # a body that ends on another block's start waits an iteration)
         bid = block_id(st2['pc'])
         for k, (s, L) in enumerate(bodies):
-            st2 = _exec_block_body(
-                st2, (bid == jnp.int32(k)) & ~st2['done'],
-                soa_np[:, s:s + L, :], spc, interp, cfg, dev)
+            bact = (bid == jnp.int32(k)) & ~st2['done']
+            if pallas_interpret is None:
+                st2 = _exec_block_body(st2, bact, soa_np[:, s:s + L, :],
+                                       spc, interp, cfg, dev)
+            else:
+                # pallas rung, block mode: same rows, bodies lowered to
+                # one VMEM-resident kernel call each (_blk_apply_row is
+                # shared, so the paths are bit-identical)
+                st2 = _exec_block_body_pallas(
+                    st2, bact, soa_np[:, s:s + L, :], spc, interp, cfg,
+                    pallas_interpret)
         # (3) quiescence / pause / deadlock / exactness per _exec_loop
         same = jnp.all((st2['pc'] == st_in['pc'])
                        & (st2['time'] == st_in['time'])
@@ -2339,6 +2536,19 @@ def _run_batch_engine(soa, spc, interp, sync_part, meas_bits,
         st = _exec_blocks(st0, prog, spc, interp, sync_part, meas_bits,
                           meas_valid, cfg)
         st.pop('paused', None)
+    elif engine == 'pallas':
+        # physics/trace are pallas-ineligible (resolve_engine), so the
+        # state carry is pure int32/bool and fits the kernel boundary
+        itp = cfg.pallas_interpret
+        if itp is None:
+            itp = _default_pallas_interpret()
+        if _pallas_mode(prog, cfg) == 'span':
+            st = _exec_span_pallas(st0, _soa_from_static(prog), spc,
+                                   interp, meas_bits, cfg, itp)
+        else:
+            st = _exec_blocks(st0, prog, spc, interp, sync_part,
+                              meas_bits, meas_valid, cfg,
+                              pallas_interpret=itp)
     else:
         raise ValueError(f'unresolved engine {engine!r}')
     st.pop('phys_wait', None)
@@ -2390,6 +2600,25 @@ def _run_batch_blk_jit(spc, interp, sync_part, meas_bits, cfg, n_cores,
     counter_inc('block_trace')
     return _run_batch_engine(None, spc, interp, sync_part, meas_bits, cfg,
                              n_cores, init_regs, engine='block', prog=blk)
+
+
+@functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'pal'))
+def _run_batch_pal_jit(spc, interp, sync_part, meas_bits, cfg, n_cores,
+                       init_regs, pal=None):
+    """Injected-bits batch on the Pallas megastep engine.  ``pal`` is
+    the content-keyed static program (:func:`_soa_static`) — identical
+    programs share one cache entry, and the span/block mode pick plus
+    the in-kernel instruction specialization happen at trace time."""
+    counter_inc('pallas_trace')
+    return _run_batch_engine(None, spc, interp, sync_part, meas_bits, cfg,
+                             n_cores, init_regs, engine='pallas', prog=pal)
+
+
+def pallas_trace_count() -> int:
+    """How many times the pallas-engine executor has been traced in
+    this process (named counter ``'pallas_trace'`` — utils.profiling):
+    the retrace contract allows at most one per (bucket, engine) pair."""
+    return counter_get('pallas_trace')
 
 
 def block_trace_count() -> int:
@@ -2515,12 +2744,13 @@ def simulate_multi_batch(mps, meas_bits, init_regs=None,
         cfg = InterpreterConfig(**kw)
     else:
         cfg = replace(cfg, **kw)
-    if cfg.straightline or cfg.engine in ('straightline', 'block'):
+    if cfg.straightline or cfg.engine in ('straightline', 'block',
+                                          'pallas'):
         raise ValueError(
             'simulate_multi_batch runs the generic engine only: the '
-            'straight-line and block executors key their caches on '
-            'program content, the per-sequence compile this path '
-            'amortizes away')
+            'straight-line, block, and pallas executors key their '
+            'caches on program content, the per-sequence compile this '
+            'path amortizes away')
     if cfg.straightline is None or cfg.engine is not None:
         # normalize 'auto'/'generic' to the one legacy cache key
         cfg = replace(cfg, straightline=False, engine=None)
@@ -2657,6 +2887,10 @@ def simulate(mp, meas_bits=None, init_regs=None,
         out = _run_batch_blk_jit(spc, interp, sync_part, meas_bits[None],
                                  cfg, mp.n_cores, init_regs[None],
                                  blk=_soa_static(mp))
+    elif eng == 'pallas':
+        out = _run_batch_pal_jit(spc, interp, sync_part, meas_bits[None],
+                                 cfg, mp.n_cores, init_regs[None],
+                                 pal=_soa_static(mp))
     else:
         return _check_strict(
             _run_jit(soa, spc, interp, sync_part, meas_bits, cfg,
@@ -2692,6 +2926,11 @@ def simulate_batch(mp, meas_bits, init_regs=None,
             _run_batch_blk_jit(spc, interp, sync_part, meas_bits, cfg,
                                mp.n_cores, init_regs,
                                blk=_soa_static(mp)), strict)
+    if eng == 'pallas':
+        return _check_strict(
+            _run_batch_pal_jit(spc, interp, sync_part, meas_bits, cfg,
+                               mp.n_cores, init_regs,
+                               pal=_soa_static(mp)), strict)
     return _check_strict(
         _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
                        mp.n_cores, init_regs, program_traits(mp)), strict)

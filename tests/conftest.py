@@ -28,6 +28,19 @@ import jax
 if not _USE_REAL_PLATFORM:
     jax.config.update('jax_platforms', 'cpu')
 
+# share bench.py's persistent compilation cache (.jax_cache/, gitignored):
+# the tier-1 suite is compile-dominated on small CPU hosts, and the
+# module-boundary jax.clear_caches() below turns every re-run into a full
+# recompile without it.  Warm-cache reruns cut suite wall-clock severalfold;
+# DPROC_TEST_NO_CACHE=1 restores cold compiles (e.g. to time the compiler).
+if not os.environ.get('DPROC_TEST_NO_CACHE'):
+    _cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        '.jax_cache')
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update('jax_compilation_cache_dir', _cache_dir)
+    jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np

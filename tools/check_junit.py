@@ -23,6 +23,13 @@ FAULT_MARK = 'fault summary (trapped shots'
 # (no exemptions: even serve tests may not leak their dispatchers)
 LEAK_MARK = 'SERVICE THREAD LEAK'
 
+# test modules whose cases may NEVER skip: the pallas exec-kernel suite
+# runs under the kernel interpreter on CPU by design, so a skip there
+# means the CPU ladder rung silently stopped being exercised (the
+# test_tpu_kernels.py hardware gate is the one legitimate skip site and
+# is not listed here)
+NO_SKIP_MODULES = ('test_exec_pallas',)
+
 
 def _is_fault_test(tc) -> bool:
     ident = f'{tc.get("classname", "")}.{tc.get("name", "")}'.lower()
@@ -42,9 +49,12 @@ def main(path: str) -> int:
     if n_tests == 0:
         print('FAILURE: no tests ran')
         return 1
-    leaks, thread_leaks = [], []
+    leaks, thread_leaks, bad_skips = [], [], []
     for tc in root.iter('testcase'):
         ident = f'{tc.get("classname")}.{tc.get("name")}'
+        if tc.find('skipped') is not None and any(
+                m in tc.get('classname', '') for m in NO_SKIP_MODULES):
+            bad_skips.append(ident)
         for out in (tc.findall('system-out') + tc.findall('system-err')):
             if not out.text:
                 continue
@@ -62,10 +72,15 @@ def main(path: str) -> int:
             print(f'THREAD LEAK: {name}: execution-service dispatcher '
                   f'thread survived the test (shut the service down — '
                   f'see docs/SERVING.md)')
-    if leaks or thread_leaks:
+    if bad_skips:
+        for name in bad_skips:
+            print(f'BAD SKIP: {name}: pallas exec-kernel tests must '
+                  f'run on CPU via interpret mode, never skip (see '
+                  f'docs/PERF.md "megastep")')
+    if leaks or thread_leaks or bad_skips:
         return 1
     print(f'junit OK: {n_tests} tests, no failures, no fault leaks, '
-          f'no leaked service threads')
+          f'no leaked service threads, no gated skips')
     return 0
 
 

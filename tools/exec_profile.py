@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Exec-phase overhead decomposition (round-3 weak #3).
+"""Exec-phase overhead decomposition (round-3 weak #3, round-6 ladder).
 
 The bench's exec phase (the batched interpreter while_loop, no resolve)
 sits at ~18% of HBM peak; docs/PERF.md attributed the rest to
@@ -19,7 +19,22 @@ re-times the same program with ``steps_per_iter`` unrolled k sub-steps
 per iteration: overhead that amortizes with k is per-ITERATION
 (recoverable by unrolling); what remains is per-STEP.
 
+Round 6 extends the decomposition across the engine ladder
+(:func:`decompose_engines`, imported by bench.py as the machine-
+readable ``exec_profile`` artifact row): the same ``(a, b)`` fit per
+engine, so the pallas megastep kernel's claim — it deletes fixed
+per-step cost ``a``, not streaming cost ``b`` — is a measured number
+(``fixed_cost_reduction_vs_generic``), not an assertion.  Each
+engine's ``I`` is ITS outer-iteration count (instruction steps for
+generic, while-loop trips for block/pallas), so totals
+(``fixed_s_total = I * a``) are what compare across engines.
+
     python tools/exec_profile.py            # real chip
+
+Env knobs: BENCH_QUBITS / BENCH_DEPTH (workload), PROFILE_BATCHES,
+PROFILE_REPS, PROFILE_KS (unroll sweep), PROFILE_ENGINES (ladder
+sweep, default 'generic,block,pallas'), PROFILE_PACKED / PROFILE_SL
+(round-5 carry-layout levers, legacy sweep only).
 """
 
 import os
@@ -32,12 +47,103 @@ import json
 
 import numpy as np
 
+DEFAULT_BATCHES = (16384, 65536, 262144)
+DEFAULT_ENGINES = ('generic', 'block', 'pallas')
+
+
+def _fit(rows):
+    """Least-squares ``t/I = a + b*B`` over ``(B, t, I)`` rows."""
+    I = rows[0][2]
+    A = np.array([[1.0, B] for B, _, _ in rows])
+    y = np.array([t / I for _, t, _ in rows])
+    (a, b), *_ = np.linalg.lstsq(A, y, rcond=None)
+    return float(a), float(b), I
+
+
+def _timed_run(mp, cfg, B, reps, rng):
+    """Median warm wall-clock of one injected-bits batch + its exact
+    outer-iteration count ('steps' counts while_loop trips; the span
+    engine reports its unrolled instruction count)."""
+    import jax
+    from distributed_processor_tpu.sim.interpreter import simulate_batch
+    bits = rng.integers(0, 2, size=(B, mp.n_cores, 2))
+    out = simulate_batch(mp, bits, cfg=cfg)          # compile + warm
+    jax.block_until_ready(out['steps'])
+    steps = int(out['steps'])
+    ts = []
+    for _ in range(reps):
+        bits = rng.integers(0, 2, size=(B, mp.n_cores, 2))
+        t0 = time.perf_counter()
+        out = simulate_batch(mp, bits, cfg=cfg)
+        assert not bool(jax.block_until_ready(out['incomplete']))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)), steps
+
+
+def decompose_engines(n_qubits: int = 8, depth: int = 12,
+                      batches=DEFAULT_BATCHES, reps: int = 3,
+                      engines=DEFAULT_ENGINES) -> dict:
+    """Per-engine ``(a, b)`` decomposition — the ``exec_profile`` row.
+
+    Returns a machine-readable dict: per engine ``per_iter_fixed_s``
+    (a), ``per_shot_s`` (b), ``iterations`` (I), ``fixed_s_total``
+    (I*a — the cross-engine comparable), raw ``t_ms``; engines the
+    program/backend cannot run record ``{'ineligible': reason}``
+    instead of numbers.  Comparative ``fixed_cost_reduction_vs_generic``
+    (generic I*a over this engine's I*a) is attached per non-generic
+    engine that fit.
+    """
+    import jax
+    from bench import build_machine_program
+    from distributed_processor_tpu.sim.interpreter import (
+        InterpreterConfig, resolve_engine)
+
+    mp = build_machine_program(n_qubits, depth)
+    base = dict(max_steps=2 * mp.n_instr + 64,
+                max_pulses=int(mp.max_pulses_per_core(1)) + 4,
+                max_meas=2, max_resets=2, record_pulses=False)
+    out = {'platform': jax.devices()[0].platform,
+           'n_qubits': n_qubits, 'depth': depth, 'n_instr': mp.n_instr,
+           'batches': [int(B) for B in batches], 'reps': reps,
+           'engines': {}}
+    for eng in engines:
+        cfg = InterpreterConfig(engine=eng, **base)
+        try:
+            resolve_engine(mp, cfg)
+        except ValueError as e:
+            out['engines'][eng] = {'ineligible': str(e)[:200]}
+            continue
+        rng = np.random.default_rng(0)
+        rows = []
+        for B in batches:
+            t, steps = _timed_run(mp, cfg, int(B), reps, rng)
+            rows.append((int(B), t, steps))
+            print(f'{eng:>8} B={B:>7}: {t*1e3:8.2f} ms ({steps} iters)',
+                  file=sys.stderr)
+        a, b, I = _fit(rows)
+        out['engines'][eng] = {
+            'per_iter_fixed_s': a, 'per_shot_s': b, 'iterations': I,
+            'fixed_s_total': a * I,
+            'fixed_frac_at_largest_batch': round(
+                a / (a + b * rows[-1][0]), 4) if a + b * rows[-1][0]
+            else None,
+            't_ms': {str(B): round(t * 1e3, 2) for B, t, _ in rows},
+        }
+    gen = out['engines'].get('generic', {})
+    for eng, row in out['engines'].items():
+        if eng != 'generic' and 'fixed_s_total' in row \
+                and gen.get('fixed_s_total'):
+            row['fixed_cost_reduction_vs_generic'] = round(
+                gen['fixed_s_total'] / row['fixed_s_total'], 2) \
+                if row['fixed_s_total'] else None
+    return out
+
 
 def main():
     import jax
     from bench import build_machine_program, enable_compilation_cache
     from distributed_processor_tpu.sim.interpreter import (
-        InterpreterConfig, simulate_batch)
+        InterpreterConfig)
 
     enable_compilation_cache()
 
@@ -59,18 +165,7 @@ def main():
 
     def timed(B, k):
         cfg = InterpreterConfig(steps_per_iter=k, **base)
-        bits = rng.integers(0, 2, size=(B, mp.n_cores, 2))
-        out = simulate_batch(mp, bits, cfg=cfg)      # compile + warm
-        jax.block_until_ready(out['steps'])
-        steps = int(out['steps'])
-        ts = []
-        for r in range(reps):
-            bits = rng.integers(0, 2, size=(B, mp.n_cores, 2))
-            t0 = time.perf_counter()
-            out = simulate_batch(mp, bits, cfg=cfg)
-            assert not bool(jax.block_until_ready(out['incomplete']))
-            ts.append(time.perf_counter() - t0)
-        return float(np.median(ts)), steps
+        return _timed_run(mp, cfg, B, reps, rng)
 
     result = {'platform': jax.devices()[0].platform,
               'device': str(jax.devices()[0]),
@@ -78,17 +173,15 @@ def main():
 
     # 1. t(B) decomposition at k=1
     batches = [int(x) for x in os.environ.get(
-        'PROFILE_BATCHES', '16384,65536,262144').split(',')]
+        'PROFILE_BATCHES', ','.join(map(str, DEFAULT_BATCHES)))
+        .split(',')]
     rows = []
     for B in batches:
         t, steps = timed(B, 1)
         rows.append((B, t, steps))
         print(f'B={B:>7} k=1: {t*1e3:8.2f} ms  ({steps} steps)',
               file=sys.stderr)
-    I = rows[0][2]
-    A = np.array([[1.0, B] for B, _, _ in rows])
-    y = np.array([t / I for _, t, _ in rows])
-    (a, b), *_ = np.linalg.lstsq(A, y, rcond=None)
+    a, b, I = _fit(rows)
     B_bench = batches[-1]
     fixed_frac = a / (a + b * B_bench)
     result['per_step_fixed_s'] = float(a)
@@ -113,6 +206,12 @@ def main():
         t, _ = timed(batches[0], k)
         result['unroll_small_t_ms'][str(k)] = round(t * 1e3, 2)
         print(f'B={batches[0]} k={k}: {t*1e3:8.2f} ms', file=sys.stderr)
+
+    # 4. engine-ladder decomposition (the bench's exec_profile row)
+    engines = tuple(os.environ.get(
+        'PROFILE_ENGINES', ','.join(DEFAULT_ENGINES)).split(','))
+    result['engine_ladder'] = decompose_engines(
+        n_qubits, depth, batches=batches, reps=reps, engines=engines)
 
     print(json.dumps(result))
 
