@@ -36,7 +36,14 @@ resolve chunk in samples, default 256 — smaller trades speed for peak
 memory), BENCH_SWEEP_SHOTS/BENCH_SWEEP_BATCH/BENCH_SWEEP_SPAN (the
 dispatch-amortization row's sweep shape, defaults 131072/2048/16),
 BENCH_SERVE_REQS/BENCH_SERVE_SHOTS (the continuous-batching row's
-request count and shots per request, defaults 32/32).
+request count and shots per request, defaults 32/32),
+BENCH_SERVE_DP/BENCH_SERVE_DP_REQS/BENCH_SERVE_DP_SHOTS (the
+multi-device scaling sub-row: executor counts '1,2' and its workload,
+defaults 1,2/32/64 — runs in a forced-device-count CPU child when this
+process sees fewer devices), BENCH_SERVE_OPEN_REQS/
+BENCH_SERVE_OPEN_RATE/BENCH_SERVE_OPEN_DEVICES (the open-loop latency
+row: request count, Poisson arrival rate in Hz, optional executor
+count; defaults 48/40/single-device).
 
 Besides the final stdout line, every completed row is written
 incrementally and atomically to BENCH_ARTIFACT (default
@@ -115,7 +122,8 @@ from distributed_processor_tpu.pipeline import compile_to_machine
 from distributed_processor_tpu.models import (
     active_reset, rb_program, make_default_qchip, couplings_from_qchip)
 from distributed_processor_tpu.serve.benchmark import (
-    continuous_batching_comparison)
+    continuous_batching_comparison, multi_device_scaling,
+    open_loop_latency)
 from distributed_processor_tpu.sim.interpreter import InterpreterConfig
 from distributed_processor_tpu.sim.physics import (
     ReadoutPhysics, run_physics_batch, prepare_physics_tables)
@@ -852,6 +860,10 @@ def _degraded_rerun(attempts):
                  ('BENCH_SWEEP_SHOTS', '8192'), ('BENCH_SWEEP_BATCH', '1024'),
                  ('BENCH_SWEEP_SPAN', '4'), ('BENCH_LADDER_DEPTH', '12'),
                  ('BENCH_SERVE_REQS', '8'), ('BENCH_SERVE_SHOTS', '16'),
+                 ('BENCH_SERVE_DP_REQS', '8'),
+                 ('BENCH_SERVE_DP_SHOTS', '16'),
+                 ('BENCH_SERVE_OPEN_REQS', '12'),
+                 ('BENCH_SERVE_OPEN_RATE', '30'),
                  # exec_profile row under the kernel interpreter: tiny
                  # batches, one rep — the (a, b) fit is still real
                  ('PROFILE_BATCHES', '64,128,256'),
@@ -864,6 +876,61 @@ def _degraded_rerun(attempts):
     if rc == 0:
         os._exit(0)
     print(f'degraded CPU rerun failed (rc={rc})', file=sys.stderr)
+
+
+def _serve_scaling_row():
+    """Multi-device serve scaling: the continuous-batching workload at
+    dp=1,2,... per-device executors (``BENCH_SERVE_DP``, default
+    '1,2').  Runs in-process when this process already sees enough
+    devices (TPU hosts); otherwise shells out to a CPU child with
+    ``--xla_force_host_platform_device_count`` so the executor pool is
+    real — the ISSUE-sanctioned off-TPU path.  Either way the row
+    carries per-device dispatch counts and the bit-identity gate runs
+    before any timing (serve/benchmark.py)."""
+    import re
+    import subprocess
+    dp_list = sorted({int(x) for x in os.environ.get(
+        'BENCH_SERVE_DP', '1,2').split(',') if x})
+    n_reqs = int(os.environ.get('BENCH_SERVE_DP_REQS', 32))
+    shots = int(os.environ.get('BENCH_SERVE_DP_SHOTS', 64))
+    depth = int(os.environ.get('BENCH_SERVE_DP_DEPTH', 2))
+    if len(jax.local_devices()) >= dp_list[-1]:
+        return multi_device_scaling(dp_list=dp_list, n_reqs=n_reqs,
+                                    shots=shots, depth=depth)
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    flags = re.sub(r'--xla_force_host_platform_device_count=\d+', '',
+                   env.get('XLA_FLAGS', ''))
+    env['XLA_FLAGS'] = (flags + ' --xla_force_host_platform_device_'
+                        f'count={dp_list[-1]}').strip()
+    if not env.get('BENCH_NO_CACHE'):
+        env.setdefault('JAX_COMPILATION_CACHE_DIR', _CACHE_DIR)
+    env['PYTHONPATH'] = os.pathsep.join(
+        p for p in (os.path.dirname(os.path.abspath(__file__)),
+                    env.get('PYTHONPATH', '')) if p)
+    proc = subprocess.run(
+        [sys.executable, '-m',
+         'distributed_processor_tpu.serve.benchmark', 'scaling',
+         '--dp', ','.join(map(str, dp_list)), '--reqs', str(n_reqs),
+         '--shots', str(shots), '--depth', str(depth)],
+        env=env, capture_output=True, text=True,
+        timeout=float(os.environ.get('BENCH_SERVE_DP_TIMEOUT', 1800)))
+    if proc.returncode != 0:
+        return {'error': f'forced-device child rc={proc.returncode}: '
+                         f'{proc.stderr.strip()[-300:]}'}
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    row['forced_device_child'] = True
+    return row
+
+
+def _serve_open_loop_row():
+    """Open-loop serve latency: p50/p99 under seeded Poisson-ish
+    mixed-bucket arrivals (serve/benchmark.py)."""
+    devs = os.environ.get('BENCH_SERVE_OPEN_DEVICES')
+    return open_loop_latency(
+        n_reqs=int(os.environ.get('BENCH_SERVE_OPEN_REQS', 48)),
+        rate_hz=float(os.environ.get('BENCH_SERVE_OPEN_RATE', 40)),
+        shots=int(os.environ.get('BENCH_SERVE_OPEN_SHOTS', 16)),
+        devices=int(devs) if devs else None)
 
 
 def main():
@@ -1298,7 +1365,33 @@ def main():
         serve_row = {'error': 'timeout', 'detail': str(e)}
     except Exception as e:      # pragma: no cover - defensive
         serve_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+
+    # dp scaling sub-row: the same serve workload across 1, 2, ...
+    # per-device executors (bucket-affinity routing + work stealing);
+    # shells out to a forced-device-count CPU child when this process
+    # sees fewer devices than the largest dp
+    try:
+        serve_scaling = _timed_row(_serve_scaling_row) \
+            if secondaries else None
+    except _RowTimeout as e:
+        serve_scaling = {'error': 'timeout', 'detail': str(e)}
+    except Exception as e:      # pragma: no cover - defensive
+        serve_scaling = {'error': f'{type(e).__name__}: {e}'[:200]}
+    if isinstance(serve_row, dict):
+        serve_row['scaling_dp'] = serve_scaling
     artifact.row('continuous_batching', serve_row)
+
+    # open-loop serve latency row: p50/p99 under Poisson-ish
+    # mixed-bucket arrivals — queueing measured honestly (arrivals
+    # do not wait for completions), all shapes warmed first
+    try:
+        serve_open = _timed_row(_serve_open_loop_row) \
+            if secondaries else None
+    except _RowTimeout as e:
+        serve_open = {'error': 'timeout', 'detail': str(e)}
+    except Exception as e:      # pragma: no cover - defensive
+        serve_open = {'error': f'{type(e).__name__}: {e}'[:200]}
+    artifact.row('serve_open_loop', serve_open)
 
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
@@ -1347,6 +1440,7 @@ def main():
             'engine_ladder': ladder,
             'exec_profile': profile_row,
             'continuous_batching': serve_row,
+            'serve_open_loop': serve_open,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

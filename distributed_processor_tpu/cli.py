@@ -357,11 +357,28 @@ def cmd_trace(args):
 
 
 def cmd_serve_bench(args):
-    from .serve.benchmark import continuous_batching_comparison
-    row = continuous_batching_comparison(
-        n_reqs=args.requests, n_qubits=args.qubits, depth=args.depth,
-        shots=args.shots, seed=args.seed,
-        max_wait_ms=args.max_wait_ms)
+    from .serve.benchmark import (continuous_batching_comparison,
+                                  multi_device_scaling,
+                                  open_loop_latency)
+    if args.dp:
+        # multi-device closed-loop scaling: needs that many visible
+        # devices in THIS process (off-TPU: XLA_FLAGS=
+        # --xla_force_host_platform_device_count=N; bench.py shells
+        # out to a forced child automatically, the CLI does not)
+        row = multi_device_scaling(
+            dp_list=[int(x) for x in args.dp.split(',') if x],
+            n_reqs=args.requests, n_qubits=args.qubits,
+            depth=args.depth, shots=args.shots, seed=args.seed)
+    elif args.open_loop:
+        row = open_loop_latency(
+            n_reqs=args.requests, rate_hz=args.rate_hz,
+            n_qubits=args.qubits, shots=args.shots, seed=args.seed,
+            devices=args.devices)
+    else:
+        row = continuous_batching_comparison(
+            n_reqs=args.requests, n_qubits=args.qubits,
+            depth=args.depth, shots=args.shots, seed=args.seed,
+            max_wait_ms=args.max_wait_ms)
     print(json.dumps(row, indent=2))
 
 
@@ -552,6 +569,19 @@ def main(argv=None):
                    help='ensemble seed')
     p.add_argument('--max-wait-ms', type=float, default=100.0,
                    help='coalescing deadline passed to the service')
+    p.add_argument('--dp', metavar='N,N,...',
+                   help="multi-device scaling mode: run the closed-"
+                        "loop workload at each executor count (e.g. "
+                        "'1,2'); needs that many visible devices")
+    p.add_argument('--open-loop', action='store_true',
+                   help='open-loop latency mode: p50/p99 under '
+                        'Poisson-ish mixed-bucket arrivals')
+    p.add_argument('--rate-hz', type=float, default=40.0,
+                   help='open-loop offered arrival rate')
+    p.add_argument('--devices', type=int, default=None,
+                   help='open-loop: shard the service across this '
+                        'many devices (default: classic single-device '
+                        'path)')
     p.set_defaults(fn=cmd_serve_bench)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')

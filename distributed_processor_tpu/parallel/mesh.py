@@ -29,3 +29,26 @@ def make_mesh(n_dp: int = None, n_mp: int = 1, devices=None) -> Mesh:
 def shot_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for ``[shots, ...]`` arrays: shots over the dp axis."""
     return NamedSharding(mesh, P('dp'))
+
+
+def serving_devices(n: int = None, devices=None) -> list:
+    """Devices the serve tier shards its per-device executors across —
+    the dp axis of the serving mesh, one independent dispatcher + warm
+    jit cache per device (serve/service.py).
+
+    LOCAL devices only: an :class:`~..serve.ExecutionService` lives in
+    one host process, so pod-scale multihost serving shards SERVICES
+    across hosts (parallel/multihost.py), never executors across
+    processes.  ``n`` takes the first n devices; asking for more than
+    the host advertises is an error rather than a silent shrink (the
+    bench acceptance gates on real per-device traffic).
+    """
+    devs = list(devices) if devices is not None else jax.local_devices()
+    if n is not None:
+        if not 1 <= n <= len(devs):
+            raise ValueError(
+                f'requested {n} serving devices; host advertises '
+                f'{len(devs)} (force more on CPU with XLA_FLAGS='
+                f'--xla_force_host_platform_device_count=N)')
+        devs = devs[:n]
+    return devs

@@ -2,11 +2,16 @@
 
 The serving tier sits above the multi-program interpreter: many
 independent callers submit compiled machine programs asynchronously;
-one dispatcher coalesces them into shape-bucketed batches so they share
-``simulate_multi_batch``'s warm jit cache, then demuxes per-request
-stats back onto future-like handles.  The QubiC reference serves one
-FPGA board per user; the TPU port serves many users per chip by making
-batch occupancy a scheduling decision instead of a caller obligation.
+dispatchers coalesce them into shape-bucketed batches so they share
+``simulate_multi_batch``'s warm jit cache, then demux per-request
+stats back onto future-like handles.  With ``devices=`` the service
+shards into a pool of per-device executors — bucket-affinity routing
+keeps each bucket's warm cache hot on its home device, work stealing
+moves ripened batches to idle devices — scaling one host's serving
+throughput across its whole device mesh.  The QubiC reference serves
+one FPGA board per user; the TPU port serves many users per chip (and
+many chips per service) by making batch occupancy and device placement
+scheduling decisions instead of caller obligations.
 """
 
 from .batcher import Coalescer, bucket_key

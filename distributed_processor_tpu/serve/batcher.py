@@ -52,6 +52,11 @@ class Coalescer:
         self.max_wait_s = max_wait_s
         self._buckets: dict = {}     # key -> list[Request], arrival order
         self._depth = 0
+        # buckets that ripened elsewhere and were migrated in by work
+        # stealing: already past the latency dial once, so they stay
+        # immediately dispatchable here even when the migration dropped
+        # them below the count threshold
+        self._forced: set = set()
         # requests observed leaving via handle.cancel() (dropped during
         # pruning or lost the claim race) — the service folds this into
         # its stats() 'cancelled' count
@@ -72,6 +77,7 @@ class Coalescer:
                 if req.handle._fail(exc):
                     n += 1
         self._buckets.clear()
+        self._forced.clear()
         self._depth = 0
         return n
 
@@ -87,7 +93,7 @@ class Coalescer:
                     self._depth -= 1
                     if req.handle.cancelled():
                         self.dropped_cancelled += 1
-                elif req.deadline is not None and now >= req.deadline:
+                elif req.expired(now):
                     self._depth -= 1
                     if req.handle._fail(DeadlineError(
                             f'deadline passed while queued '
@@ -100,6 +106,7 @@ class Coalescer:
                 self._buckets[key] = kept
             else:
                 del self._buckets[key]
+                self._forced.discard(key)
         return expired
 
     def _ripe(self, reqs: list, now: float, flush: bool) -> bool:
@@ -125,7 +132,8 @@ class Coalescer:
         expired = self._prune(now)
         best_key, best_rank = None, None
         for key, reqs in self._buckets.items():
-            if not self._ripe(reqs, now, flush):
+            if key not in self._forced \
+                    and not self._ripe(reqs, now, flush):
                 continue
             head = min(reqs, key=lambda r: (-r.priority, r.seq))
             rank = (-head.priority, head.seq)
@@ -147,10 +155,83 @@ class Coalescer:
             self._buckets[best_key] = sorted(leave, key=lambda r: r.seq)
         else:
             del self._buckets[best_key]
+            self._forced.discard(best_key)
         self._depth -= len(take)
         if not batch:       # every candidate was cancelled in the race
             return None, [], expired
         return best_key, batch, expired
+
+    def ripe_keys(self, now: float = None, flush: bool = False) -> list:
+        """Keys of the buckets a dispatcher could claim right now, best
+        head first (the order ``pop_batch`` would prefer them).  A pure
+        view — no pruning, no claiming — used by the work-stealing path
+        to pick a victim bucket; staleness is fine because ``absorb``
+        re-validates every request at the re-queue boundary."""
+        if now is None:
+            now = time.monotonic()
+        ranked = []
+        for key, reqs in self._buckets.items():
+            live = [r for r in reqs if not r.handle.done()]
+            if not live:
+                continue
+            if key not in self._forced \
+                    and not self._ripe(live, now, flush):
+                continue
+            head = min(live, key=lambda r: (-r.priority, r.seq))
+            ranked.append(((-head.priority, head.seq), key))
+        return [key for _, key in sorted(ranked)]
+
+    def migrate_bucket(self, key: tuple, max_n: int) -> list:
+        """Remove up to ``max_n`` requests from ``key``'s bucket in
+        claim order (priority desc, arrival asc) WITHOUT claiming them
+        — work stealing moves whole ripened batches between per-device
+        queues, and the requests must stay cancellable in flight.  The
+        receiving queue's :meth:`absorb` re-runs the deadline/cancel
+        checks the requests aged past while queued here."""
+        reqs = self._buckets.get(key)
+        if not reqs:
+            return []
+        ranked = sorted(reqs, key=lambda r: (-r.priority, r.seq))
+        take, leave = ranked[:max_n], ranked[max_n:]
+        if leave:
+            self._buckets[key] = sorted(leave, key=lambda r: r.seq)
+        else:
+            del self._buckets[key]
+            self._forced.discard(key)
+        self._depth -= len(take)
+        return take
+
+    def absorb(self, key: tuple, reqs: list, now: float = None) -> list:
+        """Re-queue migrated requests: the stolen batch's landing point.
+
+        A request cancelled in flight is dropped (counted in
+        ``dropped_cancelled``); one whose deadline passed while it sat
+        in the victim's queue is failed with :class:`DeadlineError`
+        HERE, at the re-queue boundary, so a migrated request can never
+        outlive its ``deadline_ms`` silently.  Returns the expired
+        requests for the service's stats."""
+        if now is None:
+            now = time.monotonic()
+        expired = []
+        for req in reqs:
+            if req.handle.done():
+                if req.handle.cancelled():
+                    self.dropped_cancelled += 1
+                continue
+            if req.expired(now):
+                if req.handle._fail(DeadlineError(
+                        f'deadline passed while queued (expired during '
+                        f'work-steal migration, {now - req.submit_t:.3f}'
+                        f' s after submission)')):
+                    expired.append(req)
+                continue
+            req.migrations += 1
+            self.push(key, req)
+            # the batch already ripened at the victim; keep it
+            # immediately dispatchable here even if the migration
+            # dropped it below the count threshold
+            self._forced.add(key)
+        return expired
 
     def next_event(self, now: float = None) -> float:
         """Seconds until the next scheduled wake-up (a bucket ripening
@@ -158,6 +239,8 @@ class Coalescer:
         dispatcher's condition-wait timeout."""
         if not self._buckets:
             return None
+        if self._forced:
+            return 0.0          # a migrated-in bucket is ready now
         if now is None:
             now = time.monotonic()
         horizon = None

@@ -11,8 +11,23 @@ compile), so the ratio isolates the dispatch economics: N host
 round-trips vs ~1.  Results are asserted bit-identical before any
 number is reported.
 
-Shared by the ``continuous_batching`` row in bench.py and the
-``serve-bench`` CLI subcommand.
+Two further modes probe the multi-device pool:
+
+* :func:`multi_device_scaling` — the pod-scale headline: the same
+  closed-loop workload at dp=1/2/... per-device executors, warm,
+  bit-identity asserted per request before any timing, per-device
+  traffic recorded from ``stats()``.
+* :func:`open_loop_latency` — p50/p99 request latency under a seeded
+  Poisson-ish MIXED-bucket arrival process (open loop: arrivals do not
+  wait for completions, so queueing delay is measured honestly instead
+  of being hidden by submit backpressure).
+
+Shared by the ``continuous_batching`` / ``serve_open_loop`` rows in
+bench.py and the ``serve-bench`` CLI subcommand.  ``python -m
+distributed_processor_tpu.serve.benchmark scaling|openloop ...`` runs
+either mode standalone — bench.py uses that to force a multi-device
+CPU host (``--xla_force_host_platform_device_count``) in a subprocess
+when the parent process sees too few devices.
 """
 
 from __future__ import annotations
@@ -28,7 +43,7 @@ from ..models import active_reset, make_default_qchip, rb_ensemble
 from ..pipeline import compile_to_machine
 from ..sim.interpreter import (InterpreterConfig, multi_trace_count,
                                simulate_batch)
-from .service import ExecutionService
+from .service import ExecutionService, _normalize_cfg
 
 
 def continuous_batching_comparison(n_reqs: int = 32, n_qubits: int = 2,
@@ -109,3 +124,257 @@ def continuous_batching_comparison(n_reqs: int = 32, n_qubits: int = 2,
                 'N per-program dispatches vs coalesced multi-program '
                 'dispatch(es); results asserted bit-identical first',
     }
+
+
+def _workload(n_reqs, n_qubits, depth, shots, seed):
+    """(mps, bits, cfg): the RB many-users workload every serve bench
+    mode shares — one shape bucket, distinct program contents."""
+    qubits = [f'Q{i}' for i in range(n_qubits)]
+    qchip = make_default_qchip(n_qubits)
+    mps = [compile_to_machine(active_reset(qubits) + prog, qchip,
+                              n_qubits=n_qubits)
+           for prog in rb_ensemble(qubits, depth, n_reqs, seed=seed)]
+    bucket = max(isa.shape_bucket(mp.n_instr) for mp in mps)
+    cfg = InterpreterConfig(max_steps=2 * bucket + 64,
+                            max_pulses=bucket + 2, max_meas=2,
+                            max_resets=2, record_pulses=False)
+    rng = np.random.default_rng(seed + 11)
+    bits = [rng.integers(0, 2, size=(shots, mps[0].n_cores, 2))
+            .astype(np.int32) for _ in mps]
+    return mps, bits, cfg
+
+
+def _solo_refs(mps, bits, cfg):
+    """Warm per-request references for the bit-identity gate, under
+    the same normalized cfg the service will use."""
+    ncfg, _ = _normalize_cfg(cfg, isa.shape_bucket(mps[0].n_instr))
+    return [jax.tree.map(np.asarray, simulate_batch(mp, b, cfg=ncfg))
+            for mp, b in zip(mps, bits)]
+
+
+def _assert_bit_identical(results, refs, label):
+    mismatch = []
+    for i, (got, want) in enumerate(zip(results, refs)):
+        for k in want:
+            if not np.array_equal(np.asarray(got[k]),
+                                  np.asarray(want[k])):
+                mismatch.append(f'{i}:{k}')
+    if mismatch:
+        raise AssertionError(
+            f'{label}: service results diverged from solo dispatch: '
+            f'{mismatch[:8]}')
+
+
+def multi_device_scaling(dp_list=(1, 2), n_reqs: int = 32,
+                         n_qubits: int = 2, depth: int = 2,
+                         shots: int = 64, seed: int = 0,
+                         max_batch_programs: int = None,
+                         max_wait_ms: float = 50.0) -> dict:
+    """Pod-scale headline: warm closed-loop shots/s of the SAME
+    workload served by 1, 2, ... per-device executors.
+
+    Per dp the service is warmed on every device first (so the timed
+    round measures steady-state serving, not compiles), every request's
+    result is asserted bit-identical to its solo dispatch BEFORE the
+    timed round, and ``stats()`` must show dispatch traffic on every
+    device (the bucket is shared, so devices past the home only get
+    work via stealing).  ``host_cpu_count`` is recorded because forced
+    CPU "devices" share host cores — near-linear scaling needs real
+    parallel hardware (TPU chips, or >= dp host cores).
+    """
+    import os
+    dp_list = sorted(set(int(d) for d in dp_list))
+    if dp_list[0] < 1:
+        raise ValueError(f'dp counts must be >= 1; got {dp_list}')
+    avail = len(jax.local_devices())
+    if dp_list[-1] > avail:
+        raise ValueError(
+            f'dp={dp_list[-1]} needs that many visible devices; host '
+            f'advertises {avail} (off-TPU force them with XLA_FLAGS='
+            f'--xla_force_host_platform_device_count={dp_list[-1]})')
+    mps, bits, cfg = _workload(n_reqs, n_qubits, depth, shots, seed)
+    # enough ripe batches per round that every executor gets work:
+    # n_reqs/mb >= 2*dp for the largest dp
+    mb = max_batch_programs or max(1, n_reqs // (2 * dp_list[-1]))
+    refs = _solo_refs(mps, bits, cfg)
+    rows, base_sps = {}, None
+    for dp in dp_list:
+        svc = ExecutionService(cfg, max_batch_programs=mb,
+                               max_wait_ms=max_wait_ms,
+                               max_queue=4 * n_reqs, devices=dp)
+        try:
+            svc.warmup(mps[0], shots=shots, n_programs=mb)
+            # untimed round: residual compiles + the bit-identity gate
+            handles = [svc.submit(mp, b) for mp, b in zip(mps, bits)]
+            res = [h.result(timeout=600) for h in handles]
+            _assert_bit_identical(res, refs, f'dp{dp} pre-timing')
+            t0 = time.perf_counter()
+            handles = [svc.submit(mp, b) for mp, b in zip(mps, bits)]
+            res = [h.result(timeout=600) for h in handles]
+            dt = time.perf_counter() - t0
+            _assert_bit_identical(res, refs, f'dp{dp} timed')
+            stats = svc.stats()
+        finally:
+            svc.shutdown()
+        active = sum(1 for d in stats['devices'] if d['dispatches'] > 0)
+        if active < dp:
+            raise AssertionError(
+                f'dp{dp}: only {active}/{dp} devices saw dispatch '
+                f'traffic — routing/stealing failed to spread the load')
+        sps = n_reqs * shots / dt
+        base_sps = base_sps if base_sps is not None else sps
+        rows[f'dp{dp}'] = {
+            'warm_s': round(dt, 4),
+            'shots_per_sec': round(sps, 1),
+            'speedup_vs_dp1': round(sps / base_sps, 2),
+            'devices_active': active,
+            'steals': stats['steals'],
+            'compile_cold': stats['compile']['cold'],
+            'compile_warm': stats['compile']['warm'],
+            'per_device_dispatches': [d['dispatches']
+                                      for d in stats['devices']],
+        }
+    return {
+        'n_reqs': n_reqs, 'n_qubits': n_qubits, 'depth': depth,
+        'shots_per_req': shots, 'max_batch_programs': mb,
+        'host_cpu_count': os.cpu_count(),
+        'bit_identical': True,
+        **rows,
+        'note': 'warm closed-loop rounds, every device warmed first; '
+                'bit-identity vs solo dispatch asserted per request '
+                'before timing; shared-core CPU "devices" bound the '
+                'speedup by host_cpu_count',
+    }
+
+
+def open_loop_latency(n_reqs: int = 48, rate_hz: float = 40.0,
+                      n_qubits: int = 2, depths=(2, 12),
+                      shots: int = 16, seed: int = 0, devices=None,
+                      max_batch_programs: int = 4,
+                      max_wait_ms: float = 5.0) -> dict:
+    """Open-loop serving latency: p50/p99 under a seeded Poisson-ish
+    mixed-bucket arrival process.
+
+    Closed-loop throughput hides queueing: submitters wait for results,
+    so the queue never builds.  Here arrivals follow pre-drawn
+    exponential inter-arrival gaps (open loop — a request is submitted
+    at its scheduled time no matter how backed up the service is) and
+    each request draws one of ``depths``'s shape buckets at random, so
+    the coalescer sees the realistic interleaved-tenant mix.  Every
+    executable shape is warmed on every device first; the reported
+    p50/p99 are the service's own submit-to-done percentiles over
+    exactly these requests.  Bit-identity is asserted per request
+    before any number is reported.
+    """
+    rng = np.random.default_rng(seed)
+    per_bucket = {d: _workload(max(1, n_reqs // len(depths)), n_qubits,
+                               d, shots, seed + 17 * i)
+                  for i, d in enumerate(depths)}
+    choice = rng.integers(0, len(depths), size=n_reqs)
+    gaps = rng.exponential(1.0 / rate_hz, size=n_reqs)
+    reqs = []                       # (mp, bits, cfg, ref)
+    for i in range(n_reqs):
+        d = depths[choice[i]]
+        mps, bits, cfg = per_bucket[d]
+        j = i % len(mps)
+        reqs.append((mps[j], bits[j], cfg, d))
+    refs = {d: _solo_refs(*per_bucket[d]) for d in depths}
+    svc = ExecutionService(max_batch_programs=max_batch_programs,
+                           max_wait_ms=max_wait_ms,
+                           max_queue=4 * n_reqs, devices=devices)
+    try:
+        # warm every pow2 occupancy x bucket x device the open loop
+        # can produce (pad_programs keeps live batches on these shapes)
+        p = 1
+        pows = []
+        while p <= max_batch_programs:
+            pows.append(p)
+            p *= 2
+        for d in depths:
+            mps, _, cfg = per_bucket[d]
+            for np_ in pows:
+                svc.warmup(mps[0], shots=shots, n_programs=np_,
+                           cfg=cfg)
+        pre = svc.stats()
+        t0 = time.perf_counter()
+        handles = []
+        for (mp, bits, cfg, _d), gap in zip(reqs, gaps):
+            time.sleep(float(gap))
+            handles.append(svc.submit(mp, bits, cfg=cfg))
+        results = [h.result(timeout=600) for h in handles]
+        wall = time.perf_counter() - t0
+        stats = svc.stats()
+    finally:
+        svc.shutdown()
+    for (mp, bits, cfg, d), got, i in zip(reqs, results,
+                                          range(n_reqs)):
+        want = refs[d][i % len(refs[d])]
+        for k in want:
+            if not np.array_equal(np.asarray(got[k]),
+                                  np.asarray(want[k])):
+                raise AssertionError(
+                    f'open-loop request {i} (depth {d}) diverged from '
+                    f'solo dispatch on {k!r}')
+    occ = stats['batch_occupancy']
+    return {
+        'n_reqs': n_reqs, 'offered_rate_hz': rate_hz,
+        'achieved_rate_hz': round(n_reqs / wall, 2),
+        'depths': list(depths), 'shots_per_req': shots,
+        'n_devices': stats['n_devices'],
+        'latency_p50_ms': round(stats['latency_p50_ms'], 3),
+        'latency_p99_ms': round(stats['latency_p99_ms'], 3),
+        'mean_batch_occupancy': round(stats['coalesce_efficiency'], 2),
+        'batch_occupancy': {int(k): v for k, v in occ.items()},
+        'dispatches': stats['dispatches'],
+        'steals': stats['steals'],
+        'cold_compiles_timed': (stats['compile']['cold']
+                                - pre['compile']['cold']),
+        'bit_identical': True,
+        'note': 'seeded exponential inter-arrival gaps, mixed shape '
+                'buckets, all executable shapes warmed on all devices '
+                'first; p50/p99 are service submit-to-done percentiles',
+    }
+
+
+def _main(argv=None):
+    """Standalone entry: ``python -m distributed_processor_tpu.serve.
+    benchmark scaling|openloop ...`` prints one JSON row — bench.py
+    shells out here with ``--xla_force_host_platform_device_count`` to
+    get a multi-device pool on hosts whose parent process sees fewer
+    devices than the requested dp."""
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(
+        prog='python -m distributed_processor_tpu.serve.benchmark')
+    sub = ap.add_subparsers(dest='mode', required=True)
+    s = sub.add_parser('scaling', help='closed-loop dp scaling row')
+    s.add_argument('--dp', default='1,2')
+    s.add_argument('--reqs', type=int, default=32)
+    s.add_argument('--shots', type=int, default=64)
+    s.add_argument('--depth', type=int, default=2)
+    s.add_argument('--qubits', type=int, default=2)
+    s.add_argument('--seed', type=int, default=0)
+    o = sub.add_parser('openloop', help='open-loop latency row')
+    o.add_argument('--reqs', type=int, default=48)
+    o.add_argument('--rate', type=float, default=40.0)
+    o.add_argument('--shots', type=int, default=16)
+    o.add_argument('--depths', default='2,12')
+    o.add_argument('--devices', type=int, default=None)
+    o.add_argument('--qubits', type=int, default=2)
+    o.add_argument('--seed', type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.mode == 'scaling':
+        row = multi_device_scaling(
+            dp_list=[int(x) for x in args.dp.split(',') if x],
+            n_reqs=args.reqs, n_qubits=args.qubits, depth=args.depth,
+            shots=args.shots, seed=args.seed)
+    else:
+        row = open_loop_latency(
+            n_reqs=args.reqs, rate_hz=args.rate, n_qubits=args.qubits,
+            depths=[int(x) for x in args.depths.split(',') if x],
+            shots=args.shots, seed=args.seed, devices=args.devices)
+    print(json.dumps(row))
+
+
+if __name__ == '__main__':
+    _main()

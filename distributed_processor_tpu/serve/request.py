@@ -149,6 +149,9 @@ class Request:
     wants ``FaultError`` on trapped shots.  ``deadline`` is an absolute
     ``time.monotonic()`` value or None; ``seq`` is the service-wide
     arrival number used as the FIFO tiebreak inside a priority lane.
+    ``migrations`` counts how many times work stealing moved this
+    request between per-device queues (each hop re-runs the
+    deadline/cancel checks at the re-queue boundary).
     """
     mp: object
     meas_bits: object
@@ -161,3 +164,10 @@ class Request:
     seq: int
     handle: RequestHandle = field(default_factory=RequestHandle)
     submit_t: float = field(default_factory=time.monotonic)
+    migrations: int = 0
+
+    def expired(self, now: float) -> bool:
+        """Whether the deadline has passed as of ``now`` (False when no
+        deadline is armed) — shared by queue pruning and the stolen-
+        batch re-queue check."""
+        return self.deadline is not None and now >= self.deadline

@@ -3,13 +3,13 @@
 :class:`ExecutionService` is the in-process serving runtime over the
 interpreter: any thread calls :meth:`~ExecutionService.submit` with one
 compiled :class:`~..decoder.MachineProgram` and gets a
-:class:`~.request.RequestHandle` back immediately; a single dispatcher
-thread drains the queue, coalesces compatible requests into
-shape-bucketed batches (``batcher.bucket_key``), runs each batch
-through :func:`~..sim.interpreter.simulate_multi_batch` — hitting the
-warm jit cache keyed on the bucket SHAPE — and demuxes per-request
-stats back onto the handles.  The classic continuous-batching contract
-(vLLM-style, transplanted from token generation to shot execution):
+:class:`~.request.RequestHandle` back immediately; dispatcher threads
+drain the queues, coalesce compatible requests into shape-bucketed
+batches (``batcher.bucket_key``), run each batch through
+:func:`~..sim.interpreter.simulate_multi_batch` — hitting the warm jit
+cache keyed on the bucket SHAPE — and demux per-request stats back onto
+the handles.  The classic continuous-batching contract (vLLM-style,
+transplanted from token generation to shot execution):
 
 * latency/throughput dial: a bucket dispatches when it reaches
   ``max_batch_programs`` or its oldest member has waited
@@ -23,15 +23,31 @@ stats back onto the handles.  The classic continuous-batching contract
 * cancellation/deadlines honored at batch boundaries — the claim into
   a batch is the point of no return;
 * graceful ``shutdown(drain=True)`` flushes everything queued, then
-  joins the dispatcher.
+  joins every dispatcher.
 
-Bit-identity guarantee (tests/test_serve.py): a demuxed result equals
-the solo ``simulate_batch`` run of the same request under the same
-normalized cfg, per stat including ``fault_shots`` — the multi path is
+Multi-device sharding (``devices=``): the service runs a POOL of
+per-device executors, each owning its own coalescer queue, its own
+dispatcher thread, and — because jit cache entries are per-device — its
+own independent warm cache.  A bucket-affinity router pins each
+``bucket_key`` to a home device (least-loaded at first sight, sticky
+after) so a bucket's one-time compile is paid once and every later
+dispatch of that bucket stays warm.  Work stealing migrates a ripened
+batch to an idle device when the home is busy or backed up, accepting
+the one-time compile on the thief (counted in ``stats()`` as a cold
+hit and a steal).  The default ``devices=None`` is the single-executor
+path with NO device pinning — byte-identical to the classic
+single-device service, sharing the process default-device jit cache.
+
+Bit-identity guarantee (tests/test_serve.py, test_serve_multidevice.py):
+a demuxed result equals the solo ``simulate_batch`` run of the same
+request under the same normalized cfg, per stat including
+``fault_shots`` — REGARDLESS of which device ran it.  The multi path is
 the generic engine vmapped over programs, each program's step counter
-freezes independently, and short requests are padded by replicating
-their OWN shot rows (inert under deterministic execution, trimmed off
-in :func:`~..sim.interpreter.demux_multi_batch`).
+freezes independently; short requests are padded by replicating their
+OWN shot rows and (under ``pad_programs``) batches are padded to a
+power-of-two program count by replicating the last request — both inert
+under deterministic execution, trimmed off in
+:func:`~..sim.interpreter.demux_multi_batch`.
 """
 
 from __future__ import annotations
@@ -112,6 +128,53 @@ def _pad_shots(arr: np.ndarray, n_shots: int) -> np.ndarray:
     return np.concatenate([arr, reps], axis=0)
 
 
+def _pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
+def _bucket_label(key: tuple) -> str:
+    """Human/JSON-able label for a bucket key: the shape part only
+    (cores x instruction bucket).  Distinct cfg/geometry variants of
+    the same shape share a label — the per-bucket compile stats answer
+    "which SHAPES are hot", not "which exact executables"."""
+    return f'c{key[0]}i{key[1]}'
+
+
+class _DeviceExecutor:
+    """One device's slice of the service: its own coalescer queue, its
+    own dispatcher thread, its own (per-device, hence independent) warm
+    jit cache, and its own counters.  ``device=None`` means "do not pin"
+    — the process default device, the classic single-device path.  All
+    mutable state is guarded by the service's condition variable; the
+    executor is a struct, the service owns the concurrency."""
+
+    def __init__(self, svc: 'ExecutionService', idx: int, device,
+                 max_batch_programs: int, max_wait_s: float):
+        self.idx = idx
+        self.device = device
+        self.q = Coalescer(max_batch_programs, max_wait_s)
+        self.busy = False            # a batch is executing right now
+        self.dispatches = 0
+        self.programs_dispatched = 0
+        self.occupancy = collections.Counter()          # batch size -> n
+        self.engine_dispatches = collections.Counter()  # engine -> n
+        self.steals = 0              # batches this executor stole
+        self.stolen_from = 0         # batches stolen FROM this executor
+        self.cold_compiles = 0
+        self.warm_hits = 0
+        # (bucket_key, shape signature) dispatched at least once on
+        # this device: the host-side cold/warm compile classifier (the
+        # jit cache itself keys on the same shapes, per device)
+        self.seen = set()
+        self.thread = threading.Thread(
+            target=svc._dispatch_loop, args=(self,),
+            name=f'{DISPATCH_THREAD_PREFIX}-{svc.name}-d{idx}',
+            daemon=True)
+
+    def label(self) -> str:
+        return 'default' if self.device is None else str(self.device)
+
+
 class ExecutionService:
     """In-process continuous-batching front end over the interpreter.
 
@@ -130,8 +193,8 @@ class ExecutionService:
         latency/throughput dial: 0 approximates per-request dispatch,
         large values maximize occupancy.
     max_queue:
-        Admission bound on TOTAL queued requests across buckets;
-        ``submit`` raises :class:`QueueFullError` beyond it.
+        Admission bound on TOTAL queued requests across buckets and
+        devices; ``submit`` raises :class:`QueueFullError` beyond it.
     singleton_engine:
         Optional engine selector ('auto' / 'straightline' / 'block' /
         'pallas' / 'generic') for batches that end up with a single
@@ -139,12 +202,31 @@ class ExecutionService:
         ride :func:`simulate_batch` and the full engine ladder instead.
         Default None keeps everything on the one shared multi-program
         cache (the right call for compile-bound fleets).
+    devices:
+        How many executors the service shards across.  ``None``
+        (default): ONE executor with no device pinning — the classic
+        single-device service, regardless of how many devices the host
+        advertises.  An int n / ``'all'``: one executor pinned to each
+        of the first n / all local devices
+        (:func:`~..parallel.mesh.serving_devices`).  Or an explicit
+        sequence of jax devices.
+    work_stealing:
+        Allow an idle executor to migrate a ripened batch away from a
+        busy or backed-up home device (one-time compile on the thief,
+        counted in stats).  Default True; meaningless with one executor.
+    pad_programs:
+        Pad each multi-program batch to a power-of-two program count by
+        replicating the last request (inert, trimmed at demux) so
+        odd-sized remainders and stolen batches reuse the pow2-shaped
+        executables instead of compiling one per batch size.  Default
+        True.
     """
 
     def __init__(self, cfg: InterpreterConfig = None, *,
                  max_batch_programs: int = 16, max_wait_ms: float = 2.0,
                  max_queue: int = 256, singleton_engine: str = None,
-                 name: str = None):
+                 name: str = None, devices=None,
+                 work_stealing: bool = True, pad_programs: bool = True):
         if max_batch_programs < 1:
             raise ValueError('max_batch_programs must be >= 1')
         if max_queue < 1:
@@ -154,11 +236,34 @@ class ExecutionService:
                 f'singleton_engine must be one of {ENGINES} or None; '
                 f'got {singleton_engine!r}')
         self._default_cfg = cfg
+        self.max_batch_programs = max_batch_programs
         self.max_queue = max_queue
         self.singleton_engine = singleton_engine
+        self.pad_programs = pad_programs
         self.name = name or f'svc{next(_SERVICE_SEQ)}'
+        if devices is None:
+            dev_list = [None]
+        elif isinstance(devices, bool):
+            raise ValueError('devices must be None, an int, "all", or '
+                             'a sequence of jax devices')
+        elif isinstance(devices, int):
+            from ..parallel.mesh import serving_devices
+            dev_list = serving_devices(devices)
+        elif devices == 'all':
+            from ..parallel.mesh import serving_devices
+            dev_list = serving_devices()
+        else:
+            dev_list = list(devices)
+            if not dev_list:
+                raise ValueError('devices sequence must be non-empty')
         self._cv = threading.Condition()
-        self._q = Coalescer(max_batch_programs, max_wait_ms / 1e3)
+        self._executors = [
+            _DeviceExecutor(self, i, d, max_batch_programs,
+                            max_wait_ms / 1e3)
+            for i, d in enumerate(dev_list)]
+        self._stealing = bool(work_stealing) and len(self._executors) > 1
+        self._home = {}                        # bucket_key -> executor idx
+        self._home_counts = collections.Counter()
         self._seq = itertools.count()
         self._closing = False
         self._drain = True
@@ -171,13 +276,14 @@ class ExecutionService:
         self._rejected = 0        # QueueFullError at admission
         self._dispatches = 0
         self._programs_dispatched = 0
+        self._steals = 0
+        self._warmups = 0
         self._occupancy = collections.Counter()   # batch size -> count
         self._engine_dispatches = collections.Counter()  # engine -> count
+        self._bucket_compiles = {}     # bucket label -> {'cold','warm'}
         self._latency_s = collections.deque(maxlen=4096)
-        self._thread = threading.Thread(
-            target=self._dispatch_loop,
-            name=f'{DISPATCH_THREAD_PREFIX}-{self.name}', daemon=True)
-        self._thread.start()
+        for ex in self._executors:
+            ex.thread.start()
 
     # -- submission ------------------------------------------------------
 
@@ -257,7 +363,7 @@ class ExecutionService:
             if self._closing:
                 raise ServiceClosedError(
                     f'service {self.name!r} is shut down')
-            if len(self._q) >= self.max_queue:
+            if self._depth_locked() >= self.max_queue:
                 self._rejected += 1
                 profiling.counter_inc('serve.rejected')
                 raise QueueFullError(
@@ -266,40 +372,132 @@ class ExecutionService:
                           init_regs=init_regs, cfg=cfg, strict=strict,
                           n_shots=n_shots, priority=priority,
                           deadline=deadline, seq=next(self._seq))
-            self._q.push(key, req)
+            self._route_locked(key).q.push(key, req)
             self._submitted += 1
             profiling.counter_inc('serve.submitted')
             self._cv.notify_all()
         return req.handle
 
+    # -- routing / stealing ----------------------------------------------
+
+    def _depth_locked(self) -> int:
+        return sum(len(ex.q) for ex in self._executors)
+
+    def _route_locked(self, key) -> _DeviceExecutor:
+        """Bucket-affinity router: the first sighting of a bucket pins
+        it to the least-loaded executor (queue depth, then how many
+        home buckets it already carries, then index — deterministic);
+        every later submission of the bucket lands on the same home so
+        its warm per-device jit cache stays hot."""
+        idx = self._home.get(key)
+        if idx is None:
+            idx = min(self._executors,
+                      key=lambda ex: (len(ex.q),
+                                      self._home_counts[ex.idx],
+                                      ex.idx)).idx
+            self._home[key] = idx
+            self._home_counts[idx] += 1
+        return self._executors[idx]
+
+    def _try_steal_locked(self, thief: _DeviceExecutor, now: float,
+                          flush: bool = False) -> bool:
+        """Migrate one ripened batch from the deepest eligible victim
+        queue into ``thief``'s.  A victim is eligible when it has a
+        ripe bucket it cannot serve promptly: it is mid-execution, or
+        more than one bucket ripened at once (or the service is
+        draining, when any backlog is fair game).  Returns True when
+        requests actually moved; the thief's own pop_batch then claims
+        them (``absorb`` re-ran the deadline/cancel checks — a stolen
+        request never outlives its deadline silently)."""
+        best = None
+        for v in self._executors:
+            if v is thief or len(v.q) == 0:
+                continue
+            ripe = v.q.ripe_keys(now, flush=flush)
+            if not ripe:
+                continue
+            if not (flush or v.busy or len(ripe) > 1):
+                continue
+            if best is None or len(v.q) > len(best[0].q):
+                best = (v, ripe[0])
+        if best is None:
+            return False
+        victim, key = best
+        reqs = victim.q.migrate_bucket(key, self.max_batch_programs)
+        if not reqs:
+            return False
+        victim.stolen_from += 1
+        thief.steals += 1
+        self._steals += 1
+        profiling.counter_inc('serve.steals')
+        expired = thief.q.absorb(key, reqs, now)
+        self._count_expired_locked(expired)
+        return True
+
+    def _count_expired_locked(self, expired) -> None:
+        if expired:
+            self._expired += len(expired)
+            profiling.counter_inc('serve.expired', len(expired))
+
     # -- dispatcher ------------------------------------------------------
 
-    def _dispatch_loop(self):
+    def _dispatch_loop(self, ex: _DeviceExecutor):
         while True:
             with self._cv:
                 while True:
                     flush = self._closing and self._drain
-                    key, batch, expired = self._q.pop_batch(flush=flush)
-                    if expired:
-                        self._expired += len(expired)
-                        profiling.counter_inc('serve.expired',
-                                              len(expired))
+                    key, batch, expired = ex.q.pop_batch(flush=flush)
+                    self._count_expired_locked(expired)
+                    if key is None and self._stealing:
+                        if self._try_steal_locked(ex, time.monotonic(),
+                                                  flush=flush):
+                            continue     # absorbed work: pop it now
                     if key is not None:
+                        ex.busy = True
+                        # wake idle peers: the remaining ripe buckets
+                        # just became stealable
+                        self._cv.notify_all()
                         break
                     if self._closing and (not self._drain
-                                          or len(self._q) == 0):
+                                          or self._depth_locked() == 0):
                         return
-                    timeout = self._q.next_event()
-                    if timeout is None or timeout > 0:
+                    timeout = self._wait_timeout_locked(
+                        ex, time.monotonic())
+                    if timeout is None:
+                        self._cv.wait()
+                    elif timeout > 0:
                         self._cv.wait(timeout)
-                    # timeout == 0.0: a bucket is already ripe, loop
-            self._execute(key, batch)
+                    else:
+                        # something is ripe somewhere but not claimable
+                        # by this executor yet: bounded re-check
+                        self._cv.wait(0.002)
+            try:
+                self._execute(ex, key, batch)
+            finally:
+                with self._cv:
+                    ex.busy = False
+                    self._cv.notify_all()
 
-    def _execute(self, key, batch):
+    def _wait_timeout_locked(self, ex: _DeviceExecutor,
+                             now: float) -> float:
+        """Condition-wait horizon: this executor's next queue event,
+        or — with stealing on — any peer's (a peer's bucket ripening
+        may become this executor's work)."""
+        t = ex.q.next_event(now)
+        if self._stealing:
+            for v in self._executors:
+                if v is ex:
+                    continue
+                tv = v.q.next_event(now)
+                if tv is not None:
+                    t = tv if t is None else min(t, tv)
+        return t
+
+    def _execute(self, ex: _DeviceExecutor, key, batch):
         cfg = key[-1]
         t0 = time.monotonic()
         try:
-            results = self._run_batch(batch, cfg)
+            results = self._run_batch(ex, key, batch, cfg)
         except Exception as exc:      # noqa: BLE001 - fail the batch, live on
             with self._cv:
                 self._failed += len(batch)
@@ -322,6 +520,9 @@ class ExecutionService:
             self._dispatches += 1
             self._programs_dispatched += len(batch)
             self._occupancy[len(batch)] += 1
+            ex.dispatches += 1
+            ex.programs_dispatched += len(batch)
+            ex.occupancy[len(batch)] += 1
             self._completed += completed
             self._failed += failed
             for req in batch:
@@ -331,56 +532,163 @@ class ExecutionService:
         profiling.counter_inc('serve.batch_ms',
                               int((now - t0) * 1e3))
 
-    def _run_batch(self, batch, cfg):
-        """Execute one coalesced batch; returns per-request stats dicts
-        in batch order (host numpy, padding trimmed)."""
+    def _run_batch(self, ex: _DeviceExecutor, key, batch, cfg):
+        """Execute one coalesced batch on ``ex``'s device; returns
+        per-request stats dicts in batch order (host numpy, padding
+        trimmed)."""
         if len(batch) == 1 and self.singleton_engine is not None:
             req = batch[0]
             scfg = replace(cfg, engine=self.singleton_engine)
-            self._count_engine(resolve_engine(req.mp, scfg))
+            eng = resolve_engine(req.mp, scfg)
+            self._count_engine_locked(ex, eng)
+            self._classify_compile(ex, key, ('solo', eng, req.n_shots,
+                                             req.init_regs is None))
             out = simulate_batch(req.mp, req.meas_bits, req.init_regs,
-                                 cfg=scfg)
+                                 cfg=scfg, jax_device=ex.device)
             return [jax.tree.map(np.asarray, out)]
         B = max(r.n_shots for r in batch)
-        meas = np.stack([_pad_shots(r.meas_bits, B) for r in batch])
+        P = _pow2(len(batch)) if self.pad_programs else len(batch)
+        pad = P - len(batch)
+        # program-count padding replicates the LAST request: its lanes
+        # are deterministic copies, and demux only reads the first
+        # len(batch) program slots — inert, but it keeps odd-sized
+        # remainders and stolen batches on the pow2-shaped executables
+        meas = np.stack(
+            [_pad_shots(r.meas_bits, B) for r in batch]
+            + [_pad_shots(batch[-1].meas_bits, B)] * pad)
         if any(r.init_regs is not None for r in batch):
-            init = np.stack([
-                _pad_shots(r.init_regs, B) if r.init_regs is not None
-                else np.zeros((B, r.mp.n_cores, isa.N_REGS), np.int32)
-                for r in batch])
+            rows = [_pad_shots(r.init_regs, B) if r.init_regs is not None
+                    else np.zeros((B, r.mp.n_cores, isa.N_REGS), np.int32)
+                    for r in batch]
+            init = np.stack(rows + [rows[-1]] * pad)
         else:
             init = None
-        mmp = stack_machine_programs([r.mp for r in batch],
-                                     pad_to=key_bucket(batch))
-        self._count_engine('generic')
-        out = simulate_multi_batch(mmp, meas, init, cfg=cfg)
+        mmp = stack_machine_programs(
+            [r.mp for r in batch] + [batch[-1].mp] * pad,
+            pad_to=key_bucket(batch))
+        self._count_engine_locked(ex, 'generic')
+        self._classify_compile(ex, key, ('multi', P, B, init is None))
+        out = simulate_multi_batch(mmp, meas, init, cfg=cfg,
+                                   jax_device=ex.device)
         host = jax.tree.map(np.asarray, out)
         return [demux_multi_batch(host, i, n_shots=r.n_shots)
                 for i, r in enumerate(batch)]
 
-    def _count_engine(self, eng: str):
+    def _count_engine_locked(self, ex: _DeviceExecutor, eng: str):
         """Record which ladder rung a dispatch actually ran on (the
         multi path is generic by construction; the singleton path
         resolves 'auto' the same way ``simulate_batch`` will)."""
         with self._cv:
             self._engine_dispatches[eng] += 1
+            ex.engine_dispatches[eng] += 1
         profiling.counter_inc(f'serve.engine.{eng}')
+
+    def _classify_compile(self, ex: _DeviceExecutor, key,
+                          shape_sig: tuple) -> bool:
+        """Host-side cold/warm jit classification: the first dispatch
+        of a (bucket, shape signature) on a device is a compile, every
+        repeat is a warm cache hit — the same shapes the jit cache
+        itself keys on, tracked per executor because cache entries are
+        per device.  (An estimate: a process-shared persistent compile
+        cache can make a "cold" entry cheap, and content-keyed
+        singleton engines can recompile under an unchanged signature.)
+        Groundwork for the ROADMAP AOT-warmup item via :meth:`warmup`.
+        """
+        sig = (key, shape_sig)
+        with self._cv:
+            cold = sig not in ex.seen
+            if cold:
+                ex.seen.add(sig)
+                ex.cold_compiles += 1
+            else:
+                ex.warm_hits += 1
+            per = self._bucket_compiles.setdefault(
+                _bucket_label(key), {'cold': 0, 'warm': 0})
+            per['cold' if cold else 'warm'] += 1
+        profiling.counter_inc(
+            'serve.compile.cold' if cold else 'serve.compile.warm')
+        return cold
+
+    # -- warmup ----------------------------------------------------------
+
+    def warmup(self, mp, *, shots: int = 1, n_programs: int = None,
+               cfg: InterpreterConfig = None) -> list:
+        """Pre-compile ``mp``'s bucket on EVERY device executor by
+        running one representative batch synchronously, so the first
+        real request in the bucket does not eat the XLA compile inside
+        its latency budget (the ROADMAP "AOT warmup" groundwork — and
+        the reason cold/warm hits are tracked at all).
+
+        The jit cache keys on the full batch SHAPE — (programs, shots,
+        cores, instruction bucket, cfg) — so warm coverage needs
+        representative ``shots`` and ``n_programs`` (default
+        ``max_batch_programs``; padded to a power of two exactly like
+        live dispatch when ``pad_programs``).  Counted in
+        ``stats()['compile']`` and the ``serve.compile.*`` counters
+        like any dispatch.  Returns per-executor
+        ``{'device', 'cold'}`` dicts."""
+        with self._cv:
+            if self._closing:
+                raise ServiceClosedError(
+                    f'service {self.name!r} is shut down')
+        n_programs = n_programs if n_programs is not None \
+            else self.max_batch_programs
+        n_programs = max(1, min(n_programs, self.max_batch_programs))
+        base = cfg if cfg is not None else self._default_cfg
+        ncfg, _ = _normalize_cfg(base, isa.shape_bucket(mp.n_instr))
+        meas = np.zeros((int(shots), mp.n_cores, ncfg.max_meas),
+                        np.int32)
+        key = bucket_key(mp, ncfg)
+        batch = [Request(mp=mp, meas_bits=meas, init_regs=None,
+                         cfg=ncfg, strict=False, n_shots=int(shots),
+                         priority=0, deadline=None, seq=-1)
+                 for _ in range(n_programs)]
+        report = []
+        for ex in self._executors:
+            seen0 = ex.cold_compiles
+            self._run_batch(ex, key, batch, ncfg)
+            with self._cv:
+                self._warmups += 1
+                cold = ex.cold_compiles > seen0
+            profiling.counter_inc('serve.warmups')
+            report.append({'device': ex.label(), 'cold': cold})
+        return report
 
     # -- introspection / lifecycle ---------------------------------------
 
     def stats(self) -> dict:
-        """Snapshot of the service counters: queue depth, batch
-        occupancy histogram, coalescing efficiency (programs per
-        dispatch), and p50/p99 submit-to-done latency in ms."""
+        """Snapshot of the service counters: aggregate queue depth,
+        batch occupancy histogram, coalescing efficiency (programs per
+        dispatch), p50/p99 submit-to-done latency in ms, cold/warm jit
+        compile hits per bucket, and a per-device breakdown (queue
+        depth, occupancy, steals, compile hits) for the multi-device
+        pool."""
         with self._cv:
             lat = np.asarray(self._latency_s, np.float64)
             occ = dict(sorted(self._occupancy.items()))
+            devices = [{
+                'device': ex.label(),
+                'index': ex.idx,
+                'busy': ex.busy,
+                'queue_depth': len(ex.q),
+                'dispatches': ex.dispatches,
+                'programs_dispatched': ex.programs_dispatched,
+                'batch_occupancy': dict(sorted(ex.occupancy.items())),
+                'engine_dispatches': dict(sorted(
+                    ex.engine_dispatches.items())),
+                'steals': ex.steals,
+                'stolen_from': ex.stolen_from,
+                'cold_compiles': ex.cold_compiles,
+                'warm_hits': ex.warm_hits,
+                'home_buckets': self._home_counts[ex.idx],
+            } for ex in self._executors]
             snap = {
-                'queue_depth': len(self._q),
+                'queue_depth': self._depth_locked(),
                 'submitted': self._submitted,
                 'completed': self._completed,
                 'failed': self._failed,
-                'cancelled': self._cancelled + self._q.dropped_cancelled,
+                'cancelled': self._cancelled + sum(
+                    ex.q.dropped_cancelled for ex in self._executors),
                 'expired': self._expired,
                 'rejected': self._rejected,
                 'dispatches': self._dispatches,
@@ -391,6 +699,19 @@ class ExecutionService:
                 'coalesce_efficiency': (
                     self._programs_dispatched / self._dispatches
                     if self._dispatches else 0.0),
+                'n_devices': len(self._executors),
+                'work_stealing': self._stealing,
+                'steals': self._steals,
+                'warmups': self._warmups,
+                'compile': {
+                    'cold': sum(ex.cold_compiles
+                                for ex in self._executors),
+                    'warm': sum(ex.warm_hits
+                                for ex in self._executors),
+                    'per_bucket': {k: dict(v) for k, v in sorted(
+                        self._bucket_compiles.items())},
+                },
+                'devices': devices,
             }
         if lat.size:
             snap['latency_p50_ms'] = float(np.percentile(lat, 50) * 1e3)
@@ -402,22 +723,27 @@ class ExecutionService:
 
     def shutdown(self, drain: bool = True, timeout: float = None):
         """Stop the service.  ``drain=True`` (default) flushes every
-        queued request through dispatch first; ``drain=False`` fails
-        queued requests with :class:`CancelledError` (in-flight batches
-        still complete).  Joins the dispatcher thread (up to
-        ``timeout`` seconds); idempotent."""
+        queued request through dispatch first (all executors keep
+        draining — including by stealing — until every queue is empty);
+        ``drain=False`` fails queued requests with
+        :class:`CancelledError` (in-flight batches still complete).
+        Joins every dispatcher thread (up to ``timeout`` seconds EACH);
+        idempotent."""
         with self._cv:
             if not self._closing:
                 self._closing = True
                 self._drain = drain
                 if not drain:
-                    n = self._q.cancel_all(CancelledError(
-                        f'service {self.name!r} shut down without '
-                        f'draining'))
-                    self._cancelled += n
-                    profiling.counter_inc('serve.cancelled', n)
+                    for ex in self._executors:
+                        n = ex.q.cancel_all(CancelledError(
+                            f'service {self.name!r} shut down without '
+                            f'draining'))
+                        self._cancelled += n
+                        if n:
+                            profiling.counter_inc('serve.cancelled', n)
             self._cv.notify_all()
-        self._thread.join(timeout)
+        for ex in self._executors:
+            ex.thread.join(timeout)
 
     def __enter__(self):
         return self

@@ -2711,8 +2711,16 @@ def make_span_runner(step):
 
 def simulate_multi_batch(mps, meas_bits, init_regs=None,
                          cfg: InterpreterConfig = None, pad_to: int = None,
-                         **kw) -> dict:
+                         jax_device=None, **kw) -> dict:
     """Execute N programs x B shots in one compiled call.
+
+    ``jax_device`` pins the dispatch to one accelerator device (inputs
+    here are uncommitted host arrays, so ``jax.default_device`` decides
+    placement) — and because pjit cache entries are per-device, each
+    device pinned this way grows its own independent warm cache.  The
+    multi-device serving tier (serve/service.py) gives every executor
+    a hot cache exactly this way.  NOT ``cfg.device``, which selects
+    the physics co-state model.
 
     ``mps``: a list of :class:`~..decoder.MachineProgram` (stacked here
     with shape-bucketed DONE padding — see ``decoder.
@@ -2735,6 +2743,10 @@ def simulate_multi_batch(mps, meas_bits, init_regs=None,
     on program content, which is exactly the compile-per-sequence cost
     being amortized away (``straightline=True`` raises).
     """
+    if jax_device is not None:
+        with jax.default_device(jax_device):
+            return simulate_multi_batch(mps, meas_bits, init_regs,
+                                        cfg=cfg, pad_to=pad_to, **kw)
     from ..decoder import MultiMachineProgram, stack_machine_programs
     mmp = mps if isinstance(mps, MultiMachineProgram) \
         else stack_machine_programs(mps, pad_to=pad_to)
@@ -2901,11 +2913,18 @@ def simulate(mp, meas_bits=None, init_regs=None,
 
 
 def simulate_batch(mp, meas_bits, init_regs=None,
-                   cfg: InterpreterConfig = None, **kw) -> dict:
+                   cfg: InterpreterConfig = None, jax_device=None,
+                   **kw) -> dict:
     """Batch :func:`simulate` over a leading shot axis of ``meas_bits``
     (``[n_shots, n_cores, n_meas]``) — the reference re-runs shots on the
     host; here shots are the leading axis of every state array on the
-    accelerator.  ``init_regs`` may also carry the shot/sweep-point axis."""
+    accelerator.  ``init_regs`` may also carry the shot/sweep-point axis.
+    ``jax_device`` pins dispatch (and the jit cache entry) to one device
+    — see :func:`simulate_multi_batch`."""
+    if jax_device is not None:
+        with jax.default_device(jax_device):
+            return simulate_batch(mp, meas_bits, init_regs, cfg=cfg,
+                                  **kw)
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
     cfg, strict = _fault_policy(cfg)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)

@@ -368,3 +368,28 @@ def test_concurrent_submitter_stress():
             if ck not in solo_cache:
                 solo_cache[ck] = _solo(mp, bits, cfg)
             _assert_same(got, solo_cache[ck], f't{tid}')
+
+
+def test_single_device_stats_surface():
+    """The default (no ``devices=``) service is one unpinned executor:
+    stats() still carries the multi-device surface — one device row,
+    zero steals, cold/warm compile classification — so dashboards need
+    no schema fork between laptop and pod deployments."""
+    mps = _ensemble(2, 2, 1, seed=21)
+    cfg = _cfg_for(mps)
+    bits = np.zeros((2, mps[0].n_cores, 2), np.int32)
+    with ExecutionService(cfg, max_batch_programs=4,
+                          max_wait_ms=2.0) as svc:
+        svc.submit(mps[0], bits).result(timeout=300)
+        svc.submit(mps[0], bits).result(timeout=300)
+        stats = svc.stats()
+    assert stats['n_devices'] == 1
+    assert stats['work_stealing'] is False
+    assert stats['steals'] == 0 and stats['warmups'] == 0
+    assert len(stats['devices']) == 1
+    dev = stats['devices'][0]
+    assert dev['device'] == 'default' and dev['home_buckets'] == 1
+    assert dev['dispatches'] == stats['dispatches'] == 2
+    comp = stats['compile']
+    assert comp['cold'] == 1 and comp['warm'] == 1
+    assert sum(v['cold'] for v in comp['per_bucket'].values()) == 1

@@ -30,6 +30,14 @@ LEAK_MARK = 'SERVICE THREAD LEAK'
 # is not listed here)
 NO_SKIP_MODULES = ('test_exec_pallas',)
 
+# the multi-device serve suite may skip ONLY on a genuinely
+# single-device host: its module-level skip reason records how many
+# devices the host advertised, and anything other than exactly one
+# means the pool plumbing silently stopped being exercised (the
+# serve-tier mirror of the pallas BAD SKIP gate above)
+MULTIDEV_MODULE = 'test_serve_multidevice'
+MULTIDEV_OK_SKIP = 'host advertises 1 device'
+
 
 def _is_fault_test(tc) -> bool:
     ident = f'{tc.get("classname", "")}.{tc.get("name", "")}'.lower()
@@ -49,12 +57,19 @@ def main(path: str) -> int:
     if n_tests == 0:
         print('FAILURE: no tests ran')
         return 1
-    leaks, thread_leaks, bad_skips = [], [], []
+    leaks, thread_leaks, bad_skips, dev_skips = [], [], [], []
     for tc in root.iter('testcase'):
         ident = f'{tc.get("classname")}.{tc.get("name")}'
-        if tc.find('skipped') is not None and any(
+        skipped = tc.find('skipped')
+        if skipped is not None and any(
                 m in tc.get('classname', '') for m in NO_SKIP_MODULES):
             bad_skips.append(ident)
+        if skipped is not None \
+                and MULTIDEV_MODULE in tc.get('classname', ''):
+            reason = (skipped.get('message') or '') + \
+                (skipped.text or '')
+            if MULTIDEV_OK_SKIP not in reason:
+                dev_skips.append(ident)
         for out in (tc.findall('system-out') + tc.findall('system-err')):
             if not out.text:
                 continue
@@ -77,7 +92,13 @@ def main(path: str) -> int:
             print(f'BAD SKIP: {name}: pallas exec-kernel tests must '
                   f'run on CPU via interpret mode, never skip (see '
                   f'docs/PERF.md "megastep")')
-    if leaks or thread_leaks or bad_skips:
+    if dev_skips:
+        for name in dev_skips:
+            print(f'BAD SKIP: {name}: multi-device serve tests '
+                  f'skipped on a host advertising >1 device — the '
+                  f'executor pool stopped being exercised (see '
+                  f'docs/SERVING.md "multi-device")')
+    if leaks or thread_leaks or bad_skips or dev_skips:
         return 1
     print(f'junit OK: {n_tests} tests, no failures, no fault leaks, '
           f'no leaked service threads, no gated skips')
