@@ -122,8 +122,8 @@ from distributed_processor_tpu.pipeline import compile_to_machine
 from distributed_processor_tpu.models import (
     active_reset, rb_program, make_default_qchip, couplings_from_qchip)
 from distributed_processor_tpu.serve.benchmark import (
-    continuous_batching_comparison, multi_device_scaling,
-    open_loop_latency)
+    availability_under_chaos, continuous_batching_comparison,
+    multi_device_scaling, open_loop_latency)
 from distributed_processor_tpu.sim.interpreter import InterpreterConfig
 from distributed_processor_tpu.sim.physics import (
     ReadoutPhysics, run_physics_batch, prepare_physics_tables)
@@ -864,6 +864,8 @@ def _degraded_rerun(attempts):
                  ('BENCH_SERVE_DP_SHOTS', '16'),
                  ('BENCH_SERVE_OPEN_REQS', '12'),
                  ('BENCH_SERVE_OPEN_RATE', '30'),
+                 ('BENCH_CHAOS_REQS', '24'),
+                 ('BENCH_CHAOS_RATE', '40'),
                  # exec_profile row under the kernel interpreter: tiny
                  # batches, one rep — the (a, b) fit is still real
                  ('PROFILE_BATCHES', '64,128,256'),
@@ -931,6 +933,26 @@ def _serve_open_loop_row():
         rate_hz=float(os.environ.get('BENCH_SERVE_OPEN_RATE', 40)),
         shots=int(os.environ.get('BENCH_SERVE_OPEN_SHOTS', 16)),
         devices=int(devs) if devs else None)
+
+
+def _serve_chaos_row():
+    """Availability under chaos: goodput fraction + p99 latency of an
+    open-loop arrival stream while seeded crash/hang/slowdown faults
+    are injected under the service's ``_run_batch`` — the supervision
+    stack (bounded retries, breaker quarantine, hang watchdog, canary
+    re-admission) is what keeps goodput near 1.0.  Bit-identity is
+    asserted on every completed request and every handle must
+    terminate before numbers are reported (serve/benchmark.py)."""
+    devs = os.environ.get('BENCH_CHAOS_DEVICES')
+    return availability_under_chaos(
+        n_reqs=int(os.environ.get('BENCH_CHAOS_REQS', 80)),
+        rate_hz=float(os.environ.get('BENCH_CHAOS_RATE', 60)),
+        shots=int(os.environ.get('BENCH_CHAOS_SHOTS', 8)),
+        seed=int(os.environ.get('BENCH_CHAOS_SEED', 0)),
+        devices=int(devs) if devs else None,
+        p_crash=float(os.environ.get('BENCH_CHAOS_P_CRASH', 0.08)),
+        p_hang=float(os.environ.get('BENCH_CHAOS_P_HANG', 0.02)),
+        p_slow=float(os.environ.get('BENCH_CHAOS_P_SLOW', 0.10)))
 
 
 def main():
@@ -1393,6 +1415,18 @@ def main():
         serve_open = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('serve_open_loop', serve_open)
 
+    # availability-under-chaos row: the same open-loop stream with
+    # seeded executor faults injected under _run_batch — goodput and
+    # tail latency with the self-healing machinery doing its job
+    try:
+        serve_chaos = _timed_row(_serve_chaos_row) \
+            if secondaries else None
+    except _RowTimeout as e:
+        serve_chaos = {'error': 'timeout', 'detail': str(e)}
+    except Exception as e:      # pragma: no cover - defensive
+        serve_chaos = {'error': f'{type(e).__name__}: {e}'[:200]}
+    artifact.row('availability_under_chaos', serve_chaos)
+
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
     result = {
@@ -1441,6 +1475,7 @@ def main():
             'exec_profile': profile_row,
             'continuous_batching': serve_row,
             'serve_open_loop': serve_open,
+            'availability_under_chaos': serve_chaos,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

@@ -357,10 +357,21 @@ def cmd_trace(args):
 
 
 def cmd_serve_bench(args):
-    from .serve.benchmark import (continuous_batching_comparison,
+    from .serve.benchmark import (availability_under_chaos,
+                                  continuous_batching_comparison,
                                   multi_device_scaling,
                                   open_loop_latency)
-    if args.dp:
+    if args.chaos:
+        # availability under injected faults: crash/hang/slowdown under
+        # _run_batch, goodput + tails with the supervision stack
+        # (retries, breaker quarantine, canary re-admission) healing
+        row = availability_under_chaos(
+            n_reqs=args.requests, rate_hz=args.rate_hz,
+            n_qubits=args.qubits, depth=args.depth, shots=args.shots,
+            seed=args.seed, devices=args.devices,
+            p_crash=args.p_crash, p_hang=args.p_hang,
+            p_slow=args.p_slow)
+    elif args.dp:
         # multi-device closed-loop scaling: needs that many visible
         # devices in THIS process (off-TPU: XLA_FLAGS=
         # --xla_force_host_platform_device_count=N; bench.py shells
@@ -582,6 +593,20 @@ def main(argv=None):
                    help='open-loop: shard the service across this '
                         'many devices (default: classic single-device '
                         'path)')
+    p.add_argument('--chaos', action='store_true',
+                   help='availability under seeded fault injection: '
+                        'open-loop stream with crashes/hangs/slowdowns '
+                        'injected under the executors; reports goodput '
+                        'fraction, retries, breaker trips, '
+                        're-admissions (bit-identity asserted)')
+    p.add_argument('--p-crash', type=float, default=0.08,
+                   help='chaos: per-dispatch injected crash probability')
+    p.add_argument('--p-hang', type=float, default=0.02,
+                   help='chaos: per-dispatch injected hang probability '
+                        '(past the watchdog)')
+    p.add_argument('--p-slow', type=float, default=0.10,
+                   help='chaos: per-dispatch injected slowdown '
+                        'probability (below the watchdog)')
     p.set_defaults(fn=cmd_serve_bench)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')

@@ -12,21 +12,51 @@ throughput across its whole device mesh.  The QubiC reference serves
 one FPGA board per user; the TPU port serves many users per chip (and
 many chips per service) by making batch occupancy and device placement
 scheduling decisions instead of caller obligations.
+
+The service is self-healing (docs/ROBUSTNESS.md "serving-layer
+failures"): a supervisor thread health-checks every executor
+(heartbeat, hang watchdog, dead-thread detection), a per-executor
+circuit breaker quarantines repeat infrastructure offenders and
+re-admits them through bit-checked canary probes, infrastructure
+failures retry on healthy executors under a bounded
+:class:`RetryPolicy`, and overload control (``max_est_wait_ms``)
+sheds or rejects work with :class:`OverloadError` instead of letting
+queues grow into missed deadlines.  ``serve.chaos`` injects seeded
+crashes/hangs/slowdowns under ``_run_batch`` to prove all of it.
 """
 
 from .batcher import Coalescer, bucket_key
-from .request import (CancelledError, DeadlineError, QueueFullError,
-                      RequestHandle, ServiceClosedError)
-from .service import DISPATCH_THREAD_PREFIX, ExecutionService
+from .chaos import ChaosError, ChaosMonkey, ChaosPlan, ChaosThreadDeath
+from .request import (CancelledError, DeadlineError, ExecutorLostError,
+                      OverloadError, QueueFullError, RequestHandle,
+                      ServiceClosedError, ShutdownError)
+from .service import (CANARY_THREAD_PREFIX, DISPATCH_THREAD_PREFIX,
+                      SUPERVISE_THREAD_PREFIX, ExecutionService)
+from .supervise import (HEALTH_LIVE, HEALTH_PROBING,
+                        HEALTH_QUARANTINED, CircuitBreaker, RetryPolicy)
 
 __all__ = [
+    'CANARY_THREAD_PREFIX',
     'CancelledError',
+    'ChaosError',
+    'ChaosMonkey',
+    'ChaosPlan',
+    'ChaosThreadDeath',
+    'CircuitBreaker',
     'Coalescer',
     'DISPATCH_THREAD_PREFIX',
     'DeadlineError',
     'ExecutionService',
+    'ExecutorLostError',
+    'HEALTH_LIVE',
+    'HEALTH_PROBING',
+    'HEALTH_QUARANTINED',
+    'OverloadError',
     'QueueFullError',
     'RequestHandle',
+    'RetryPolicy',
+    'SUPERVISE_THREAD_PREFIX',
     'ServiceClosedError',
+    'ShutdownError',
     'bucket_key',
 ]

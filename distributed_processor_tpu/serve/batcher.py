@@ -65,9 +65,15 @@ class Coalescer:
     def __len__(self) -> int:
         return self._depth
 
-    def push(self, key: tuple, req: Request) -> None:
+    def push(self, key: tuple, req: Request,
+             forced: bool = False) -> None:
+        """``forced`` marks the bucket immediately dispatchable — used
+        when re-queueing a parked retry, which already waited out its
+        backoff and must not sit through the latency dial again."""
         self._buckets.setdefault(key, []).append(req)
         self._depth += 1
+        if forced:
+            self._forced.add(key)
 
     def cancel_all(self, exc: BaseException) -> int:
         """Fail every queued request (non-draining shutdown)."""
@@ -147,7 +153,13 @@ class Coalescer:
                        reqs[self.max_batch_programs:])
         batch = []
         for r in take:
-            if r.handle._claim():
+            tok = r.handle._claim()
+            if tok:
+                # the attempt token travels with the request: the
+                # executor presents it back at fulfill/fail time, so a
+                # dispatch that hung (and whose request was retried
+                # elsewhere) can never double-complete the handle
+                r.claim_token = tok
                 batch.append(r)
             elif r.handle.cancelled():   # lost the race to cancel()
                 self.dropped_cancelled += 1
@@ -201,6 +213,52 @@ class Coalescer:
         self._depth -= len(take)
         return take
 
+    def migrate_all(self) -> dict:
+        """Remove EVERY queued request, keyed by bucket — the
+        quarantine path: a tripped/lost executor's whole backlog
+        re-homes onto healthy executors via their :meth:`absorb` (which
+        re-runs the deadline/cancel checks, exactly like a work-steal
+        migration)."""
+        out = {key: sorted(reqs, key=lambda r: (-r.priority, r.seq))
+               for key, reqs in self._buckets.items()}
+        self._buckets.clear()
+        self._forced.clear()
+        self._depth = 0
+        return out
+
+    def shed_candidate(self, below_priority: int):
+        """The single most-sheddable queued request strictly below
+        ``below_priority`` — lowest priority first, newest arrival
+        within it (the request that has invested the least waiting) —
+        as ``(key, req)``, or None.  A pure view: the service compares
+        candidates ACROSS executor queues before calling
+        :meth:`remove` on the loser's, then fails it with
+        ``OverloadError`` (the overload-control eviction path)."""
+        worst, worst_key = None, None
+        for key, reqs in self._buckets.items():
+            for r in reqs:
+                if r.priority >= below_priority or r.handle.done():
+                    continue
+                if worst is None or (r.priority, -r.seq) \
+                        < (worst.priority, -worst.seq):
+                    worst, worst_key = r, key
+        if worst is None:
+            return None
+        return worst_key, worst
+
+    def remove(self, key: tuple, req: Request) -> bool:
+        """Drop one specific queued request (the shed eviction);
+        False when it already left the queue some other way."""
+        reqs = self._buckets.get(key)
+        if not reqs or req not in reqs:
+            return False
+        reqs.remove(req)
+        if not reqs:
+            del self._buckets[key]
+            self._forced.discard(key)
+        self._depth -= 1
+        return True
+
     def absorb(self, key: tuple, reqs: list, now: float = None) -> list:
         """Re-queue migrated requests: the stolen batch's landing point.
 
@@ -226,6 +284,7 @@ class Coalescer:
                     expired.append(req)
                 continue
             req.migrations += 1
+            req.handle.migrations = req.migrations
             self.push(key, req)
             # the batch already ripened at the victim; keep it
             # immediately dispatchable here even if the migration

@@ -12,9 +12,17 @@ state machine is deliberately small and fully lock-guarded:
                 the cancellation point)
 ``done``        result or exception set, ``result()`` unblocked
 
-The states only move forward, and every transition happens under the
-handle's own lock, so ``cancel()`` racing the dispatcher's claim has
-exactly one winner.
+Every transition happens under the handle's own lock, so ``cancel()``
+racing the dispatcher's claim has exactly one winner.  ``dispatched``
+can move BACK to ``queued`` exactly one way: the supervision layer
+re-queues a request whose batch died on executor infrastructure (crash,
+hang, dead dispatcher — docs/ROBUSTNESS.md "serving-layer failures").
+Each claim hands the dispatcher an **attempt token**; fulfilling or
+failing with a stale token is a silent no-op, so a hung dispatch that
+eventually returns after its request was retried elsewhere cannot
+double-complete the handle, and ``cancel()`` racing a retry re-queue
+still has exactly one winner (the re-queue invalidates the old token,
+the cancel flips the state to done, the next claim loses).
 """
 
 from __future__ import annotations
@@ -36,6 +44,35 @@ class QueueFullError(RuntimeError):
 class CancelledError(RuntimeError):
     """The request was cancelled (``handle.cancel()`` or a non-draining
     shutdown) before it was dispatched."""
+
+
+class ShutdownError(CancelledError):
+    """The service shut down before this request could run.
+
+    Raised on handles still queued at ``shutdown(drain=False)`` and on
+    any handle left unresolved when the last dispatcher exits — a
+    forced shutdown must fail every outstanding handle so ``result()``
+    can never block forever.  Subclasses :class:`CancelledError`: a
+    shutdown IS a service-initiated cancellation, just a typed one.
+    """
+
+
+class OverloadError(RuntimeError):
+    """Admission-control shed: the service refused (or evicted) this
+    request because the estimated queue service time exceeds the
+    configured bound (``max_est_wait_ms``) or provably exceeds the
+    request's own ``deadline_ms``.  Shedding early and loudly beats
+    queueing a request that can only expire — the caller can back off,
+    retry elsewhere, or lower its demands (docs/SERVING.md
+    "overload control")."""
+
+
+class ExecutorLostError(RuntimeError):
+    """The executor running this request's batch was lost (dispatcher
+    thread died, or a dispatch hung past the watchdog) and the retry
+    budget could not place it elsewhere.  Infrastructure-class: the
+    supervision layer retries these under the service's
+    :class:`~.supervise.RetryPolicy` before they ever surface."""
 
 
 class DeadlineError(RuntimeError):
@@ -73,6 +110,16 @@ class RequestHandle:
         self._state = _QUEUED
         self._result = None
         self._exception = None
+        # attempt token: bumped by every _claim and every _requeue, so
+        # an executor holding a stale token (its dispatch hung or
+        # failed and the request was retried elsewhere) cannot
+        # complete the handle
+        self._attempt = 0
+        # supervision counters (written under _lock by the service):
+        # how many times the request was re-queued after an
+        # infrastructure failure / migrated between executor queues
+        self.retries = 0
+        self.migrations = 0
 
     # -- submitter side -------------------------------------------------
 
@@ -109,28 +156,52 @@ class RequestHandle:
 
     # -- service side ---------------------------------------------------
 
-    def _claim(self) -> bool:
-        """Dispatcher: move queued -> dispatched; False if the request
-        was cancelled/failed first (the batch must skip it)."""
+    def _claim(self):
+        """Dispatcher: move queued -> dispatched.  Returns the attempt
+        token (a truthy int) the claimer must present to ``_fulfill``/
+        ``_fail``/``_requeue``, or 0 if the request was cancelled or
+        failed first (the batch must skip it)."""
         with self._lock:
             if self._state != _QUEUED:
-                return False
+                return 0
             self._state = _DISPATCHED
+            self._attempt += 1
+            return self._attempt
+
+    def _requeue(self, token: int) -> bool:
+        """Supervision: move dispatched -> queued for a retry after an
+        infrastructure failure.  Invalidates ``token`` (a straggling
+        duplicate of the failed dispatch can no longer complete the
+        handle) and bumps ``retries``.  False when the handle is
+        already done (cancel/deadline won) or the token is stale (a
+        different retry already happened)."""
+        with self._lock:
+            if self._state != _DISPATCHED or token != self._attempt:
+                return False
+            self._state = _QUEUED
+            self._attempt += 1
+            self.retries += 1
             return True
 
-    def _fulfill(self, result: dict) -> None:
+    def _fulfill(self, result: dict, token: int = None) -> bool:
         with self._lock:
-            if self._state == _DONE:        # pragma: no cover - defensive
-                return
+            if self._state == _DONE:
+                return False
+            if token is not None and token != self._attempt:
+                return False        # stale dispatch: retried elsewhere
             self._state = _DONE
             self._result = result
         self._event.set()
+        return True
 
-    def _fail(self, exc: BaseException, only_queued: bool = False) -> bool:
+    def _fail(self, exc: BaseException, only_queued: bool = False,
+              token: int = None) -> bool:
         with self._lock:
             if self._state == _DONE or \
                     (only_queued and self._state != _QUEUED):
                 return False
+            if token is not None and token != self._attempt:
+                return False        # stale dispatch: retried elsewhere
             self._state = _DONE
             self._exception = exc
         self._event.set()
@@ -151,7 +222,13 @@ class Request:
     arrival number used as the FIFO tiebreak inside a priority lane.
     ``migrations`` counts how many times work stealing moved this
     request between per-device queues (each hop re-runs the
-    deadline/cancel checks at the re-queue boundary).
+    deadline/cancel checks at the re-queue boundary; mirrored onto the
+    handle).  ``claim_token`` is the attempt token the last ``_claim``
+    returned — the batch executor presents it back so a stale dispatch
+    (retried elsewhere meanwhile) cannot complete the handle.
+    ``last_error`` records the most recent infrastructure failure so
+    retry-budget exhaustion surfaces the ORIGINAL error, not a generic
+    "gave up".
     """
     mp: object
     meas_bits: object
@@ -165,6 +242,8 @@ class Request:
     handle: RequestHandle = field(default_factory=RequestHandle)
     submit_t: float = field(default_factory=time.monotonic)
     migrations: int = 0
+    claim_token: int = 0
+    last_error: BaseException = None
 
     def expired(self, now: float) -> bool:
         """Whether the deadline has passed as of ``now`` (False when no

@@ -63,20 +63,26 @@ import numpy as np
 import jax
 
 from .. import isa
-from ..decoder import stack_machine_programs
+from ..decoder import machine_program_from_cmds, stack_machine_programs
 from ..sim.interpreter import (ENGINES, InterpreterConfig, FaultError,
                                demux_multi_batch, fault_shot_counts,
-                               resolve_engine, simulate_batch,
-                               simulate_multi_batch)
+                               is_infrastructure_error, resolve_engine,
+                               simulate_batch, simulate_multi_batch)
 from ..utils import profiling
 from .batcher import Coalescer, bucket_key
-from .request import (CancelledError, QueueFullError, Request,
-                      ServiceClosedError)
+from .request import (CancelledError, DeadlineError, ExecutorLostError,
+                      OverloadError, QueueFullError, Request,
+                      ServiceClosedError, ShutdownError)
+from .supervise import (HEALTH_LIVE, HEALTH_PROBING, HEALTH_QUARANTINED,
+                        CircuitBreaker, RetryPolicy)
 
-# dispatcher threads carry this prefix so the test harness can detect
+# service threads carry these prefixes so the test harness can detect
 # leaked services (tests/conftest.py prints the junit-gated marker —
-# tools/check_junit.py — when one survives a test)
+# tools/check_junit.py — when one survives a test); the supervision
+# layer's threads share the 'dproc-serve' stem the conftest probe scans
 DISPATCH_THREAD_PREFIX = 'dproc-serve-dispatch'
+SUPERVISE_THREAD_PREFIX = 'dproc-serve-supervise'
+CANARY_THREAD_PREFIX = 'dproc-serve-canary'
 
 _SERVICE_SEQ = itertools.count()
 
@@ -149,11 +155,29 @@ class _DeviceExecutor:
     executor is a struct, the service owns the concurrency."""
 
     def __init__(self, svc: 'ExecutionService', idx: int, device,
-                 max_batch_programs: int, max_wait_s: float):
+                 max_batch_programs: int, max_wait_s: float,
+                 breaker: CircuitBreaker):
         self.idx = idx
         self.device = device
         self.q = Coalescer(max_batch_programs, max_wait_s)
         self.busy = False            # a batch is executing right now
+        # -- supervision state (all under the service's cv) --------------
+        self.health = HEALTH_LIVE
+        self.breaker = breaker
+        # (key, batch) currently executing: the supervisor's handle on
+        # work to recover when the dispatch hangs or the thread dies
+        self.inflight = None
+        # wall-clock watchdog: absolute monotonic instant after which
+        # the current dispatch counts as hung (None = no dispatch
+        # running, or the watchdog is disabled)
+        self.dispatch_deadline = None
+        self.last_beat = time.monotonic()
+        self.hangs = 0
+        self.deaths = 0
+        self.respawns = 0
+        self.canary_ok = 0
+        self.canary_fail = 0
+        self.canary_thread = None
         self.dispatches = 0
         self.programs_dispatched = 0
         self.occupancy = collections.Counter()          # batch size -> n
@@ -166,9 +190,15 @@ class _DeviceExecutor:
         # this device: the host-side cold/warm compile classifier (the
         # jit cache itself keys on the same shapes, per device)
         self.seen = set()
+        self.spawn_thread(svc)
+
+    def spawn_thread(self, svc: 'ExecutionService') -> None:
+        """(Re)create the dispatcher thread — __init__, and the
+        supervisor's dead-thread respawn path (a fresh Thread object:
+        a died Thread cannot be restarted)."""
         self.thread = threading.Thread(
             target=svc._dispatch_loop, args=(self,),
-            name=f'{DISPATCH_THREAD_PREFIX}-{svc.name}-d{idx}',
+            name=f'{DISPATCH_THREAD_PREFIX}-{svc.name}-d{self.idx}',
             daemon=True)
 
     def label(self) -> str:
@@ -220,13 +250,56 @@ class ExecutionService:
         odd-sized remainders and stolen batches reuse the pow2-shaped
         executables instead of compiling one per batch size.  Default
         True.
+    supervision:
+        Run the supervisor thread: per-executor heartbeats, hang
+        watchdog, dead-dispatcher detection + respawn, circuit-breaker
+        quarantine with canary-probed re-admission (docs/ROBUSTNESS.md
+        "serving-layer failures").  Default True.  With it off,
+        infrastructure failures are still retried under
+        ``retry_policy`` but a broken executor is never quarantined
+        and a dead dispatcher is only cleaned up at shutdown.
+    retry_policy:
+        :class:`~.supervise.RetryPolicy` bounding how often an
+        INFRASTRUCTURE failure (executor crash / hang / death — never
+        :class:`FaultError`, validation or deadline errors) is retried
+        on a healthy executor, with exponential backoff.  None
+        (default) uses ``RetryPolicy()``; ``RetryPolicy(max_attempts=
+        1)`` disables retrying.
+    hang_timeout_s:
+        Wall-clock watchdog on every device dispatch: one exceeding
+        this is declared hung, its executor quarantined, its requests
+        retried elsewhere (the straggler's eventual completion is
+        discarded by the attempt token).  Default None = off — a cold
+        XLA compile can legitimately take minutes, so only enable this
+        on warmed-up services with a known service-time envelope.
+    breaker_threshold / breaker_cooldown_ms:
+        Circuit breaker: this many CONSECUTIVE infrastructure failures
+        quarantine the executor; after the cooldown (doubling per
+        re-trip, capped) a canary probe decides re-admission.
+    max_est_wait_ms:
+        Overload control: when the estimated queue service time (EWMA
+        per-program batch time x queued programs / live executors)
+        exceeds this bound, ``submit`` sheds the lowest-priority
+        queued request (failing it with :class:`OverloadError`) to
+        admit a higher-priority one, or rejects the submission
+        outright; a request whose own ``deadline_ms`` provably cannot
+        be met is rejected early instead of queueing to expire.
+        Default None = off (the bounded queue / QueueFullError is
+        then the only admission control, exactly as before).
     """
 
     def __init__(self, cfg: InterpreterConfig = None, *,
                  max_batch_programs: int = 16, max_wait_ms: float = 2.0,
                  max_queue: int = 256, singleton_engine: str = None,
                  name: str = None, devices=None,
-                 work_stealing: bool = True, pad_programs: bool = True):
+                 work_stealing: bool = True, pad_programs: bool = True,
+                 supervision: bool = True,
+                 retry_policy: RetryPolicy = None,
+                 hang_timeout_s: float = None,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_ms: float = 250.0,
+                 supervise_interval_ms: float = 25.0,
+                 max_est_wait_ms: float = None):
         if max_batch_programs < 1:
             raise ValueError('max_batch_programs must be >= 1')
         if max_queue < 1:
@@ -256,10 +329,23 @@ class ExecutionService:
             dev_list = list(devices)
             if not dev_list:
                 raise ValueError('devices sequence must be non-empty')
+        if hang_timeout_s is not None and hang_timeout_s <= 0:
+            raise ValueError('hang_timeout_s must be positive or None')
+        if max_est_wait_ms is not None and max_est_wait_ms <= 0:
+            raise ValueError('max_est_wait_ms must be positive or None')
+        self._supervision = bool(supervision)
+        self._retry_policy = retry_policy if retry_policy is not None \
+            else RetryPolicy()
+        self._hang_timeout_s = hang_timeout_s
+        self._supervise_interval_s = supervise_interval_ms / 1e3
+        self._max_est_wait_s = None if max_est_wait_ms is None \
+            else max_est_wait_ms / 1e3
         self._cv = threading.Condition()
         self._executors = [
             _DeviceExecutor(self, i, d, max_batch_programs,
-                            max_wait_ms / 1e3)
+                            max_wait_ms / 1e3,
+                            CircuitBreaker(breaker_threshold,
+                                           breaker_cooldown_ms / 1e3))
             for i, d in enumerate(dev_list)]
         self._stealing = bool(work_stealing) and len(self._executors) > 1
         self._home = {}                        # bucket_key -> executor idx
@@ -282,8 +368,35 @@ class ExecutionService:
         self._engine_dispatches = collections.Counter()  # engine -> count
         self._bucket_compiles = {}     # bucket label -> {'cold','warm'}
         self._latency_s = collections.deque(maxlen=4096)
+        # -- supervision state (guarded by _cv's lock) -------------------
+        # requests waiting out a retry backoff: (eligible_t, key, req),
+        # pumped back into the queues by dispatchers and the supervisor
+        self._parked = []
+        self._stop_supervisor = False
+        self._retries = 0
+        self._retry_exhausted = 0
+        self._shed = 0
+        self._overload_rejected = 0
+        self._breaker_trips = 0
+        self._readmissions = 0
+        self._executor_deaths = 0
+        self._hangs = 0
+        self._canary_ok = 0
+        self._canary_fail = 0
+        # EWMA of per-program batch service time (the overload
+        # estimator's numerator); None until the first batch lands
+        self._ewma_prog_s = None
+        self._canary_mp = None         # lazily-built tiny probe program
+        self._canary_ref = None        # first canary result: bit reference
         for ex in self._executors:
             ex.thread.start()
+        self._supervisor = None
+        if self._supervision:
+            self._supervisor = threading.Thread(
+                target=self._supervise_loop,
+                name=f'{SUPERVISE_THREAD_PREFIX}-{self.name}',
+                daemon=True)
+            self._supervisor.start()
 
     # -- submission ------------------------------------------------------
 
@@ -368,36 +481,130 @@ class ExecutionService:
                 profiling.counter_inc('serve.rejected')
                 raise QueueFullError(
                     f'queue full ({self.max_queue} requests pending)')
+            self._admit_overload_locked(priority, deadline)
             req = Request(mp=mp, meas_bits=meas_bits,
                           init_regs=init_regs, cfg=cfg, strict=strict,
                           n_shots=n_shots, priority=priority,
                           deadline=deadline, seq=next(self._seq))
-            self._route_locked(key).q.push(key, req)
+            tgt = self._route_locked(key)
+            if tgt is None:
+                # every executor is quarantined/probing: park the
+                # request; the first re-admission pumps it back in
+                self._parked.append((time.monotonic(), key, req))
+            else:
+                tgt.q.push(key, req)
             self._submitted += 1
             profiling.counter_inc('serve.submitted')
             self._cv.notify_all()
         return req.handle
 
+    def _admit_overload_locked(self, priority: int, deadline) -> None:
+        """Overload control (``max_est_wait_ms``): estimate how long
+        the queue will take to serve, reject a submission that provably
+        cannot meet its own deadline, and above the bound either shed
+        the lowest-priority queued request to make room or reject the
+        newcomer (docs/ROBUSTNESS.md "serving-layer failures")."""
+        if self._max_est_wait_s is None:
+            return
+        est_s = self._est_wait_s_locked()
+        if est_s is None:       # no completed batch yet: no estimate
+            return
+        now = time.monotonic()
+        if deadline is not None and now + est_s >= deadline:
+            self._overload_rejected += 1
+            profiling.counter_inc('serve.overload_rejected')
+            raise OverloadError(
+                f'deadline cannot be met: estimated queue wait '
+                f'{est_s * 1e3:.1f} ms exceeds the '
+                f'{(deadline - now) * 1e3:.1f} ms remaining — '
+                f'rejected at admission instead of queueing to expire')
+        if est_s <= self._max_est_wait_s:
+            return
+        if self._shed_locked(priority) is None:
+            self._overload_rejected += 1
+            profiling.counter_inc('serve.overload_rejected')
+            raise OverloadError(
+                f'overloaded: estimated queue wait {est_s * 1e3:.1f} '
+                f'ms exceeds max_est_wait_ms='
+                f'{self._max_est_wait_s * 1e3:g} and nothing of lower '
+                f'priority is queued to shed')
+
+    def _est_wait_s_locked(self):
+        """Estimated service time of the current backlog: queued
+        programs x EWMA per-program batch time / live executors.
+        None until the first batch completes."""
+        if self._ewma_prog_s is None:
+            return None
+        live = sum(1 for ex in self._executors
+                   if ex.health == HEALTH_LIVE) or 1
+        return self._depth_locked() * self._ewma_prog_s / live
+
+    def _shed_locked(self, below_priority: int):
+        """Evict the globally most-sheddable queued/parked request
+        strictly below ``below_priority`` (lowest priority, newest
+        arrival — least invested), failing it with
+        :class:`OverloadError`.  Returns the shed request or None."""
+        best = None                      # (rank, executor-or-None, key, req)
+        for ex in self._executors:
+            cand = ex.q.shed_candidate(below_priority)
+            if cand is None:
+                continue
+            key, req = cand
+            rank = (req.priority, -req.seq)
+            if best is None or rank < best[0]:
+                best = (rank, ex, key, req)
+        for _, key, req in self._parked:
+            if req.priority >= below_priority or req.handle.done():
+                continue
+            rank = (req.priority, -req.seq)
+            if best is None or rank < best[0]:
+                best = (rank, None, key, req)
+        if best is None:
+            return None
+        _, ex, key, req = best
+        if ex is None:
+            self._parked = [it for it in self._parked
+                            if it[2] is not req]
+        elif not ex.q.remove(key, req):
+            return None
+        if req.handle._fail(OverloadError(
+                f'shed under overload: estimated queue wait exceeds '
+                f'max_est_wait_ms={self._max_est_wait_s * 1e3:g} and '
+                f'a higher-priority request arrived')):
+            self._shed += 1
+            profiling.counter_inc('serve.shed')
+        return req
+
     # -- routing / stealing ----------------------------------------------
 
     def _depth_locked(self) -> int:
-        return sum(len(ex.q) for ex in self._executors)
+        return sum(len(ex.q) for ex in self._executors) \
+            + len(self._parked)
 
     def _route_locked(self, key) -> _DeviceExecutor:
         """Bucket-affinity router: the first sighting of a bucket pins
-        it to the least-loaded executor (queue depth, then how many
-        home buckets it already carries, then index — deterministic);
-        every later submission of the bucket lands on the same home so
-        its warm per-device jit cache stays hot."""
+        it to the least-loaded LIVE executor (queue depth, then how
+        many home buckets it already carries, then index —
+        deterministic); every later submission of the bucket lands on
+        the same home so its warm per-device jit cache stays hot.  A
+        home that got quarantined re-pins to a live peer; None when no
+        executor is live (the caller parks the request)."""
         idx = self._home.get(key)
-        if idx is None:
-            idx = min(self._executors,
-                      key=lambda ex: (len(ex.q),
-                                      self._home_counts[ex.idx],
-                                      ex.idx)).idx
-            self._home[key] = idx
-            self._home_counts[idx] += 1
-        return self._executors[idx]
+        if idx is not None \
+                and self._executors[idx].health == HEALTH_LIVE:
+            return self._executors[idx]
+        live = [ex for ex in self._executors
+                if ex.health == HEALTH_LIVE]
+        if not live:
+            return None
+        if idx is not None:
+            self._home_counts[idx] -= 1
+        ex = min(live, key=lambda e: (len(e.q),
+                                      self._home_counts[e.idx],
+                                      e.idx))
+        self._home[key] = ex.idx
+        self._home_counts[ex.idx] += 1
+        return ex
 
     def _try_steal_locked(self, thief: _DeviceExecutor, now: float,
                           flush: bool = False) -> bool:
@@ -439,30 +646,246 @@ class ExecutionService:
             self._expired += len(expired)
             profiling.counter_inc('serve.expired', len(expired))
 
+    # -- supervision -----------------------------------------------------
+
+    def _pump_parked_locked(self, now: float, flush: bool = False):
+        """Move parked retries whose backoff elapsed back into a live
+        executor's queue (forced: they already waited out the latency
+        dial once).  Deadlines are re-checked here — a parked request
+        never outlives its ``deadline_ms`` silently — and with no live
+        executor the request stays parked until a re-admission (or, on
+        a draining shutdown, drains through ANY executor)."""
+        if not self._parked:
+            return
+        keep = []
+        for item in self._parked:
+            t, key, req = item
+            if req.handle.done():
+                if req.handle.cancelled():
+                    self._cancelled += 1
+                continue
+            if not flush and t > now:
+                keep.append(item)
+                continue
+            if req.expired(now):
+                if req.handle._fail(DeadlineError(
+                        f'deadline passed while parked for retry '
+                        f'({now - req.submit_t:.3f} s after '
+                        f'submission)')):
+                    self._count_expired_locked([req])
+                continue
+            tgt = self._route_locked(key)
+            if tgt is None and flush:
+                tgt = min(self._executors,
+                          key=lambda e: (len(e.q), e.idx))
+            if tgt is None:
+                keep.append(item)
+                continue
+            tgt.q.push(key, req, forced=True)
+        self._parked = keep
+
+    def _quarantine_locked(self, ex: _DeviceExecutor, now: float):
+        """Trip the breaker: mark ``ex`` quarantined (no routed
+        traffic, no stealing), arm its cooldown, strip its bucket
+        homes, and re-home its whole backlog onto healthy executors
+        via the absorb path (re-running every deadline/cancel check,
+        exactly like a work-steal migration)."""
+        ex.health = HEALTH_QUARANTINED
+        ex.breaker.trip(now)
+        self._breaker_trips += 1
+        profiling.counter_inc('serve.breaker_trips')
+        for key in [k for k, i in self._home.items() if i == ex.idx]:
+            del self._home[key]
+            self._home_counts[ex.idx] -= 1
+        for key, reqs in ex.q.migrate_all().items():
+            tgt = self._route_locked(key)
+            if tgt is None:
+                self._parked.extend((now, key, r) for r in reqs)
+            else:
+                self._count_expired_locked(
+                    tgt.q.absorb(key, reqs, now))
+        self._cv.notify_all()
+
+    def _supervise_loop(self):
+        """The supervisor thread: every tick it pumps parked retries,
+        checks each executor for a dead dispatcher thread (respawn +
+        quarantine + retry its in-flight batch), a dispatch past the
+        hang watchdog (quarantine + retry elsewhere; the straggler's
+        eventual completion is token-stale), and a quarantined
+        executor whose cooldown elapsed (launch a canary probe)."""
+        while True:
+            with self._cv:
+                if self._stop_supervisor:
+                    return
+                now = time.monotonic()
+                self._pump_parked_locked(now)
+                for ex in self._executors:
+                    if not ex.thread.is_alive() and not self._closing:
+                        self._on_executor_death_locked(ex, now)
+                    elif ex.dispatch_deadline is not None \
+                            and now > ex.dispatch_deadline:
+                        self._on_executor_hang_locked(ex, now)
+                    if ex.health == HEALTH_QUARANTINED \
+                            and ex.canary_thread is None \
+                            and not self._closing \
+                            and ex.breaker.ready_to_probe(now):
+                        self._start_canary_locked(ex)
+                self._cv.wait(self._supervise_interval_s)
+
+    def _on_executor_death_locked(self, ex: _DeviceExecutor,
+                                  now: float):
+        """The dispatcher thread died (a non-Exception throwable out
+        of a dispatch, or a bug): recover its in-flight batch into the
+        retry path, quarantine the executor, and respawn a fresh
+        dispatcher so the pool never shrinks permanently."""
+        self._executor_deaths += 1
+        ex.deaths += 1
+        profiling.counter_inc('serve.executor_deaths')
+        inflight, ex.inflight = ex.inflight, None
+        ex.busy = False
+        ex.dispatch_deadline = None
+        self._quarantine_locked(ex, now)
+        if inflight is not None:
+            key, batch = inflight
+            self._retry_batch_locked(key, batch, ExecutorLostError(
+                f'dispatcher thread for executor {ex.label()} died '
+                f'mid-dispatch'), now)
+        ex.respawns += 1
+        ex.spawn_thread(self)
+        ex.thread.start()
+        self._cv.notify_all()
+
+    def _on_executor_hang_locked(self, ex: _DeviceExecutor,
+                                 now: float):
+        """The current dispatch blew past ``hang_timeout_s``: retry
+        its batch on healthy executors NOW (fresh attempt tokens make
+        the hung dispatch's eventual completion a no-op) and
+        quarantine the executor — the canary decides when it is
+        trustworthy again."""
+        self._hangs += 1
+        ex.hangs += 1
+        profiling.counter_inc('serve.hangs')
+        inflight, ex.inflight = ex.inflight, None
+        ex.dispatch_deadline = None
+        self._quarantine_locked(ex, now)
+        if inflight is not None:
+            key, batch = inflight
+            self._retry_batch_locked(key, batch, ExecutorLostError(
+                f'dispatch on executor {ex.label()} exceeded '
+                f'hang_timeout_s={self._hang_timeout_s}'), now)
+        self._cv.notify_all()
+
+    def _start_canary_locked(self, ex: _DeviceExecutor):
+        """Half-open probe: run one tiny known program on the
+        quarantined executor in a short-lived thread (through
+        ``_run_batch``, so fault injection exercises this path too)."""
+        ex.health = HEALTH_PROBING
+        ex.canary_thread = threading.Thread(
+            target=self._canary_probe, args=(ex,),
+            name=f'{CANARY_THREAD_PREFIX}-{self.name}-d{ex.idx}',
+            daemon=True)
+        ex.canary_thread.start()
+
+    def _canary_work(self):
+        """The canary workload: a tiny branch-free single-core
+        program (its own 1-program bucket, so a canary compile never
+        perturbs serving buckets), built once and reused."""
+        if self._canary_mp is None:
+            core = [isa.pulse_cmd(amp_word=1000, cfg_word=0,
+                                  env_word=3, cmd_time=10),
+                    isa.done_cmd()]
+            self._canary_mp = machine_program_from_cmds([core])
+        mp = self._canary_mp
+        ncfg, _ = _normalize_cfg(None, isa.shape_bucket(mp.n_instr))
+        key = bucket_key(mp, ncfg)
+        meas = np.zeros((1, mp.n_cores, ncfg.max_meas), np.int32)
+        req = Request(mp=mp, meas_bits=meas, init_regs=None, cfg=ncfg,
+                      strict=False, n_shots=1, priority=0,
+                      deadline=None, seq=-1)
+        return key, [req], ncfg
+
+    def _canary_probe(self, ex: _DeviceExecutor):
+        """Runs on the canary thread.  Success needs a clean run AND
+        bit-identity with the first successful canary anywhere in the
+        pool — a device that computes WRONG bits stays quarantined
+        just like one that crashes.  Success re-admits the executor
+        (health live, breaker reset, parked work pumped); failure
+        re-arms the quarantine with an escalated cooldown."""
+        ok = False
+        try:
+            key, batch, ncfg = self._canary_work()
+            out = self._run_batch(ex, key, batch, ncfg)[0]
+            ref = {k: np.asarray(v) for k, v in out.items()}
+            clean = not np.asarray(ref.get('fault', 0)).any()
+            with self._cv:
+                if self._canary_ref is None:
+                    self._canary_ref = ref
+                    ok = clean
+                else:
+                    ok = clean and set(ref) == set(self._canary_ref) \
+                        and all(np.array_equal(ref[k],
+                                               self._canary_ref[k])
+                                for k in self._canary_ref)
+        except BaseException:   # noqa: BLE001 - injected faults included
+            ok = False
+        now = time.monotonic()
+        with self._cv:
+            ex.canary_thread = None
+            if ok:
+                ex.canary_ok += 1
+                self._canary_ok += 1
+                profiling.counter_inc('serve.canary.ok')
+                ex.health = HEALTH_LIVE
+                ex.breaker.readmit()
+                self._readmissions += 1
+                profiling.counter_inc('serve.readmissions')
+                self._pump_parked_locked(now)
+            else:
+                ex.canary_fail += 1
+                self._canary_fail += 1
+                profiling.counter_inc('serve.canary.fail')
+                ex.health = HEALTH_QUARANTINED
+                ex.breaker.trip(now)
+            self._cv.notify_all()
+
     # -- dispatcher ------------------------------------------------------
 
     def _dispatch_loop(self, ex: _DeviceExecutor):
         while True:
             with self._cv:
                 while True:
+                    now = time.monotonic()
+                    ex.last_beat = now       # supervisor heartbeat
                     flush = self._closing and self._drain
-                    key, batch, expired = ex.q.pop_batch(flush=flush)
-                    self._count_expired_locked(expired)
-                    if key is None and self._stealing:
-                        if self._try_steal_locked(ex, time.monotonic(),
-                                                  flush=flush):
-                            continue     # absorbed work: pop it now
-                    if key is not None:
-                        ex.busy = True
-                        # wake idle peers: the remaining ripe buckets
-                        # just became stealable
-                        self._cv.notify_all()
-                        break
+                    self._pump_parked_locked(now, flush=flush)
+                    # a quarantined/probing executor receives no routed
+                    # traffic and may not pop or steal — except during a
+                    # draining shutdown, when everyone helps flush
+                    if ex.health == HEALTH_LIVE or flush:
+                        key, batch, expired = ex.q.pop_batch(
+                            now, flush=flush)
+                        self._count_expired_locked(expired)
+                        if key is None and self._stealing:
+                            if self._try_steal_locked(ex, now,
+                                                      flush=flush):
+                                continue     # absorbed work: pop it now
+                        if key is not None:
+                            ex.busy = True
+                            ex.inflight = (key, batch)
+                            if self._hang_timeout_s is not None:
+                                ex.dispatch_deadline = \
+                                    now + self._hang_timeout_s
+                            # wake idle peers: the remaining ripe
+                            # buckets just became stealable
+                            self._cv.notify_all()
+                            break
                     if self._closing and (not self._drain
                                           or self._depth_locked() == 0):
                         return
-                    timeout = self._wait_timeout_locked(
-                        ex, time.monotonic())
+                    if ex.health != HEALTH_LIVE:
+                        self._cv.wait(0.25)
+                        continue
+                    timeout = self._wait_timeout_locked(ex, now)
                     if timeout is None:
                         self._cv.wait()
                     elif timeout > 0:
@@ -471,11 +894,19 @@ class ExecutionService:
                         # something is ripe somewhere but not claimable
                         # by this executor yet: bounded re-check
                         self._cv.wait(0.002)
+            done = False
             try:
                 self._execute(ex, key, batch)
+                done = True
             finally:
                 with self._cv:
                     ex.busy = False
+                    ex.dispatch_deadline = None
+                    if done:
+                        ex.inflight = None
+                    # else the thread is dying on a non-Exception
+                    # throwable mid-dispatch: leave inflight for the
+                    # supervisor's dead-thread recovery to retry
                     self._cv.notify_all()
 
     def _wait_timeout_locked(self, ex: _DeviceExecutor,
@@ -491,6 +922,12 @@ class ExecutionService:
                 tv = v.q.next_event(now)
                 if tv is not None:
                     t = tv if t is None else min(t, tv)
+        if self._parked:
+            # a parked retry becoming eligible is a queue event too —
+            # without this, a dispatcher could sleep unbounded while a
+            # retry waits out its backoff (supervision may be off)
+            tp = max(min(e[0] for e in self._parked) - now, 0.0)
+            t = tp if t is None else min(t, tp)
         return t
 
     def _execute(self, ex: _DeviceExecutor, key, batch):
@@ -499,22 +936,22 @@ class ExecutionService:
         try:
             results = self._run_batch(ex, key, batch, cfg)
         except Exception as exc:      # noqa: BLE001 - fail the batch, live on
-            with self._cv:
-                self._failed += len(batch)
-            profiling.counter_inc('serve.batch_failures')
-            for req in batch:
-                req.handle._fail(exc)
+            self._on_batch_failure(ex, key, batch, exc)
             return
         completed = failed = 0
         for req, res in zip(batch, results):
+            # every completion presents the attempt token: if this
+            # dispatch was declared hung and the request retried
+            # elsewhere, the token is stale and the write is a no-op
             if req.strict:
                 counts = np.asarray(fault_shot_counts(res['fault']))
                 if counts.any():
-                    req.handle._fail(FaultError(counts))
-                    failed += 1
+                    if req.handle._fail(FaultError(counts),
+                                        token=req.claim_token):
+                        failed += 1
                     continue
-            req.handle._fulfill(res)
-            completed += 1
+            if req.handle._fulfill(res, token=req.claim_token):
+                completed += 1
         now = time.monotonic()
         with self._cv:
             self._dispatches += 1
@@ -525,12 +962,64 @@ class ExecutionService:
             ex.occupancy[len(batch)] += 1
             self._completed += completed
             self._failed += failed
+            ex.breaker.record_success()
+            per_prog = (now - t0) / len(batch)
+            self._ewma_prog_s = per_prog if self._ewma_prog_s is None \
+                else 0.25 * per_prog + 0.75 * self._ewma_prog_s
             for req in batch:
                 self._latency_s.append(now - req.submit_t)
         profiling.counter_inc('serve.dispatches')
         profiling.counter_inc('serve.programs_dispatched', len(batch))
         profiling.counter_inc('serve.batch_ms',
                               int((now - t0) * 1e3))
+
+    def _on_batch_failure(self, ex: _DeviceExecutor, key, batch, exc):
+        """A batch raised out of ``_run_batch``.  Program-class errors
+        (:func:`is_infrastructure_error` False — validation, bad
+        arguments: they reproduce identically anywhere) propagate to
+        every handle immediately; infrastructure-class errors feed the
+        executor's circuit breaker and send the batch through the
+        bounded-retry path."""
+        profiling.counter_inc('serve.batch_failures')
+        if not is_infrastructure_error(exc):
+            failed = 0
+            for req in batch:
+                if req.handle._fail(exc, token=req.claim_token):
+                    failed += 1
+            with self._cv:
+                self._failed += failed
+            return
+        now = time.monotonic()
+        with self._cv:
+            tripped = ex.breaker.record_failure()
+            if tripped and ex.health == HEALTH_LIVE \
+                    and self._supervision:
+                self._quarantine_locked(ex, now)
+            self._retry_batch_locked(key, batch, exc, now)
+            self._cv.notify_all()
+
+    def _retry_batch_locked(self, key, batch, exc, now: float):
+        """Send a batch that died on executor infrastructure through
+        the :class:`RetryPolicy`: each request re-queues (invalidating
+        its old attempt token) and parks until its backoff elapses;
+        one out of budget fails with the ORIGINAL infrastructure error
+        it hit.  Requests already resolved (cancel / deadline / a
+        racing completion) are skipped by the token guard."""
+        policy = self._retry_policy
+        for req in batch:
+            if req.last_error is None:
+                req.last_error = exc
+            if req.handle.retries + 1 >= policy.max_attempts:
+                if req.handle._fail(req.last_error,
+                                    token=req.claim_token):
+                    self._failed += 1
+                    self._retry_exhausted += 1
+                    profiling.counter_inc('serve.retry_exhausted')
+            elif req.handle._requeue(req.claim_token):
+                self._retries += 1
+                profiling.counter_inc('serve.retries')
+                delay = policy.delay_s(req.handle.retries - 1)
+                self._parked.append((now + delay, key, req))
 
     def _run_batch(self, ex: _DeviceExecutor, key, batch, cfg):
         """Execute one coalesced batch on ``ex``'s device; returns
@@ -670,6 +1159,7 @@ class ExecutionService:
                 'device': ex.label(),
                 'index': ex.idx,
                 'busy': ex.busy,
+                'health': ex.health,
                 'queue_depth': len(ex.q),
                 'dispatches': ex.dispatches,
                 'programs_dispatched': ex.programs_dispatched,
@@ -681,7 +1171,18 @@ class ExecutionService:
                 'cold_compiles': ex.cold_compiles,
                 'warm_hits': ex.warm_hits,
                 'home_buckets': self._home_counts[ex.idx],
+                'breaker_trips': ex.breaker.trips,
+                'consecutive_failures': ex.breaker.consecutive,
+                'readmissions': ex.breaker.readmissions,
+                'hangs': ex.hangs,
+                'deaths': ex.deaths,
+                'respawns': ex.respawns,
+                'canary_ok': ex.canary_ok,
+                'canary_fail': ex.canary_fail,
             } for ex in self._executors]
+            health = collections.Counter(
+                ex.health for ex in self._executors)
+            est_s = self._est_wait_s_locked()
             snap = {
                 'queue_depth': self._depth_locked(),
                 'submitted': self._submitted,
@@ -703,6 +1204,24 @@ class ExecutionService:
                 'work_stealing': self._stealing,
                 'steals': self._steals,
                 'warmups': self._warmups,
+                'supervision': self._supervision,
+                'health': {state: health.get(state, 0)
+                           for state in (HEALTH_LIVE,
+                                         HEALTH_QUARANTINED,
+                                         HEALTH_PROBING)},
+                'parked': len(self._parked),
+                'retries': self._retries,
+                'retry_exhausted': self._retry_exhausted,
+                'shed': self._shed,
+                'overload_rejected': self._overload_rejected,
+                'breaker_trips': self._breaker_trips,
+                'readmissions': self._readmissions,
+                'executor_deaths': self._executor_deaths,
+                'hangs': self._hangs,
+                'canary': {'ok': self._canary_ok,
+                           'fail': self._canary_fail},
+                'est_wait_ms': None if est_s is None
+                else float(est_s * 1e3),
                 'compile': {
                     'cold': sum(ex.cold_compiles
                                 for ex in self._executors),
@@ -726,24 +1245,69 @@ class ExecutionService:
         queued request through dispatch first (all executors keep
         draining — including by stealing — until every queue is empty);
         ``drain=False`` fails queued requests with
-        :class:`CancelledError` (in-flight batches still complete).
-        Joins every dispatcher thread (up to ``timeout`` seconds EACH);
-        idempotent."""
+        :class:`ShutdownError` (a :class:`CancelledError` subclass;
+        in-flight batches still complete).  Joins every dispatcher,
+        supervisor and canary thread (up to ``timeout`` seconds EACH),
+        then force-fails ANY handle still unresolved — after shutdown
+        returns, ``result()`` can never block forever, even when a
+        dispatch hung or a dispatcher died (the late straggler's
+        completion is discarded as stale).  Idempotent."""
         with self._cv:
             if not self._closing:
                 self._closing = True
                 self._drain = drain
                 if not drain:
+                    exc = ShutdownError(
+                        f'service {self.name!r} shut down without '
+                        f'draining')
+                    n = 0
                     for ex in self._executors:
-                        n = ex.q.cancel_all(CancelledError(
-                            f'service {self.name!r} shut down without '
-                            f'draining'))
-                        self._cancelled += n
-                        if n:
-                            profiling.counter_inc('serve.cancelled', n)
+                        n += ex.q.cancel_all(exc)
+                    for _, _, req in self._parked:
+                        if req.handle._fail(exc):
+                            n += 1
+                    self._parked = []
+                    self._cancelled += n
+                    if n:
+                        profiling.counter_inc('serve.cancelled', n)
             self._cv.notify_all()
         for ex in self._executors:
             ex.thread.join(timeout)
+        if self._supervisor is not None:
+            with self._cv:
+                self._stop_supervisor = True
+                self._cv.notify_all()
+            self._supervisor.join(timeout)
+        for ex in self._executors:
+            t = ex.canary_thread
+            if t is not None:
+                t.join(timeout)
+        # forced-shutdown guarantee: whatever the joins left behind
+        # (a hung dispatch past its join timeout, a dead dispatcher's
+        # recovered-but-unserved batch, a parked retry) fails typed NOW
+        exc = ShutdownError(
+            f'service {self.name!r} shut down with this request '
+            f'unresolved')
+        with self._cv:
+            leftovers = []
+            for ex in self._executors:
+                if ex.inflight is not None:
+                    leftovers.extend(ex.inflight[1])
+                    if not ex.thread.is_alive():
+                        ex.inflight = None
+                leftovers.extend(
+                    r for reqs in ex.q.migrate_all().values()
+                    for r in reqs)
+            leftovers.extend(r for _, _, r in self._parked)
+            self._parked = []
+            n = 0
+            for req in leftovers:
+                if req.handle._fail(exc):
+                    n += 1
+            self._cancelled += n
+            if n:
+                profiling.counter_inc('serve.cancelled', n)
+            self._cv.notify_all()
 
     def __enter__(self):
         return self

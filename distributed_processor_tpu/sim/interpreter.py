@@ -129,6 +129,31 @@ class FaultError(RuntimeError):
         super().__init__('faulted shots: ' + (', '.join(parts) or 'none'))
 
 
+def is_infrastructure_error(exc: BaseException) -> bool:
+    """Classify an execution failure: ``True`` means the execution
+    SUBSTRATE failed (XLA runtime fault, device loss, resource
+    exhaustion, a chaos-injected crash) and the same program would
+    plausibly succeed on a healthy executor — the serving tier's
+    :class:`~..serve.supervise.RetryPolicy` may retry it.  ``False``
+    means the failure is a property of the PROGRAM or the request
+    itself (:class:`FaultError`, static-validation errors, bad
+    arguments) and would reproduce identically anywhere: retrying is
+    pure waste and can mask real bugs, so these always propagate to
+    the caller on the first attempt (docs/ROBUSTNESS.md
+    "serving-layer failures").
+    """
+    if isinstance(exc, (FaultError, ValueError, TypeError, KeyError,
+                        IndexError, AssertionError,
+                        NotImplementedError)):
+        return False
+    # decoder.ProgramValidationError without importing decoder here
+    # (decoder imports isa which this module shares; keep the layers
+    # acyclic) — any *ValidationError by name is program-class
+    if type(exc).__name__.endswith('ValidationError'):
+        return False
+    return True
+
+
 def fault_shot_counts(fault) -> jnp.ndarray:
     """``fault [..., n_cores] -> [N_FAULT_CODES]`` int32: shots where
     any core trapped with each code (any-over-cores, sum-over-shots).

@@ -51,17 +51,20 @@ REFERENCE_ROOT = os.environ.get('DPROC_REFERENCE_ROOT', '/root/reference')
 
 @pytest.fixture(autouse=True)
 def _serve_thread_leak_probe():
-    """Print the junit-gated marker when a test leaks an execution-
-    service dispatcher thread (tools/check_junit.py fails CI on it).
+    """Print the junit-gated marker when a test leaks any execution-
+    service thread — dispatcher, supervisor or canary probe, i.e. the
+    whole ``dproc-serve`` prefix family (tools/check_junit.py fails
+    CI on it).
 
     A leaked dispatcher outlives its test, keeps a jit cache reference
-    alive, and can dispatch into a torn-down fixture — the serving
-    analog of the fault-leak gate: tests must shut their services down
+    alive, and can dispatch into a torn-down fixture; a leaked
+    supervisor keeps respawning them — the serving analog of the
+    fault-leak gate: tests must shut their services down
     (ExecutionService is a context manager)."""
     import threading
     yield
     leaked = sorted(t.name for t in threading.enumerate()
-                    if t.name.startswith('dproc-serve-dispatch')
+                    if t.name.startswith('dproc-serve')
                     and t.is_alive())
     if leaked:
         print(f'SERVICE THREAD LEAK: {leaked}')

@@ -1,0 +1,443 @@
+"""Self-healing serving under injected faults (serve/chaos.py).
+
+What test_serve.py pins on the happy path, this suite pins UNDER FIRE:
+with seeded crashes, hangs, slowdowns and dispatcher deaths injected
+below the retry/breaker machinery, every handle still terminates
+(zero ``result()`` timeouts — the deadlock class the supervision layer
+exists to prevent), every completion is still bit-identical to its
+solo ``simulate_batch`` run, and every failure is a TYPED error.
+Around the soak: the breaker trip → quarantine → canary → re-admit
+lifecycle on a single executor, retry-budget exhaustion surfacing the
+ORIGINAL infrastructure error, overload shedding and deadline-aware
+early rejection, dead-dispatcher respawn, the hang watchdog retrying
+elsewhere while the straggler's stale attempt token discards its late
+completion, and the forced-shutdown no-deadlock regression.
+
+The soak needs >= 2 devices (quarantine with a surviving peer); the
+module skips only on a genuinely single-device host and
+tools/check_junit.py fails CI when it skips on anything else (the
+chaos mirror of the multi-device BAD SKIP gate).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.decoder import machine_program_from_cmds
+from distributed_processor_tpu.serve import (CancelledError, ChaosError,
+                                             ChaosMonkey, ChaosPlan,
+                                             ChaosThreadDeath,
+                                             CircuitBreaker,
+                                             ExecutionService,
+                                             OverloadError, RetryPolicy,
+                                             ShutdownError)
+from distributed_processor_tpu.serve.chaos import soak
+from distributed_processor_tpu.serve.request import RequestHandle
+from distributed_processor_tpu.serve.service import _normalize_cfg
+from distributed_processor_tpu.sim.interpreter import (InterpreterConfig,
+                                                       simulate_batch)
+
+_N_DEV = len(jax.devices())
+
+pytestmark = [
+    pytest.mark.chaos,
+    pytest.mark.serve,
+    pytest.mark.skipif(
+        _N_DEV < 2,
+        reason=f'serve chaos tests need >=2 devices (host advertises '
+               f'{_N_DEV} device(s); off-TPU force more with '
+               f'--xla_force_host_platform_device_count)'),
+]
+
+
+def _mp(salt=0):
+    """Branch-free single-core program in the 8-instruction bucket;
+    ``salt`` varies the pulse words so distinct requests carry
+    distinct contents inside one shape bucket."""
+    core = [isa.pulse_cmd(amp_word=1000 + 7 * salt + 13 * i, cfg_word=0,
+                          env_word=3, cmd_time=10 + 20 * i)
+            for i in range(3)] + [isa.done_cmd()]
+    return machine_program_from_cmds([core])
+
+
+_CFG = InterpreterConfig(max_steps=2 * 8 + 64, max_pulses=8 + 2,
+                         max_meas=2, max_resets=2)
+
+
+def _solo(mp, bits):
+    ncfg, _ = _normalize_cfg(_CFG, isa.shape_bucket(mp.n_instr))
+    return jax.tree.map(np.asarray, simulate_batch(mp, bits, cfg=ncfg))
+
+
+def _assert_same(got, want, label=''):
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(
+            np.asarray(got[k]), np.asarray(want[k]),
+            err_msg=f'{label}: stat {k!r} diverged')
+
+
+def _bits(rng, shots=3):
+    return rng.integers(0, 2, size=(shots, 1, 2)).astype(np.int32)
+
+
+def _wait_all_live(svc, timeout_s=30.0):
+    """Poll until every executor is re-admitted (canary probes run on
+    the supervisor's cadence, so re-admission is asynchronous)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        health = svc.stats()['health']
+        if health['live'] == len(svc._executors):
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f'executors not all re-admitted within {timeout_s} s: '
+        f'{svc.stats()["health"]}')
+
+
+def _svc(**kw):
+    base = dict(max_batch_programs=4, max_wait_ms=2.0, max_queue=1024,
+                retry_policy=RetryPolicy(max_attempts=6,
+                                         backoff_s=0.005),
+                breaker_threshold=2, breaker_cooldown_ms=60.0,
+                supervise_interval_ms=10.0)
+    base.update(kw)
+    return ExecutionService(_CFG, **base)
+
+
+# -- the acceptance soak ------------------------------------------------
+
+
+def test_chaos_soak_dp2_terminates_bit_identical():
+    """>=100 requests against a dp=2 pool while the monkey injects a
+    scripted breaker trip, then probabilistic crashes/hangs/slowdowns.
+    Every handle terminates, every completion is bit-identical, the
+    quarantined executor is re-admitted and SERVES again within this
+    test (the post-chaos clean round)."""
+    mps = [_mp(s) for s in range(4)]
+    # 4 scripted crashes over 2 executors: by pigeonhole at least one
+    # breaker (threshold 2) reaches its streak and trips — the soak is
+    # guaranteed a quarantine + canary re-admission regardless of how
+    # the dispatchers interleave their draws
+    plan = ChaosPlan(seed=7, script=('crash',) * 4,
+                     p_crash=0.10, p_hang=0.02, p_slow=0.10,
+                     hang_s=0.8, slow_s=0.005)
+    with _svc(devices=2, hang_timeout_s=0.3) as svc:
+        # pre-compile every occupancy on both devices: a cold XLA
+        # compile inside a dispatch would read as a hang to the 0.3 s
+        # watchdog and the soak would measure compile churn, not chaos
+        for n_programs in (1, 2, 4):
+            svc.warmup(mps[0], shots=3, n_programs=n_programs)
+        with ChaosMonkey(svc, plan) as monkey:
+            report = soak(svc, mps, _CFG, n_requests=100, shots=3,
+                          seed=7, result_timeout_s=120.0)
+        assert monkey.script_exhausted()
+        assert report.submitted == 100
+        assert report.hung == 0, 'a handle result() timed out'
+        assert report.bit_mismatches == 0
+        assert report.terminated() == report.submitted
+        # under a 6-attempt budget and ~10% crash rate nothing should
+        # exhaust its retries; every submission completes
+        assert report.completed == 100, dict(report.errors)
+        stats = svc.stats()
+        assert stats['breaker_trips'] >= 1
+        assert stats['readmissions'] >= 1
+        assert stats['retries'] >= report.retries >= 1
+        # chaos is uninstalled: canaries now run clean, so every
+        # executor must come back, and a clean round must serve on it
+        _wait_all_live(svc)
+        rng = np.random.default_rng(123)
+        post = [(mp, _bits(rng)) for mp in mps for _ in range(2)]
+        handles = [svc.submit(mp, b, cfg=_CFG) for mp, b in post]
+        for (mp, b), h in zip(post, handles):
+            _assert_same(h.result(timeout=60.0), _solo(mp, b),
+                         'post-chaos round')
+        assert svc.stats()['health']['quarantined'] == 0
+
+
+# -- breaker lifecycle --------------------------------------------------
+
+
+def test_breaker_trip_quarantine_canary_readmit_single_executor():
+    """Two scripted crashes on the ONLY executor: breaker trips, the
+    in-flight request parks, a canary probe re-admits after cooldown,
+    and the parked request then completes bit-identically — service
+    heals with no healthy peer to lean on."""
+    mp, bits = _mp(), _bits(np.random.default_rng(0))
+    plan = ChaosPlan(seed=0, script=('crash', 'crash'))
+    with _svc() as svc:
+        with ChaosMonkey(svc, plan):
+            h = svc.submit(mp, bits, cfg=_CFG)
+            got = h.result(timeout=60.0)
+        _assert_same(got, _solo(mp, bits), 'healed request')
+        assert h.retries == 2
+        stats = svc.stats()
+        assert stats['breaker_trips'] >= 1
+        assert stats['readmissions'] >= 1
+        assert stats['canary']['ok'] >= 1
+        assert stats['health']['live'] == 1
+
+
+def test_circuit_breaker_unit():
+    br = CircuitBreaker(threshold=2, cooldown_s=1.0, cooldown_mult=2.0,
+                        max_cooldown_s=3.0)
+    assert not br.record_failure()
+    assert br.record_failure()          # streak hits the threshold
+    br.trip(now=100.0)
+    assert br.trips == 1
+    assert not br.ready_to_probe(100.5)
+    assert br.ready_to_probe(101.0)
+    br.trip(now=101.0)                  # failed canary: cooldown doubles
+    assert not br.ready_to_probe(102.5)
+    assert br.ready_to_probe(103.0)
+    br.readmit()
+    assert br.readmissions == 1
+    assert br.consecutive == 0
+    br.record_failure()
+    br.record_success()                 # success resets the streak
+    assert br.consecutive == 0
+    br.trip(now=200.0)                  # re-admission reset the cooldown
+    assert br.ready_to_probe(201.0)
+
+
+def test_retry_policy_schedule():
+    p = RetryPolicy(max_attempts=4, backoff_s=0.02, backoff_mult=2.0,
+                    max_backoff_s=0.05)
+    assert [p.delay_s(i) for i in range(4)] == [0.02, 0.04, 0.05, 0.05]
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        ChaosPlan(script=('explode',))
+    with pytest.raises(ValueError):
+        ChaosPlan(p_crash=0.9, p_hang=0.2)
+
+
+# -- retry budget -------------------------------------------------------
+
+
+def test_retry_budget_exhaustion_surfaces_original_error():
+    """A request that crashes on every attempt fails with the ORIGINAL
+    infrastructure error once the RetryPolicy budget is spent — typed,
+    not a timeout, not a generic wrapper."""
+    mp, bits = _mp(), _bits(np.random.default_rng(1))
+    plan = ChaosPlan(seed=0, script=('crash',) * 8)
+    with _svc(retry_policy=RetryPolicy(max_attempts=2,
+                                       backoff_s=0.005),
+              breaker_threshold=100) as svc:
+        with ChaosMonkey(svc, plan):
+            h = svc.submit(mp, bits, cfg=_CFG)
+            with pytest.raises(ChaosError, match='injected crash'):
+                h.result(timeout=60.0)
+        assert h.retries == 1           # attempt 2 of 2 was the last
+        stats = svc.stats()
+        assert stats['retry_exhausted'] == 1
+        assert stats['failed'] >= 1
+
+
+# -- overload control ---------------------------------------------------
+
+
+def test_overload_shed_and_deadline_reject():
+    """With the executor pinned busy and a warm service-time EWMA, a
+    higher-priority submission sheds the lowest-priority queued request
+    (it fails with OverloadError) and a submission whose deadline the
+    estimated wait already exceeds is rejected at admission."""
+    mp = _mp()
+    rng = np.random.default_rng(2)
+    with _svc(devices=None, max_batch_programs=1, max_wait_ms=0.0,
+              max_est_wait_ms=0.001, supervision=False) as svc:
+        # warm the EWMA (depth is 0 at each submit, so admission passes)
+        for _ in range(2):
+            svc.submit(mp, _bits(rng), cfg=_CFG).result(timeout=60.0)
+        assert svc.stats()['est_wait_ms'] is not None
+        started, release = threading.Event(), threading.Event()
+        orig = svc._run_batch
+
+        def pinned(ex, key, batch, cfg):
+            started.set()
+            release.wait(30.0)
+            return orig(ex, key, batch, cfg)
+
+        svc._run_batch = pinned
+        try:
+            busy = svc.submit(mp, _bits(rng), cfg=_CFG)
+            assert started.wait(30.0)
+            # depth 0 (the busy batch is claimed): admitted and queued
+            low = svc.submit(mp, _bits(rng), cfg=_CFG, priority=0)
+            # depth 1 -> est wait > max_est_wait_ms: the higher-priority
+            # newcomer evicts the queued low-priority request
+            high = svc.submit(mp, _bits(rng), cfg=_CFG, priority=1)
+            with pytest.raises(OverloadError, match='shed'):
+                low.result(timeout=5.0)
+            # deadline-aware early reject: the estimate alone already
+            # blows this deadline, so admission refuses synchronously
+            with pytest.raises(OverloadError, match='deadline'):
+                svc.submit(mp, _bits(rng), cfg=_CFG, deadline_ms=0.01)
+            # nothing of lower priority queued -> the newcomer itself
+            # is refused
+            with pytest.raises(OverloadError, match='overloaded'):
+                svc.submit(mp, _bits(rng), cfg=_CFG, priority=0)
+        finally:
+            release.set()
+        assert busy.result(timeout=60.0)
+        assert high.result(timeout=60.0)
+        stats = svc.stats()
+        assert stats['shed'] == 1
+        assert stats['overload_rejected'] == 2
+
+
+# -- executor death and hang --------------------------------------------
+
+
+def test_dispatcher_death_respawn_and_recovery():
+    """An injected BaseException kills the dispatcher thread outright;
+    the supervisor detects the dead thread, recovers the in-flight
+    batch, respawns the dispatcher, and the request completes."""
+    mp, bits = _mp(), _bits(np.random.default_rng(3))
+    plan = ChaosPlan(seed=0, script=('die',))
+    with _svc() as svc:
+        with ChaosMonkey(svc, plan):
+            h = svc.submit(mp, bits, cfg=_CFG)
+            got = h.result(timeout=60.0)
+        _assert_same(got, _solo(mp, bits), 'post-death request')
+        assert h.retries >= 1
+        stats = svc.stats()
+        assert stats['executor_deaths'] == 1
+        assert stats['devices'][0]['respawns'] == 1
+        assert stats['readmissions'] >= 1
+        assert stats['health']['live'] == 1
+
+
+def test_hang_watchdog_retries_elsewhere_stale_attempt_discarded():
+    """A dispatch hung past ``hang_timeout_s`` is detected by the
+    watchdog and retried on the healthy peer well before the hang
+    resolves; when the straggler finally completes, its stale attempt
+    token discards the late result instead of double-completing the
+    handle."""
+    mp, bits = _mp(), _bits(np.random.default_rng(4))
+    plan = ChaosPlan(seed=0, script=('hang',), hang_s=1.5)
+    with _svc(devices=2, hang_timeout_s=0.3) as svc:
+        # warm both executors so the retry is not a cold compile
+        svc.warmup(mp, shots=3, n_programs=1)
+        with ChaosMonkey(svc, plan):
+            t0 = time.monotonic()
+            h = svc.submit(mp, bits, cfg=_CFG)
+            got = h.result(timeout=60.0)
+            dt = time.monotonic() - t0
+            _assert_same(got, _solo(mp, bits), 'watchdog retry')
+            assert dt < 1.4, (
+                f'completion took {dt:.2f} s: the watchdog did not '
+                f'retry ahead of the 1.5 s hang')
+            assert h.retries >= 1
+            # let the straggler finish INSIDE the chaos window and
+            # prove its stale completion was discarded, not raced
+            time.sleep(1.6 - dt if dt < 1.6 else 0)
+            _assert_same(h.result(timeout=1.0), _solo(mp, bits),
+                         'post-straggler result unchanged')
+        stats = svc.stats()
+        assert stats['hangs'] >= 1
+        assert stats['breaker_trips'] >= 1
+
+
+# -- cancel vs retry race ----------------------------------------------
+
+
+def test_attempt_token_blocks_stale_completion():
+    h = RequestHandle()
+    t1 = h._claim()
+    assert t1 and not h.done()
+    assert h._requeue(t1)               # supervision retried it
+    assert h.retries == 1
+    assert not h._fulfill({'x': 1}, token=t1)   # straggler: stale token
+    assert not h._fail(RuntimeError('stale'), token=t1)
+    assert not h.done()
+    t2 = h._claim()
+    assert t2 and t2 != t1
+    assert h._fulfill({'x': 2}, token=t2)
+    assert h.result(timeout=0) == {'x': 2}
+
+
+def test_cancel_racing_retry_never_double_runs():
+    """cancel() between an infrastructure failure and the retry
+    re-queue wins: the handle is CancelledError, the retry re-queue is
+    refused, and a straggling attempt can no longer complete it."""
+    h = RequestHandle()
+    tok = h._claim()
+    assert not h.cancel()               # in flight: past the boundary
+    assert h._requeue(tok)              # infra failure parks it...
+    assert h.cancel()                   # ...and cancel wins the race
+    assert not h._requeue(tok)          # stale retry: refused
+    assert h._claim() == 0              # never dispatches again
+    assert not h._fulfill({'x': 3})
+    assert h.cancelled()
+    with pytest.raises(CancelledError):
+        h.result(timeout=0)
+
+
+def test_cancel_during_retry_backoff_in_service():
+    """Integration: a request parked for retry backoff is cancellable;
+    the parked entry is dropped and never re-dispatched."""
+    mp, bits = _mp(), _bits(np.random.default_rng(5))
+    plan = ChaosPlan(seed=0, script=('crash',) * 4)
+    with _svc(retry_policy=RetryPolicy(max_attempts=6, backoff_s=0.5),
+              breaker_threshold=100) as svc:
+        with ChaosMonkey(svc, plan):
+            h = svc.submit(mp, bits, cfg=_CFG)
+            deadline = time.monotonic() + 30.0
+            while h.retries == 0 and time.monotonic() < deadline:
+                time.sleep(0.005)       # first crash parks it
+            assert h.retries >= 1
+            assert h.cancel()
+            with pytest.raises(CancelledError):
+                h.result(timeout=5.0)
+        # the parked entry must drain without dispatching the handle
+        deadline = time.monotonic() + 10.0
+        while svc.stats()['parked'] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert svc.stats()['parked'] == 0
+        assert h.cancelled()
+
+
+# -- forced shutdown ----------------------------------------------------
+
+
+def test_forced_shutdown_never_deadlocks_result():
+    """Regression for the satellite contract: after
+    ``shutdown(drain=False)`` with a dispatch wedged mid-flight,
+    ``result(timeout=)`` raises typed ShutdownError — it must never
+    deadlock, and the straggler's late completion must not overwrite
+    the shutdown failure."""
+    mp = _mp()
+    rng = np.random.default_rng(6)
+    started, release = threading.Event(), threading.Event()
+    svc = _svc(supervision=False)
+    orig = svc._run_batch
+
+    def wedged(ex, key, batch, cfg):
+        started.set()
+        release.wait(30.0)
+        return orig(ex, key, batch, cfg)
+
+    svc._run_batch = wedged
+    try:
+        h_flight = svc.submit(mp, _bits(rng), cfg=_CFG)
+        assert started.wait(30.0)
+        h_queued = svc.submit(mp, _bits(rng), cfg=_CFG)
+        svc.shutdown(drain=False, timeout=0.3)
+        for h in (h_flight, h_queued):
+            with pytest.raises(ShutdownError):
+                h.result(timeout=5.0)
+        assert isinstance(h_flight.exception(timeout=0),
+                          CancelledError)   # ShutdownError subclasses it
+    finally:
+        release.set()
+        # join the straggling dispatcher so no thread outlives the test
+        # (the conftest leak probe watches the whole dproc-serve family)
+        svc.shutdown(drain=False)
+    with pytest.raises(ShutdownError):
+        h_flight.result(timeout=0)      # the late completion was stale

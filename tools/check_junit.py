@@ -38,6 +38,14 @@ NO_SKIP_MODULES = ('test_exec_pallas',)
 MULTIDEV_MODULE = 'test_serve_multidevice'
 MULTIDEV_OK_SKIP = 'host advertises 1 device'
 
+# the chaos suite proves the self-healing layer (supervision, retries,
+# breaker quarantine, canary re-admission) under injected faults; it
+# needs >= 2 virtual CPU devices, which the conftest always forces, so
+# a skip with any reason other than a single-device host means the
+# failure paths silently stopped being exercised
+CHAOS_MODULE = 'test_serve_chaos'
+CHAOS_OK_SKIP = 'host advertises 1 device'
+
 
 def _is_fault_test(tc) -> bool:
     ident = f'{tc.get("classname", "")}.{tc.get("name", "")}'.lower()
@@ -58,6 +66,7 @@ def main(path: str) -> int:
         print('FAILURE: no tests ran')
         return 1
     leaks, thread_leaks, bad_skips, dev_skips = [], [], [], []
+    chaos_skips = []
     for tc in root.iter('testcase'):
         ident = f'{tc.get("classname")}.{tc.get("name")}'
         skipped = tc.find('skipped')
@@ -70,6 +79,12 @@ def main(path: str) -> int:
                 (skipped.text or '')
             if MULTIDEV_OK_SKIP not in reason:
                 dev_skips.append(ident)
+        if skipped is not None \
+                and CHAOS_MODULE in tc.get('classname', ''):
+            reason = (skipped.get('message') or '') + \
+                (skipped.text or '')
+            if CHAOS_OK_SKIP not in reason:
+                chaos_skips.append(ident)
         for out in (tc.findall('system-out') + tc.findall('system-err')):
             if not out.text:
                 continue
@@ -98,7 +113,13 @@ def main(path: str) -> int:
                   f'skipped on a host advertising >1 device — the '
                   f'executor pool stopped being exercised (see '
                   f'docs/SERVING.md "multi-device")')
-    if leaks or thread_leaks or bad_skips or dev_skips:
+    if chaos_skips:
+        for name in chaos_skips:
+            print(f'BAD SKIP: {name}: serve chaos tests skipped — the '
+                  f'self-healing failure paths (retry/breaker/canary) '
+                  f'stopped being exercised (see docs/ROBUSTNESS.md '
+                  f'"serving-layer failures")')
+    if leaks or thread_leaks or bad_skips or dev_skips or chaos_skips:
         return 1
     print(f'junit OK: {n_tests} tests, no failures, no fault leaks, '
           f'no leaked service threads, no gated skips')

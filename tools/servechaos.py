@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Serve-layer chaos soak driver: no injected fault may HANG or CORRUPT.
+
+Stands up a live ExecutionService, wraps its ``_run_batch`` with the
+seeded :class:`~distributed_processor_tpu.serve.chaos.ChaosMonkey`
+(crashes, hangs past the watchdog, slowdowns, dispatcher deaths), and
+soaks it with a stream of requests.  The pass criteria are the serving
+contract under fire (docs/ROBUSTNESS.md "serving-layer failures"):
+
+* every handle terminates — zero ``result()`` timeouts;
+* every completion is bit-identical to its solo ``simulate_batch`` run;
+* every failure is a TYPED error (retry budget exhausted surfaces the
+  original fault, shutdown surfaces ShutdownError, ...).
+
+Deterministic in ``--seed`` (injection draws are serialized under one
+lock; thread interleaving varies but the outcome invariants must hold
+for every interleaving — that is the point).  Exit nonzero on any
+violation.  The sim-layer analogue is tools/faultfuzz.py; this is the
+same discipline one tier up:
+
+    python tools/servechaos.py --quick         # ~30 s, 60 requests
+    python tools/servechaos.py                 # full: 200 requests
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# the multi-device soak needs >= 2 devices; force a virtual 2-device
+# CPU before jax initialises (a no-op when a real multi-device platform
+# or the test conftest already configured one)
+if 'JAX_PLATFORMS' not in os.environ:
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=2').strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument('--quick', action='store_true',
+                    help='CI mode: 60 requests, milder injection')
+    ap.add_argument('-n', type=int, default=None,
+                    help='request count (default 60 quick / 200 full)')
+    ap.add_argument('--seed', type=int, default=0,
+                    help='soak seed (bits + injection draws)')
+    ap.add_argument('--devices', type=int, default=2,
+                    help='executor pool size (default 2)')
+    ap.add_argument('--shots', type=int, default=4)
+    ap.add_argument('--qubits', type=int, default=2)
+    ap.add_argument('--depth', type=int, default=2)
+    ap.add_argument('--p-crash', type=float, default=0.10)
+    ap.add_argument('--p-hang', type=float, default=0.03)
+    ap.add_argument('--p-slow', type=float, default=0.10)
+    ap.add_argument('--p-die', type=float, default=0.02)
+    ap.add_argument('--hang-s', type=float, default=1.0,
+                    help='injected hang duration (past the watchdog)')
+    ap.add_argument('--json', action='store_true',
+                    help='emit the report as JSON on stdout')
+    args = ap.parse_args(argv)
+
+    from distributed_processor_tpu.serve import (ChaosMonkey, ChaosPlan,
+                                                 ExecutionService,
+                                                 RetryPolicy)
+    from distributed_processor_tpu.serve.benchmark import _workload
+    from distributed_processor_tpu.serve.chaos import soak
+
+    n = args.n if args.n is not None else (60 if args.quick else 200)
+    p_crash = args.p_crash * (0.5 if args.quick else 1.0)
+    p_die = args.p_die * (0.5 if args.quick else 1.0)
+    mps, _bits, cfg = _workload(min(n, 12), args.qubits, args.depth,
+                                args.shots, args.seed)
+    plan = ChaosPlan(seed=args.seed, p_crash=p_crash, p_hang=args.p_hang,
+                     p_slow=args.p_slow, p_die=p_die,
+                     hang_s=args.hang_s, slow_s=0.01)
+    t0 = time.monotonic()
+    with ExecutionService(
+            cfg, max_batch_programs=4, max_wait_ms=5.0,
+            max_queue=4 * n, devices=args.devices,
+            retry_policy=RetryPolicy(max_attempts=6, backoff_s=0.01),
+            hang_timeout_s=0.4, breaker_threshold=3,
+            breaker_cooldown_ms=100.0,
+            supervise_interval_ms=10.0) as svc:
+        with ChaosMonkey(svc, plan) as monkey:
+            report = soak(svc, mps, cfg, n_requests=n,
+                          shots=args.shots, seed=args.seed,
+                          result_timeout_s=120.0)
+        stats = svc.stats()
+    wall_s = time.monotonic() - t0
+
+    out = {
+        'requests': n,
+        'devices': args.devices,
+        'seed': args.seed,
+        'injected': dict(monkey.injected),
+        'submitted': report.submitted,
+        'rejected': report.rejected,
+        'completed': report.completed,
+        'hung': report.hung,
+        'bit_mismatches': report.bit_mismatches,
+        'failed_typed': dict(report.errors),
+        'retries': stats['retries'],
+        'retry_exhausted': stats['retry_exhausted'],
+        'breaker_trips': stats['breaker_trips'],
+        'readmissions': stats['readmissions'],
+        'hangs_detected': stats['hangs'],
+        'executor_deaths': stats['executor_deaths'],
+        'wall_s': round(wall_s, 3),
+    }
+    failures = []
+    if report.hung:
+        failures.append(f'{report.hung} handle(s) HUNG past the '
+                        f'result timeout')
+    if report.bit_mismatches:
+        failures.append(f'{report.bit_mismatches} completion(s) not '
+                        f'bit-identical to the solo run')
+    if report.terminated() != report.submitted:
+        failures.append(f'{report.submitted - report.terminated()} '
+                        f'handle(s) neither completed nor typed-failed')
+    out['ok'] = not failures
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        for k, v in out.items():
+            print(f'{k:>18}: {v}')
+    for msg in failures:
+        print(f'SERVECHAOS FAIL: {msg}', file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
