@@ -43,7 +43,11 @@ defaults 1,2/32/64 — runs in a forced-device-count CPU child when this
 process sees fewer devices), BENCH_SERVE_OPEN_REQS/
 BENCH_SERVE_OPEN_RATE/BENCH_SERVE_OPEN_DEVICES (the open-loop latency
 row: request count, Poisson arrival rate in Hz, optional executor
-count; defaults 48/40/single-device).
+count; defaults 48/40/single-device),
+BENCH_COMPILE_TENANTS/BENCH_COMPILE_PROGRAMS/BENCH_COMPILE_DEPTH/
+BENCH_COMPILE_SHOTS/BENCH_COMPILE_THREADS (the compile front-door row:
+tenants x distinct programs of that RB depth, shots per submit_source
+request, stampede width; defaults 4/4/4/8/8).
 
 Besides the final stdout line, every completed row is written
 incrementally and atomically to BENCH_ARTIFACT (default
@@ -122,8 +126,9 @@ from distributed_processor_tpu.pipeline import compile_to_machine
 from distributed_processor_tpu.models import (
     active_reset, rb_program, make_default_qchip, couplings_from_qchip)
 from distributed_processor_tpu.serve.benchmark import (
-    availability_under_chaos, continuous_batching_comparison,
-    multi_device_scaling, open_loop_latency)
+    availability_under_chaos, compile_front_door,
+    continuous_batching_comparison, multi_device_scaling,
+    open_loop_latency)
 from distributed_processor_tpu.sim.interpreter import InterpreterConfig
 from distributed_processor_tpu.sim.physics import (
     ReadoutPhysics, run_physics_batch, prepare_physics_tables)
@@ -866,6 +871,10 @@ def _degraded_rerun(attempts):
                  ('BENCH_SERVE_OPEN_RATE', '30'),
                  ('BENCH_CHAOS_REQS', '24'),
                  ('BENCH_CHAOS_RATE', '40'),
+                 ('BENCH_COMPILE_TENANTS', '3'),
+                 ('BENCH_COMPILE_PROGRAMS', '2'),
+                 ('BENCH_COMPILE_DEPTH', '2'),
+                 ('BENCH_COMPILE_SHOTS', '8'),
                  # exec_profile row under the kernel interpreter: tiny
                  # batches, one rep — the (a, b) fit is still real
                  ('PROFILE_BATCHES', '64,128,256'),
@@ -953,6 +962,23 @@ def _serve_chaos_row():
         p_crash=float(os.environ.get('BENCH_CHAOS_P_CRASH', 0.08)),
         p_hang=float(os.environ.get('BENCH_CHAOS_P_HANG', 0.02)),
         p_slow=float(os.environ.get('BENCH_CHAOS_P_SLOW', 0.10)))
+
+
+def _compile_front_door_row():
+    """Multi-tenant compile front door: N tenants x M duplicate source
+    programs through the content-addressed compile cache vs uncached
+    compile-per-request.  The row itself asserts the contract — exactly
+    M cold compiles, 100% warm hit rate, a concurrent stampede
+    compiling exactly once (singleflight), submit_source bit-identical
+    to compile+submit, warm speedup >= 10x (serve/benchmark.py)."""
+    return compile_front_door(
+        n_tenants=int(os.environ.get('BENCH_COMPILE_TENANTS', 4)),
+        n_programs=int(os.environ.get('BENCH_COMPILE_PROGRAMS', 4)),
+        depth=int(os.environ.get('BENCH_COMPILE_DEPTH', 4)),
+        shots=int(os.environ.get('BENCH_COMPILE_SHOTS', 8)),
+        seed=int(os.environ.get('BENCH_COMPILE_SEED', 0)),
+        stampede_threads=int(os.environ.get('BENCH_COMPILE_THREADS',
+                                            8)))
 
 
 def main():
@@ -1427,6 +1453,18 @@ def main():
         serve_chaos = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('availability_under_chaos', serve_chaos)
 
+    # compile front-door row: duplicate-program tenant traffic through
+    # the content-addressed source->MachineProgram cache (dedup,
+    # singleflight, submit_source bit-identity asserted inside)
+    try:
+        front_door = _timed_row(_compile_front_door_row) \
+            if secondaries else None
+    except _RowTimeout as e:
+        front_door = {'error': 'timeout', 'detail': str(e)}
+    except Exception as e:      # pragma: no cover - defensive
+        front_door = {'error': f'{type(e).__name__}: {e}'[:200]}
+    artifact.row('compile_front_door', front_door)
+
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
     result = {
@@ -1476,6 +1514,7 @@ def main():
             'continuous_batching': serve_row,
             'serve_open_loop': serve_open,
             'availability_under_chaos': serve_chaos,
+            'compile_front_door': front_door,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

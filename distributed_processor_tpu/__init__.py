@@ -18,6 +18,9 @@ Layers:
 * :mod:`.serve` — continuous-batching execution service: async
   submission, shape-bucketed coalescing, per-request futures (imported
   explicitly — it pulls in jax)
+* :mod:`.compilecache` — multi-tenant compile front door: a
+  content-addressed source->MachineProgram cache with singleflight,
+  persistence and calibration-epoch invalidation
 """
 
 __version__ = '0.1.0'
@@ -31,6 +34,7 @@ from . import ir
 from . import compiler
 from . import assembler
 from . import decoder
+from . import compilecache
 
 from .hwconfig import FPGAConfig, ChannelConfig, FPROCChannel, load_channel_configs
 from .elements import TPUElementConfig

@@ -38,6 +38,9 @@ def _make_sim(args):
 
 
 def cmd_compile(args):
+    if args.cache_dir:
+        cmd_compile_cached(args)
+        return
     prog, _ = _compile_asm(args)
     if args.output:
         prog.save(args.output)
@@ -47,6 +50,39 @@ def cmd_compile(args):
             print(f'# core group {grp}')
             for i in instrs:
                 print(f'  {i}')
+
+
+def cmd_compile_cached(args):
+    """``compile --cache-dir DIR``: source -> MachineProgram through
+    the persistent content-addressed compile cache; prints one JSON
+    line with hit/miss status, the content key and cache counters (a
+    second identical invocation reports a disk hit)."""
+    if args.output:
+        raise SystemExit('--cache-dir prints a cache summary; '
+                         '-o/--output applies to assembly output only')
+    import time
+    from .compilecache import CompileCache
+    sim = _make_sim(args)
+    program = _load_program(args.program, args.qasm)
+    cache = CompileCache(cache_dir=args.cache_dir)
+    t0 = time.perf_counter()
+    mp, status, key = cache.get_or_compile(
+        program, sim.qchip, channel_configs=sim.channel_configs,
+        fpga_config=sim.fpga_config, n_qubits=args.qubits)
+    dt = time.perf_counter() - t0
+    stats = cache.stats()
+    print(json.dumps({
+        'status': status,                 # miss | disk (warm across runs)
+        'hit': status != 'miss',
+        'key': key,
+        'qchip_fingerprint': sim.qchip.fingerprint(),
+        'n_cores': mp.n_cores,
+        'n_instr': mp.n_instr,
+        'elapsed_ms': round(dt * 1e3, 3),
+        'cache_dir': args.cache_dir,
+        'cache': {k: stats[k] for k in
+                  ('hits', 'misses', 'disk_hits', 'size')},
+    }, indent=2))
 
 
 def _compile_asm(args):
@@ -358,10 +394,19 @@ def cmd_trace(args):
 
 def cmd_serve_bench(args):
     from .serve.benchmark import (availability_under_chaos,
+                                  compile_front_door,
                                   continuous_batching_comparison,
                                   multi_device_scaling,
                                   open_loop_latency)
-    if args.chaos:
+    if args.source_mode:
+        # the compile front door: tenants submit SOURCE programs via
+        # submit_source; content-addressed dedup + singleflight +
+        # bit-identity vs compile+submit asserted inside the row
+        row = compile_front_door(
+            n_tenants=args.tenants, n_programs=args.programs,
+            n_qubits=args.qubits, depth=args.depth, shots=args.shots,
+            seed=args.seed)
+    elif args.chaos:
         # availability under injected faults: crash/hang/slowdown under
         # _run_batch, goodput + tails with the supervision stack
         # (retries, breaker quarantine, canary re-admission) healing
@@ -405,6 +450,11 @@ def main(argv=None):
     p = sub.add_parser('compile', help='compile to per-core assembly')
     p.add_argument('program')
     p.add_argument('-o', '--output')
+    p.add_argument('--cache-dir', metavar='DIR',
+                   help='compile source -> MachineProgram through the '
+                        'persistent content-addressed compile cache '
+                        'rooted here; prints hit/miss JSON (rerun the '
+                        'same command to see the warm disk hit)')
     p.set_defaults(fn=cmd_compile)
 
     p = sub.add_parser('disasm', help='full-operand disassembly of the '
@@ -607,6 +657,18 @@ def main(argv=None):
     p.add_argument('--p-slow', type=float, default=0.10,
                    help='chaos: per-dispatch injected slowdown '
                         'probability (below the watchdog)')
+    p.add_argument('--source-mode', action='store_true',
+                   help='compile front-door mode: tenants submit '
+                        'SOURCE programs via submit_source through '
+                        'the content-addressed compile cache; reports '
+                        'cold compiles, warm hit rate, singleflight '
+                        'dedup and speedup vs uncached '
+                        'compile-per-request (bit-identity asserted)')
+    p.add_argument('--tenants', type=int, default=4,
+                   help='source-mode: tenants submitting the same '
+                        'program set')
+    p.add_argument('--programs', type=int, default=4,
+                   help='source-mode: distinct programs per tenant')
     p.set_defaults(fn=cmd_serve_bench)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')

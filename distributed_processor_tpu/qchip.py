@@ -20,6 +20,7 @@ JSON format::
 from __future__ import annotations
 
 import copy
+import hashlib
 import json
 from dataclasses import dataclass, field
 
@@ -180,3 +181,27 @@ class QChip:
     def to_dict(self) -> dict:
         return {'Qubits': copy.deepcopy(self.qubits),
                 'Gates': {name: g.to_dict() for name, g in self.gates.items()}}
+
+    def fingerprint(self) -> str:
+        """Stable content hash of the calibration state (frequency table
+        + gate library): equal for two QChips built from the same source
+        regardless of dict-key order, changed by any retune — one gate
+        amplitude, one qubit frequency.  This names the *calibration
+        epoch* in compile-cache keys (see compilecache/), so a qchip
+        update invalidates exactly the entries compiled against it.
+        """
+        blob = json.dumps(self.to_dict(), sort_keys=True,
+                          default=_fingerprint_default,
+                          separators=(',', ':'))
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _fingerprint_default(obj):
+    """json.dumps fallback for calibration values that aren't JSON
+    scalars: numpy arrays/scalars (duck-typed, no numpy import here)
+    and complex amplitudes; anything else keys on its repr."""
+    if isinstance(obj, complex):
+        return ['__complex__', obj.real, obj.imag]
+    if hasattr(obj, 'dtype') and hasattr(obj, 'tolist'):
+        return obj.tolist()
+    return repr(obj)

@@ -431,6 +431,141 @@ def availability_under_chaos(n_reqs: int = 80, rate_hz: float = 60.0,
     }
 
 
+def compile_front_door(n_tenants: int = 4, n_programs: int = 4,
+                       n_qubits: int = 2, depth: int = 4,
+                       shots: int = 8, seed: int = 0,
+                       stampede_threads: int = 8,
+                       max_wait_ms: float = 5.0) -> dict:
+    """The multi-tenant compile front door, timed: ``n_tenants`` tenants
+    each submit the SAME ``n_programs`` textbook programs (the cloud
+    workload: a million users, one RB curriculum).
+
+    Three executions of the N x M duplicate-program traffic: (a)
+    uncached compile-per-request — every tenant pays a full
+    ``compile_to_machine``; (b) the content-addressed cache, cold — M
+    compiles, everything else hits; (c) the cache fully warm.  The row
+    asserts the contract before reporting numbers: exactly M cold
+    compiles, a 100% warm hit rate, an ``stampede_threads``-way
+    concurrent stampede on a fresh program compiling EXACTLY once
+    (singleflight), cached programs byte-identical to direct compiles,
+    ``submit_source`` results bit-identical to compile+submit, and a
+    >= 10x warm speedup.
+    """
+    import threading
+    from ..compilecache import CompileCache, machine_program_bytes
+    qubits = [f'Q{i}' for i in range(n_qubits)]
+    qchip = make_default_qchip(n_qubits)
+    sources = [active_reset(qubits) + p
+               for p in rb_ensemble(qubits, depth, n_programs,
+                                    seed=seed)]
+    traffic = sources * n_tenants       # every tenant, every program
+
+    t0 = time.perf_counter()
+    direct = {}
+    for i, prog in enumerate(traffic):
+        mp = compile_to_machine(prog, qchip, n_qubits=n_qubits)
+        if i < n_programs:
+            direct[i] = mp
+    t_uncached = time.perf_counter() - t0
+
+    cache = CompileCache()
+    t0 = time.perf_counter()
+    for prog in traffic:
+        cache.get_or_compile(prog, qchip, n_qubits=n_qubits)
+    t_cold = time.perf_counter() - t0
+    st = cache.stats()
+    cold_compiles, cold_hits = st['misses'], st['hits']
+    t0 = time.perf_counter()
+    cached = [cache.get_or_compile(prog, qchip, n_qubits=n_qubits)[0]
+              for prog in traffic]
+    t_warm = time.perf_counter() - t0
+    warm_hits = cache.stats()['hits'] - cold_hits
+
+    if cold_compiles != n_programs:
+        raise AssertionError(
+            f'{cold_compiles} cold compiles for {n_programs} distinct '
+            f'programs — content addressing failed to dedup')
+    if warm_hits != len(traffic):
+        raise AssertionError(
+            f'warm pass hit {warm_hits}/{len(traffic)} — cache lost '
+            f'entries it should have kept')
+    for i in range(n_programs):
+        if (machine_program_bytes(cached[i])
+                != machine_program_bytes(direct[i])):
+            raise AssertionError(
+                f'cached program {i} is not byte-identical to its '
+                f'direct compile')
+    warm_speedup = t_uncached / t_warm
+    if warm_speedup < 10.0:
+        raise AssertionError(
+            f'warm speedup {warm_speedup:.1f}x < 10x — the front door '
+            f'is not paying for itself on duplicate traffic')
+
+    # singleflight: a concurrent stampede on a program the cache has
+    # never seen must compile exactly once (waiters that arrive after
+    # the flight lands count as plain hits — equally deduplicated)
+    fresh = active_reset(qubits) + rb_ensemble(
+        qubits, depth, 1, seed=seed + 999)[0]
+    misses_before = cache.stats()['misses']
+    barrier = threading.Barrier(stampede_threads)
+
+    def _stampede():
+        barrier.wait()
+        cache.get_or_compile(fresh, qchip, n_qubits=n_qubits)
+
+    threads = [threading.Thread(target=_stampede)
+               for _ in range(stampede_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stampede_compiles = cache.stats()['misses'] - misses_before
+    if stampede_compiles != 1:
+        raise AssertionError(
+            f'{stampede_threads}-way stampede compiled '
+            f'{stampede_compiles} times — singleflight failed')
+
+    # submit_source end-to-end: bit-identical to compile+submit
+    svc = ExecutionService(max_wait_ms=max_wait_ms,
+                           compile_cache=cache)
+    try:
+        refs = [svc.submit(direct[i], shots=shots).result(timeout=600)
+                for i in range(n_programs)]
+        handles = [svc.submit_source(p, qchip, shots=shots,
+                                     n_qubits=n_qubits)
+                   for p in sources]
+        res = [h.result(timeout=600) for h in handles]
+        _assert_bit_identical(res, refs, 'submit_source')
+        svc_stats = svc.stats()
+    finally:
+        svc.shutdown()
+
+    cc = svc_stats['compile_cache']
+    return {
+        'n_tenants': n_tenants, 'n_programs': n_programs,
+        'n_qubits': n_qubits, 'depth': depth,
+        'traffic_requests': len(traffic),
+        'uncached_s': round(t_uncached, 4),
+        'cached_cold_s': round(t_cold, 4),
+        'cached_warm_s': round(t_warm, 4),
+        'cold_compiles': cold_compiles,
+        'warm_hit_rate': 1.0,
+        'traffic_speedup': round(t_uncached / t_cold, 2),
+        'warm_speedup': round(warm_speedup, 1),
+        'stampede_threads': stampede_threads,
+        'stampede_compiles': stampede_compiles,
+        'singleflight_waits': cc['singleflight_waits'],
+        'compile_ms_p50': cc['compile_ms_p50'],
+        'compile_ms_p99': cc['compile_ms_p99'],
+        'bit_identical': True,
+        'note': 'N tenants x M duplicate programs; asserted before '
+                'reporting: M cold compiles, 100% warm hits, stampede '
+                'compiles exactly once, cached bytes == direct bytes, '
+                'submit_source bit-identical to compile+submit, '
+                'warm speedup >= 10x',
+    }
+
+
 def _main(argv=None):
     """Standalone entry: ``python -m distributed_processor_tpu.serve.
     benchmark scaling|openloop ...`` prints one JSON row — bench.py
@@ -457,6 +592,14 @@ def _main(argv=None):
     o.add_argument('--devices', type=int, default=None)
     o.add_argument('--qubits', type=int, default=2)
     o.add_argument('--seed', type=int, default=0)
+    f = sub.add_parser('frontdoor', help='compile front-door row')
+    f.add_argument('--tenants', type=int, default=4)
+    f.add_argument('--programs', type=int, default=4)
+    f.add_argument('--depth', type=int, default=4)
+    f.add_argument('--shots', type=int, default=8)
+    f.add_argument('--qubits', type=int, default=2)
+    f.add_argument('--seed', type=int, default=0)
+    f.add_argument('--stampede', type=int, default=8)
     c = sub.add_parser('chaos', help='availability-under-chaos row')
     c.add_argument('--reqs', type=int, default=80)
     c.add_argument('--rate', type=float, default=60.0)
@@ -479,6 +622,11 @@ def _main(argv=None):
             n_reqs=args.reqs, rate_hz=args.rate, n_qubits=args.qubits,
             depths=[int(x) for x in args.depths.split(',') if x],
             shots=args.shots, seed=args.seed, devices=args.devices)
+    elif args.mode == 'frontdoor':
+        row = compile_front_door(
+            n_tenants=args.tenants, n_programs=args.programs,
+            n_qubits=args.qubits, depth=args.depth, shots=args.shots,
+            seed=args.seed, stampede_threads=args.stampede)
     else:
         row = availability_under_chaos(
             n_reqs=args.reqs, rate_hz=args.rate, n_qubits=args.qubits,

@@ -56,6 +56,7 @@ import collections
 import itertools
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import replace
 
 import numpy as np
@@ -72,7 +73,7 @@ from ..utils import profiling
 from .batcher import Coalescer, bucket_key
 from .request import (CancelledError, DeadlineError, ExecutorLostError,
                       OverloadError, QueueFullError, Request,
-                      ServiceClosedError, ShutdownError)
+                      RequestHandle, ServiceClosedError, ShutdownError)
 from .supervise import (HEALTH_LIVE, HEALTH_PROBING, HEALTH_QUARANTINED,
                         CircuitBreaker, RetryPolicy)
 
@@ -83,6 +84,7 @@ from .supervise import (HEALTH_LIVE, HEALTH_PROBING, HEALTH_QUARANTINED,
 DISPATCH_THREAD_PREFIX = 'dproc-serve-dispatch'
 SUPERVISE_THREAD_PREFIX = 'dproc-serve-supervise'
 CANARY_THREAD_PREFIX = 'dproc-serve-canary'
+COMPILE_THREAD_PREFIX = 'dproc-serve-compile'
 
 _SERVICE_SEQ = itertools.count()
 
@@ -299,7 +301,9 @@ class ExecutionService:
                  breaker_threshold: int = 3,
                  breaker_cooldown_ms: float = 250.0,
                  supervise_interval_ms: float = 25.0,
-                 max_est_wait_ms: float = None):
+                 max_est_wait_ms: float = None,
+                 compile_cache=None, compile_workers: int = 2,
+                 compile_cache_dir: str = None):
         if max_batch_programs < 1:
             raise ValueError('max_batch_programs must be >= 1')
         if max_queue < 1:
@@ -388,6 +392,15 @@ class ExecutionService:
         self._ewma_prog_s = None
         self._canary_mp = None         # lazily-built tiny probe program
         self._canary_ref = None        # first canary result: bit reference
+        # -- compile front door (guarded by _cv's lock where noted) ------
+        if compile_workers < 1:
+            raise ValueError('compile_workers must be >= 1')
+        self._compile_cache = compile_cache
+        self._compile_cache_dir = compile_cache_dir
+        self._compile_workers = compile_workers
+        self._compile_pool = None      # lazily created on first submit_source
+        self._source_submitted = 0
+        self._source_handles = set()   # outer handles awaiting compile
         for ex in self._executors:
             ex.thread.start()
         self._supervisor = None
@@ -403,7 +416,7 @@ class ExecutionService:
     def submit(self, mp, meas_bits=None, *, shots: int = None,
                init_regs=None, cfg: InterpreterConfig = None,
                priority: int = 0, deadline_ms: float = None,
-               fault_mode: str = None):
+               fault_mode: str = None, _handle: RequestHandle = None):
         """Queue one program for execution; returns its
         :class:`RequestHandle` immediately.
 
@@ -482,10 +495,15 @@ class ExecutionService:
                 raise QueueFullError(
                     f'queue full ({self.max_queue} requests pending)')
             self._admit_overload_locked(priority, deadline)
+            # _handle: submit_source hands over the outer handle it
+            # already returned to the tenant, so the dispatcher fulfills
+            # that handle directly (no compile-pool thread ever blocks
+            # on execution)
+            hkw = {} if _handle is None else {'handle': _handle}
             req = Request(mp=mp, meas_bits=meas_bits,
                           init_regs=init_regs, cfg=cfg, strict=strict,
                           n_shots=n_shots, priority=priority,
-                          deadline=deadline, seq=next(self._seq))
+                          deadline=deadline, seq=next(self._seq), **hkw)
             tgt = self._route_locked(key)
             if tgt is None:
                 # every executor is quarantined/probing: park the
@@ -497,6 +515,91 @@ class ExecutionService:
             profiling.counter_inc('serve.submitted')
             self._cv.notify_all()
         return req.handle
+
+    # -- the compile front door ------------------------------------------
+
+    @property
+    def compile_cache(self):
+        """The service's :class:`~..compilecache.CompileCache` (created
+        on first touch unless one was injected at construction)."""
+        with self._cv:
+            if self._compile_cache is None:
+                from ..compilecache import CompileCache
+                self._compile_cache = CompileCache(
+                    cache_dir=self._compile_cache_dir)
+            return self._compile_cache
+
+    def submit_source(self, program, qchip, *, shots: int = None,
+                      meas_bits=None, init_regs=None,
+                      cfg: InterpreterConfig = None, priority: int = 0,
+                      deadline_ms: float = None, fault_mode: str = None,
+                      n_qubits: int = 8, pad_to: int = None,
+                      channel_configs=None, fpga_config=None,
+                      compiler_flags=None):
+        """Submit PROGRAM SOURCE — a dict-instruction list or OpenQASM 3
+        text — instead of a pre-built MachineProgram; returns a
+        :class:`RequestHandle` immediately.
+
+        The program compiles-or-hits through the service's content-
+        addressed :class:`~..compilecache.CompileCache` on a small
+        compile worker pool (``compile_workers``), so compilation never
+        blocks the dispatcher threads; the compiled request then flows
+        through :meth:`submit` onto the SAME handle.  Results are
+        bit-identical to ``compile_to_machine`` + ``submit``
+        (tests/test_compilecache.py pins it).  Failures surface typed
+        on the handle: :class:`~..decoder.ProgramValidationError` with
+        ``(core, instr)`` coordinates for a malformed program,
+        :class:`QueueFullError`/:class:`OverloadError` at admission,
+        :class:`ShutdownError` when the service closes first.
+        ``deadline_ms`` arms at dispatch (compile time is not charged
+        against it).
+        """
+        handle = RequestHandle()
+        with self._cv:
+            if self._closing:
+                raise ServiceClosedError(
+                    f'service {self.name!r} is shut down')
+            if self._compile_pool is None:
+                self._compile_pool = ThreadPoolExecutor(
+                    max_workers=self._compile_workers,
+                    thread_name_prefix=(
+                        f'{COMPILE_THREAD_PREFIX}-{self.name}'))
+            pool = self._compile_pool
+            self._source_submitted += 1
+            self._source_handles.add(handle)
+        cache = self.compile_cache
+
+        def _compile_and_submit():
+            try:
+                if handle.cancelled():
+                    return
+                mp, _status, _key = cache.get_or_compile(
+                    program, qchip, channel_configs=channel_configs,
+                    fpga_config=fpga_config,
+                    compiler_flags=compiler_flags, n_qubits=n_qubits,
+                    pad_to=pad_to)
+                self.submit(mp, meas_bits, shots=shots,
+                            init_regs=init_regs, cfg=cfg,
+                            priority=priority, deadline_ms=deadline_ms,
+                            fault_mode=fault_mode, _handle=handle)
+            except BaseException as e:
+                handle._fail(e)
+            finally:
+                with self._cv:
+                    self._source_handles.discard(handle)
+
+        try:
+            pool.submit(_compile_and_submit)
+        except RuntimeError as e:
+            # pool shut down between our check and the enqueue
+            with self._cv:
+                self._source_handles.discard(handle)
+            handle._fail(ServiceClosedError(
+                f'service {self.name!r} is shut down'))
+            raise ServiceClosedError(
+                f'service {self.name!r} is shut down') from e
+        profiling.counter_inc('serve.source_submitted')
+        return handle
 
     def _admit_overload_locked(self, priority: int, deadline) -> None:
         """Overload control (``max_est_wait_ms``): estimate how long
@@ -1230,8 +1333,17 @@ class ExecutionService:
                     'per_bucket': {k: dict(v) for k, v in sorted(
                         self._bucket_compiles.items())},
                 },
+                'source': {
+                    'submitted': self._source_submitted,
+                    'pending_compile': len(self._source_handles),
+                },
                 'devices': devices,
             }
+            cache = self._compile_cache
+        # program-compile front door counters (hit/miss/singleflight/
+        # evict/invalidation + compile-time percentiles); None until the
+        # first submit_source/compile_cache touch
+        snap['compile_cache'] = None if cache is None else cache.stats()
         if lat.size:
             snap['latency_p50_ms'] = float(np.percentile(lat, 50) * 1e3)
             snap['latency_p99_ms'] = float(np.percentile(lat, 99) * 1e3)
@@ -1251,7 +1363,19 @@ class ExecutionService:
         then force-fails ANY handle still unresolved — after shutdown
         returns, ``result()`` can never block forever, even when a
         dispatch hung or a dispatcher died (the late straggler's
-        completion is discarded as stale).  Idempotent."""
+        completion is discarded as stale).  Idempotent.
+
+        The compile front door participates: ``drain=True`` finishes
+        every pending ``submit_source`` compile BEFORE the queues close
+        (so its requests flush with the rest); ``drain=False`` cancels
+        queued compiles and fails their handles with
+        :class:`ShutdownError`."""
+        with self._cv:
+            pool = self._compile_pool
+        if drain and pool is not None:
+            # let in-flight source submissions compile and enqueue
+            # before the intake closes; their requests then drain below
+            pool.shutdown(wait=True)
         with self._cv:
             if not self._closing:
                 self._closing = True
@@ -1271,6 +1395,24 @@ class ExecutionService:
                     if n:
                         profiling.counter_inc('serve.cancelled', n)
             self._cv.notify_all()
+        if not drain and pool is not None:
+            # cancel queued compiles; a compile already running hits
+            # the closed intake (ServiceClosedError) and fails its own
+            # handle.  wait=True keeps the thread-leak probe clean.
+            pool.shutdown(wait=True, cancel_futures=True)
+            exc = ShutdownError(
+                f'service {self.name!r} shut down without draining')
+            with self._cv:
+                pending_src = list(self._source_handles)
+                self._source_handles.clear()
+            n = 0
+            for h in pending_src:
+                if h._fail(exc):
+                    n += 1
+            if n:
+                with self._cv:
+                    self._cancelled += n
+                profiling.counter_inc('serve.cancelled', n)
         for ex in self._executors:
             ex.thread.join(timeout)
         if self._supervisor is not None:
@@ -1301,6 +1443,10 @@ class ExecutionService:
             leftovers.extend(r for _, _, r in self._parked)
             self._parked = []
             n = 0
+            for h in self._source_handles:
+                if h._fail(exc):
+                    n += 1
+            self._source_handles.clear()
             for req in leftovers:
                 if req.handle._fail(exc):
                     n += 1

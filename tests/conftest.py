@@ -52,19 +52,24 @@ REFERENCE_ROOT = os.environ.get('DPROC_REFERENCE_ROOT', '/root/reference')
 @pytest.fixture(autouse=True)
 def _serve_thread_leak_probe():
     """Print the junit-gated marker when a test leaks any execution-
-    service thread — dispatcher, supervisor or canary probe, i.e. the
-    whole ``dproc-serve`` prefix family (tools/check_junit.py fails
-    CI on it).
+    service thread — dispatcher, supervisor, canary probe, or a
+    compile-front-door worker (``dproc-serve-compile-*``, the
+    ``submit_source`` pool), i.e. the whole ``dproc-serve`` prefix
+    family (tools/check_junit.py fails CI on it).
 
     A leaked dispatcher outlives its test, keeps a jit cache reference
     alive, and can dispatch into a torn-down fixture; a leaked
-    supervisor keeps respawning them — the serving analog of the
-    fault-leak gate: tests must shut their services down
-    (ExecutionService is a context manager)."""
+    supervisor keeps respawning them; a leaked compile worker can
+    finish a compile after teardown and submit into a dead service —
+    the serving analog of the fault-leak gate: tests must shut their
+    services down (ExecutionService is a context manager, and
+    ``shutdown`` joins the compile pool in both drain modes)."""
     import threading
+    # every service-owned thread family; new pools must register here
+    _SERVE_PREFIXES = ('dproc-serve', 'dproc-serve-compile')
     yield
     leaked = sorted(t.name for t in threading.enumerate()
-                    if t.name.startswith('dproc-serve')
+                    if t.name.startswith(_SERVE_PREFIXES)
                     and t.is_alive())
     if leaked:
         print(f'SERVICE THREAD LEAK: {leaked}')

@@ -1,0 +1,499 @@
+"""Multi-tenant compile front door: content-addressed cache tests.
+
+Covers the service-grade contract of ``compilecache/``:
+
+* content addressing is deterministic — repeated compiles and
+  dict-key-reordered sources produce byte-identical MachinePrograms
+  and identical keys;
+* hit/miss/LRU-evict accounting; eviction falls back to the disk tier;
+* the persistent store survives a process restart (subprocess) and
+  tolerates corrupt entries;
+* singleflight: an 8-thread stampede on one program compiles exactly
+  once;
+* ``QChip.fingerprint()`` and calibration-epoch invalidation: one gate
+  amplitude retune flushes exactly the affected entries, other qchips'
+  entries stay warm;
+* admission validation rejects malformed programs with ``(core,
+  instr)`` coordinates before anything reaches a device;
+* ``ExecutionService.submit_source`` end-to-end: results bit-identical
+  to ``compile_to_machine`` + ``submit``, including the QASM3 text
+  path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu.compilecache import (
+    CompileCache, PersistentStore, content_key, machine_program_bytes)
+from distributed_processor_tpu.decoder import (ProgramValidationError,
+                                               machine_program_from_cmds)
+from distributed_processor_tpu.models import (active_reset,
+                                              make_default_qchip,
+                                              rb_ensemble)
+from distributed_processor_tpu.pipeline import (cached_compile_to_machine,
+                                                compile_to_machine)
+
+N_QUBITS = 2
+QUBITS = ['Q0', 'Q1']
+
+
+def _programs(n, seed=0, depth=2):
+    return [active_reset(QUBITS) + p
+            for p in rb_ensemble(QUBITS, depth, n, seed=seed)]
+
+
+def _reorder(prog):
+    """The same program with every instruction dict's key order
+    reversed — must compile and key identically."""
+    return [dict(reversed(list(d.items()))) for d in prog]
+
+
+@pytest.fixture(scope='module')
+def qchip():
+    return make_default_qchip(N_QUBITS)
+
+
+# ---------------------------------------------------------------------------
+# determinism: the precondition for content addressing
+# ---------------------------------------------------------------------------
+
+def test_compile_to_machine_byte_stable(qchip):
+    """Two compiles of the same source — and of a dict-key-reordered
+    copy — produce byte-identical MachinePrograms."""
+    prog = _programs(1)[0]
+    b1 = machine_program_bytes(compile_to_machine(prog, qchip,
+                                                  n_qubits=N_QUBITS))
+    b2 = machine_program_bytes(compile_to_machine(prog, qchip,
+                                                  n_qubits=N_QUBITS))
+    b3 = machine_program_bytes(compile_to_machine(_reorder(prog), qchip,
+                                                  n_qubits=N_QUBITS))
+    assert b1 == b2, 'repeated compile is not byte-stable'
+    assert b1 == b3, 'dict-key reordering changed the compiled bytes'
+
+
+def test_content_key_order_insensitive_and_distinct(qchip):
+    p1, p2 = _programs(2)
+    k1 = content_key(p1, qchip, n_qubits=N_QUBITS)
+    assert content_key(_reorder(p1), qchip, n_qubits=N_QUBITS) == k1
+    assert content_key(p2, qchip, n_qubits=N_QUBITS) != k1
+    # explicit defaults key the same as omitted arguments
+    from distributed_processor_tpu.compiler import CompilerFlags
+    from distributed_processor_tpu.hwconfig import FPGAConfig
+    assert content_key(p1, qchip, n_qubits=N_QUBITS,
+                       fpga_config=FPGAConfig(n_cores=N_QUBITS),
+                       compiler_flags=CompilerFlags()) == k1
+    # pad_to is part of the key (it changes decode shapes)
+    assert content_key(p1, qchip, n_qubits=N_QUBITS, pad_to=256) != k1
+
+
+def test_qasm_source_keys_byte_for_byte(qchip):
+    qasm = ('OPENQASM 3.0;\nqubit[2] q;\nx q[0];\n')
+    k = content_key(qasm, qchip, n_qubits=N_QUBITS)
+    assert content_key(qasm, qchip, n_qubits=N_QUBITS) == k
+    assert content_key(qasm + ' ', qchip, n_qubits=N_QUBITS) != k
+
+
+# ---------------------------------------------------------------------------
+# hit / miss / LRU-evict
+# ---------------------------------------------------------------------------
+
+def test_hit_miss_lru_evict(qchip):
+    progs = _programs(3)
+    cache = CompileCache(capacity=2)
+    mp0, s, _ = cache.get_or_compile(progs[0], qchip, n_qubits=N_QUBITS)
+    assert s == 'miss'
+    mp0b, s, _ = cache.get_or_compile(_reorder(progs[0]), qchip,
+                                      n_qubits=N_QUBITS)
+    assert s == 'hit' and mp0b is mp0
+    cache.get_or_compile(progs[1], qchip, n_qubits=N_QUBITS)
+    # capacity 2, recency order is [p0, p1]: compiling p2 evicts p0
+    cache.get_or_compile(progs[2], qchip, n_qubits=N_QUBITS)
+    st = cache.stats()
+    assert st['evictions'] == 1 and st['size'] == 2
+    _, s, _ = cache.get_or_compile(progs[0], qchip, n_qubits=N_QUBITS)
+    assert s == 'miss', 'evicted entry should recompile'
+    assert cache.stats()['misses'] == 4
+
+
+def test_evicted_entry_comes_back_from_disk(qchip, tmp_path):
+    progs = _programs(2)
+    cache = CompileCache(capacity=1, cache_dir=str(tmp_path))
+    cache.get_or_compile(progs[0], qchip, n_qubits=N_QUBITS)
+    cache.get_or_compile(progs[1], qchip, n_qubits=N_QUBITS)  # evicts 0
+    mp, s, _ = cache.get_or_compile(progs[0], qchip, n_qubits=N_QUBITS)
+    assert s == 'disk', 'eviction should fall back to the disk tier'
+    assert machine_program_bytes(mp) == machine_program_bytes(
+        compile_to_machine(progs[0], qchip, n_qubits=N_QUBITS))
+
+
+def test_cached_result_bit_identical_to_direct(qchip):
+    prog = _programs(1)[0]
+    mp = cached_compile_to_machine(prog, qchip, n_qubits=N_QUBITS,
+                                   cache=CompileCache())
+    assert machine_program_bytes(mp) == machine_program_bytes(
+        compile_to_machine(prog, qchip, n_qubits=N_QUBITS))
+
+
+# ---------------------------------------------------------------------------
+# persistent store
+# ---------------------------------------------------------------------------
+
+_CHILD = r'''
+import json, sys
+from distributed_processor_tpu.compilecache import (CompileCache,
+                                                    machine_program_bytes)
+from distributed_processor_tpu.models import (active_reset,
+                                              make_default_qchip,
+                                              rb_ensemble)
+qchip = make_default_qchip(2)
+prog = (active_reset(['Q0', 'Q1'])
+        + rb_ensemble(['Q0', 'Q1'], 2, 1, seed=7)[0])
+cache = CompileCache(cache_dir=sys.argv[1])
+mp, status, key = cache.get_or_compile(prog, qchip, n_qubits=2)
+print(json.dumps({'status': status, 'key': key,
+                  'n_bytes': len(machine_program_bytes(mp))}))
+'''
+
+
+@pytest.mark.slow
+def test_persistent_store_survives_process_restart(tmp_path):
+    """Two fresh processes share a cache dir: the first compiles cold,
+    the second starts warm from disk with the identical content key."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    out = []
+    for _ in range(2):
+        r = subprocess.run([sys.executable, '-c', _CHILD, str(tmp_path)],
+                           capture_output=True, text=True, env=env,
+                           timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        out.append(json.loads(r.stdout.strip().splitlines()[-1]))
+    assert out[0]['status'] == 'miss'
+    assert out[1]['status'] == 'disk', 'restart did not hit the store'
+    assert out[0]['key'] == out[1]['key']
+    assert out[0]['n_bytes'] == out[1]['n_bytes']
+
+
+def test_store_corrupt_entry_is_a_miss(qchip, tmp_path):
+    prog = _programs(1)[0]
+    cache = CompileCache(cache_dir=str(tmp_path))
+    _, _, key = cache.get_or_compile(prog, qchip, n_qubits=N_QUBITS)
+    (entry,) = [f for f in os.listdir(tmp_path) if f.endswith('.mpc')]
+    with open(os.path.join(tmp_path, entry), 'wb') as f:
+        f.write(b'garbage not zlib')
+    fresh = CompileCache(cache_dir=str(tmp_path))
+    _, s, _ = fresh.get_or_compile(prog, qchip, n_qubits=N_QUBITS)
+    assert s == 'miss', 'corrupt entry must be a miss, not an error'
+    # the recompile overwrote it: next fresh cache hits disk again
+    _, s, _ = CompileCache(cache_dir=str(tmp_path)).get_or_compile(
+        prog, qchip, n_qubits=N_QUBITS)
+    assert s == 'disk'
+
+
+def test_store_version_skew_is_a_miss(qchip, tmp_path):
+    prog = _programs(1)[0]
+    cache = CompileCache(cache_dir=str(tmp_path))
+    cache.get_or_compile(prog, qchip, n_qubits=N_QUBITS)
+    import pickle
+    import zlib
+    (entry,) = [f for f in os.listdir(tmp_path) if f.endswith('.mpc')]
+    fname = os.path.join(tmp_path, entry)
+    with open(fname, 'rb') as f:
+        payload = pickle.loads(zlib.decompress(f.read()))
+    payload['version'] += 1
+    with open(fname, 'wb') as f:
+        f.write(zlib.compress(pickle.dumps(payload)))
+    _, s, _ = CompileCache(cache_dir=str(tmp_path)).get_or_compile(
+        prog, qchip, n_qubits=N_QUBITS)
+    assert s == 'miss'
+
+
+# ---------------------------------------------------------------------------
+# singleflight
+# ---------------------------------------------------------------------------
+
+def test_singleflight_stampede_compiles_once(qchip):
+    """8 threads racing the same never-seen program: exactly one
+    compile; everyone gets the same MachineProgram object."""
+    prog = _programs(1, seed=42)[0]
+    calls = []
+    release = threading.Event()
+
+    def slow_compile(program, qc, **kw):
+        calls.append(threading.get_ident())
+        release.wait(timeout=30)
+        return compile_to_machine(program, qc, **kw)
+
+    cache = CompileCache(compile_fn=slow_compile)
+    results = [None] * 8
+    barrier = threading.Barrier(8)
+
+    def worker(i):
+        barrier.wait()
+        if i == 0:
+            # give the stampede a beat to pile onto the flight, then
+            # let the owner's compile proceed
+            time.sleep(0.1)
+            release.set()
+        results[i] = cache.get_or_compile(prog, qchip,
+                                          n_qubits=N_QUBITS)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert len(calls) == 1, f'stampede compiled {len(calls)} times'
+    mps = {id(r[0]) for r in results}
+    assert len(mps) == 1, 'waiters got different program objects'
+    st = cache.stats()
+    assert st['misses'] == 1
+    assert st['singleflight_waits'] >= 1
+
+
+def test_singleflight_failure_propagates_to_waiters(qchip):
+    prog = _programs(1, seed=43)[0]
+    gate = threading.Event()
+
+    def broken_compile(program, qc, **kw):
+        gate.wait(timeout=30)
+        raise RuntimeError('compiler exploded')
+
+    cache = CompileCache(compile_fn=broken_compile)
+    errors = []
+    barrier = threading.Barrier(4)
+
+    def worker(i):
+        barrier.wait()
+        if i == 0:
+            time.sleep(0.05)
+            gate.set()
+        try:
+            cache.get_or_compile(prog, qchip, n_qubits=N_QUBITS)
+        except RuntimeError as e:
+            errors.append(str(e))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert len(errors) == 4, 'every waiter must see the typed failure'
+    # the failure was not cached: a later attempt re-runs the compiler
+    gate.set()
+    with pytest.raises(RuntimeError):
+        cache.get_or_compile(prog, qchip, n_qubits=N_QUBITS)
+
+
+# ---------------------------------------------------------------------------
+# qchip fingerprint + calibration-epoch invalidation
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_stable_and_mutation_sensitive():
+    a, b = make_default_qchip(N_QUBITS), make_default_qchip(N_QUBITS)
+    assert a.fingerprint() == b.fingerprint()
+    fp = b.fingerprint()
+    b.gates['Q0X90'].contents[0].amp = 0.123
+    assert b.fingerprint() != fp, 'amp retune must change the epoch'
+    # and it is the VALUE that matters, not the mutation path
+    c = make_default_qchip(N_QUBITS)
+    c.gates['Q0X90'].contents[0].amp = 0.123
+    assert c.fingerprint() == b.fingerprint()
+
+
+def test_epoch_invalidation_flushes_exactly_affected(tmp_path):
+    """Retuning qchip A flushes A's entries (memory AND disk) and
+    leaves qchip B's entries warm."""
+    qa, qb = make_default_qchip(N_QUBITS), make_default_qchip(N_QUBITS)
+    qb.gates['Q1X90'].contents[0].amp = 0.3   # distinct calibration
+    progs = _programs(2)
+    cache = CompileCache(cache_dir=str(tmp_path))
+    for p in progs:
+        cache.get_or_compile(p, qa, n_qubits=N_QUBITS)
+        cache.get_or_compile(p, qb, n_qubits=N_QUBITS)
+    assert cache.stats()['size'] == 4
+    # retune one gate on qa; resubmitting through the SAME object
+    # auto-flushes the stale epoch
+    qa.gates['Q0X90'].contents[0].amp = 0.6
+    _, s, _ = cache.get_or_compile(progs[0], qa, n_qubits=N_QUBITS)
+    assert s == 'miss'
+    st = cache.stats()
+    assert st['invalidations'] == 1
+    assert st['invalidated_entries'] == 4, \
+        '2 memory + 2 disk entries of the stale epoch'
+    # qb's entries never went anywhere
+    for p in progs:
+        _, s, _ = cache.get_or_compile(p, qb, n_qubits=N_QUBITS)
+        assert s == 'hit', "other qchip's entries must stay warm"
+    # the stale epoch's OTHER program is gone from disk too
+    _, s, _ = cache.get_or_compile(progs[1], qa, n_qubits=N_QUBITS)
+    assert s == 'miss'
+
+
+def test_explicit_invalidate_epoch(qchip, tmp_path):
+    prog = _programs(1)[0]
+    cache = CompileCache(cache_dir=str(tmp_path))
+    cache.get_or_compile(prog, qchip, n_qubits=N_QUBITS)
+    n = cache.invalidate_epoch(qchip.fingerprint())
+    assert n == 2, 'one memory + one disk entry'
+    _, s, _ = cache.get_or_compile(prog, qchip, n_qubits=N_QUBITS)
+    assert s == 'miss'
+
+
+# ---------------------------------------------------------------------------
+# admission validation
+# ---------------------------------------------------------------------------
+
+def _malformed_mp():
+    """A decodable program whose jump target is out of bounds — the
+    validator rejects it with coordinates (tests/test_faults.py pins
+    the codes)."""
+    from distributed_processor_tpu import isa
+    cmds = [[isa.pulse_cmd(amp_word=100, cfg_word=0, env_word=3,
+                           cmd_time=10),
+             isa.jump_i(99), isa.done_cmd()]]
+    return machine_program_from_cmds(cmds)
+
+
+def test_validation_rejection_carries_coordinates(qchip):
+    cache = CompileCache(compile_fn=lambda *a, **kw: _malformed_mp())
+    prog = _programs(1, seed=44)[0]
+    with pytest.raises(ProgramValidationError) as ei:
+        cache.get_or_compile(prog, qchip, n_qubits=N_QUBITS)
+    assert 'jump_oob' in ei.value.codes
+    (code, core, instr, msg), = [e for e in ei.value.errors
+                                 if e[0] == 'jump_oob']
+    assert (core, instr) == (0, 1)
+    st = cache.stats()
+    assert st['validation_rejects'] == 1
+    assert st['size'] == 0, 'a rejected program must never be cached'
+
+
+def test_validation_can_be_disabled(qchip):
+    cache = CompileCache(compile_fn=lambda *a, **kw: _malformed_mp(),
+                         validate=False)
+    mp, s, _ = cache.get_or_compile(_programs(1, seed=44)[0], qchip,
+                                    n_qubits=N_QUBITS)
+    assert s == 'miss' and mp.n_cores == 1
+
+
+# ---------------------------------------------------------------------------
+# serve-tier front door: submit_source
+# ---------------------------------------------------------------------------
+
+def _svc(**kw):
+    from distributed_processor_tpu.serve.service import ExecutionService
+    return ExecutionService(max_wait_ms=5.0, **kw)
+
+
+def test_submit_source_bit_identical_to_compile_plus_submit(qchip):
+    progs = _programs(2, seed=45)
+    with _svc() as svc:
+        refs = []
+        for p in progs:
+            mp = compile_to_machine(p, qchip, n_qubits=N_QUBITS)
+            refs.append(svc.submit(mp, shots=16).result(timeout=120))
+        handles = [svc.submit_source(p, qchip, shots=16,
+                                     n_qubits=N_QUBITS)
+                   for p in progs]
+        results = [h.result(timeout=120) for h in handles]
+        for got, want in zip(results, refs):
+            for k in want:
+                np.testing.assert_array_equal(np.asarray(got[k]),
+                                              np.asarray(want[k]))
+        st = svc.stats()
+        assert st['source']['submitted'] == 2
+        cc = st['compile_cache']
+        assert cc['misses'] == 2 and cc['hits'] == 0
+
+
+def test_submit_source_qasm_path(qchip):
+    """OpenQASM 3 text through the front door matches the frontend +
+    compile + submit path bit for bit."""
+    from distributed_processor_tpu.frontend import qasm_to_program
+    qasm = ('OPENQASM 3;\n'
+            'include "stdgates.inc";\n'
+            'qubit[2] q;\n'
+            'bit[2] c;\n'
+            'x q[0];\n'
+            'c[0] = measure q[0];\n'
+            'c[1] = measure q[1];\n')
+    mp = compile_to_machine(qasm_to_program(qasm), qchip,
+                            n_qubits=N_QUBITS)
+    with _svc() as svc:
+        want = svc.submit(mp, shots=8).result(timeout=120)
+        got = svc.submit_source(qasm, qchip, shots=8,
+                                n_qubits=N_QUBITS).result(timeout=120)
+        for k in want:
+            np.testing.assert_array_equal(np.asarray(got[k]),
+                                          np.asarray(want[k]))
+        # warm resubmission of the same text never re-parses
+        svc.submit_source(qasm, qchip, shots=8,
+                          n_qubits=N_QUBITS).result(timeout=120)
+        assert svc.stats()['compile_cache']['hits'] >= 1
+
+
+def test_submit_source_warm_hits_share_one_compile(qchip):
+    prog = _programs(1, seed=46)[0]
+    with _svc() as svc:
+        handles = [svc.submit_source(prog, qchip, shots=4,
+                                     n_qubits=N_QUBITS)
+                   for _ in range(6)]
+        for h in handles:
+            h.result(timeout=120)
+        cc = svc.stats()['compile_cache']
+        assert cc['misses'] == 1
+        assert cc['hits'] + cc['singleflight_waits'] == 5
+
+
+def test_submit_source_validation_failure_lands_on_handle(qchip):
+    cache = CompileCache(compile_fn=lambda *a, **kw: _malformed_mp())
+    prog = _programs(1, seed=47)[0]
+    with _svc(compile_cache=cache) as svc:
+        h = svc.submit_source(prog, qchip, shots=4, n_qubits=N_QUBITS)
+        with pytest.raises(ProgramValidationError) as ei:
+            h.result(timeout=120)
+        assert 'jump_oob' in ei.value.codes
+        assert h.done()
+
+
+def test_submit_source_shutdown_without_drain_fails_typed(qchip):
+    """Abandoning ship mid-compile: every pending source handle
+    terminates with a typed error, nothing hangs, no thread leaks.
+    The in-flight compile lands on ServiceClosedError (its submit
+    arrives after closing), queued ones on ShutdownError."""
+    from distributed_processor_tpu.serve.request import (
+        CancelledError, ServiceClosedError)
+    gate = threading.Event()
+
+    def slow_compile(program, qc, **kw):
+        gate.wait(timeout=10)
+        return compile_to_machine(program, qc, **kw)
+
+    svc = _svc(compile_cache=CompileCache(compile_fn=slow_compile),
+               compile_workers=1)
+    try:
+        handles = [svc.submit_source(p, qchip, shots=4,
+                                     n_qubits=N_QUBITS)
+                   for p in _programs(3, seed=48)]
+        releaser = threading.Timer(0.2, gate.set)
+        releaser.start()
+        svc.shutdown(drain=False)
+    finally:
+        gate.set()
+    for h in handles:
+        assert h.done()
+        with pytest.raises((CancelledError, ServiceClosedError)):
+            h.result(timeout=5)
+    # drain=False still compiles nothing new after shutdown
+    with pytest.raises(ServiceClosedError):
+        svc.submit_source(_programs(1, seed=49)[0], qchip, shots=4,
+                          n_qubits=N_QUBITS)

@@ -28,7 +28,17 @@ LEAK_MARK = 'SERVICE THREAD LEAK'
 # means the CPU ladder rung silently stopped being exercised (the
 # test_tpu_kernels.py hardware gate is the one legitimate skip site and
 # is not listed here)
-NO_SKIP_MODULES = ('test_exec_pallas',)
+# module -> why a skip there is a CI failure, printed verbatim
+NO_SKIP_MODULES = {
+    'test_exec_pallas':
+        'pallas exec-kernel tests must run on CPU via interpret '
+        'mode, never skip (see docs/PERF.md "megastep")',
+    'test_compilecache':
+        'compile front-door tests are pure CPU (numpy compile + '
+        'content hashing), there is no legitimate skip condition — a '
+        'skip means the cache/singleflight/invalidation contract '
+        'stopped being exercised (see docs/COMPILE_CACHE.md)',
+}
 
 # the multi-device serve suite may skip ONLY on a genuinely
 # single-device host: its module-level skip reason records how many
@@ -70,9 +80,11 @@ def main(path: str) -> int:
     for tc in root.iter('testcase'):
         ident = f'{tc.get("classname")}.{tc.get("name")}'
         skipped = tc.find('skipped')
-        if skipped is not None and any(
-                m in tc.get('classname', '') for m in NO_SKIP_MODULES):
-            bad_skips.append(ident)
+        if skipped is not None:
+            for mod, why in NO_SKIP_MODULES.items():
+                if mod in tc.get('classname', ''):
+                    bad_skips.append((ident, why))
+                    break
         if skipped is not None \
                 and MULTIDEV_MODULE in tc.get('classname', ''):
             reason = (skipped.get('message') or '') + \
@@ -103,10 +115,8 @@ def main(path: str) -> int:
                   f'thread survived the test (shut the service down — '
                   f'see docs/SERVING.md)')
     if bad_skips:
-        for name in bad_skips:
-            print(f'BAD SKIP: {name}: pallas exec-kernel tests must '
-                  f'run on CPU via interpret mode, never skip (see '
-                  f'docs/PERF.md "megastep")')
+        for name, why in bad_skips:
+            print(f'BAD SKIP: {name}: {why}')
     if dev_skips:
         for name in dev_skips:
             print(f'BAD SKIP: {name}: multi-device serve tests '
