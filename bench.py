@@ -790,8 +790,10 @@ def _preflight(timeouts=(30.0, 60.0, 120.0)):
     Retries with backoff before giving up: a transient tunnel blip on
     the first probe must not zero an entire round's perf artifact.  The
     error JSON is emitted only after EVERY attempt fails, and carries
-    the full per-attempt record (outcome, elapsed, error) so a flaky-
-    then-dead backend is distinguishable from one that never answered.
+    the full per-attempt record (outcome, elapsed, error, and the probe
+    STAGE that was in flight — device_init / allocate / compute) so a
+    tunnel that dies during backend bring-up is distinguishable from
+    one that enumerates devices but hangs the first real dispatch.
     Returns the attempt record on success for the detail dict.
     """
     import threading
@@ -799,16 +801,30 @@ def _preflight(timeouts=(30.0, 60.0, 120.0)):
     for n, timeout_s in enumerate(timeouts, start=1):
         done = threading.Event()
         failure = []
+        stage = ['device_init']     # last stage the probe entered
 
         def probe():
             try:
-                if os.environ.get('BENCH_PREFLIGHT_FAIL'):
-                    # test hook: a dead backend is otherwise impossible
-                    # to provoke deterministically in CI
-                    raise RuntimeError(
-                        'forced preflight failure (BENCH_PREFLIGHT_FAIL)')
+                fail_at = os.environ.get('BENCH_PREFLIGHT_FAIL')
+
+                def _enter(s):
+                    stage[0] = s
+                    if fail_at in ('1', s):
+                        # test hook: a dead backend is otherwise
+                        # impossible to provoke deterministically in CI
+                        # ('1' fails immediately; a stage name fails
+                        # once the probe reaches that stage)
+                        raise RuntimeError('forced preflight failure '
+                                           f'at {s} '
+                                           '(BENCH_PREFLIGHT_FAIL)')
+
+                _enter('device_init')
+                jax.devices()
+                _enter('allocate')
                 x = jnp.ones((8,))
+                _enter('compute')
                 float(x.sum())
+                stage[0] = 'done'
             except Exception as e:      # fast failure: report, don't wait
                 failure.append(f'{type(e).__name__}: {e}'[:300])
             finally:
@@ -826,9 +842,10 @@ def _preflight(timeouts=(30.0, 60.0, 120.0)):
             return attempts
         attempts.append({
             'attempt': n, 'ok': False, 'elapsed_s': elapsed,
+            'stage': stage[0],
             'error': failure[0] if failure else (
                 f'accelerator backend unresponsive after {timeout_s:.0f}s '
-                f'(device init/compute hang — tunnel down?)')})
+                f'(hung in probe stage {stage[0]!r} — tunnel down?)')})
         print(f'preflight attempt {n}/{len(timeouts)} failed: '
               f'{attempts[-1]["error"]}', file=sys.stderr)
     if not os.environ.get('BENCH_DEGRADED'):
@@ -869,6 +886,7 @@ def _degraded_rerun(attempts):
                  ('BENCH_SERVE_DP_SHOTS', '16'),
                  ('BENCH_SERVE_OPEN_REQS', '12'),
                  ('BENCH_SERVE_OPEN_RATE', '30'),
+                 ('BENCH_SERVE_OPEN_SHOTS', '8'),
                  ('BENCH_CHAOS_REQS', '24'),
                  ('BENCH_CHAOS_RATE', '40'),
                  ('BENCH_COMPILE_TENANTS', '3'),
@@ -935,13 +953,22 @@ def _serve_scaling_row():
 
 def _serve_open_loop_row():
     """Open-loop serve latency: p50/p99 under seeded Poisson-ish
-    mixed-bucket arrivals (serve/benchmark.py)."""
+    mixed-bucket arrivals (serve/benchmark.py).
+
+    Runs the latency-SLO comparison by default (``BENCH_SERVE_OPEN_SLO
+    =0`` opts out): the same arrival trace cold (catalog learning,
+    compiles inside the timed window) then after catalog replay, with
+    warmed p99 < unwarmed p99 and zero warm-round cold hits asserted
+    inside the row.  ``BENCH_SERVE_OPEN_CATALOG`` persists the learned
+    catalog instead of a throwaway temp file."""
     devs = os.environ.get('BENCH_SERVE_OPEN_DEVICES')
     return open_loop_latency(
         n_reqs=int(os.environ.get('BENCH_SERVE_OPEN_REQS', 48)),
         rate_hz=float(os.environ.get('BENCH_SERVE_OPEN_RATE', 40)),
         shots=int(os.environ.get('BENCH_SERVE_OPEN_SHOTS', 16)),
-        devices=int(devs) if devs else None)
+        devices=int(devs) if devs else None,
+        slo=os.environ.get('BENCH_SERVE_OPEN_SLO', '1') not in ('', '0'),
+        warmup_catalog=os.environ.get('BENCH_SERVE_OPEN_CATALOG') or None)
 
 
 def _serve_chaos_row():

@@ -425,17 +425,53 @@ def cmd_serve_bench(args):
             dp_list=[int(x) for x in args.dp.split(',') if x],
             n_reqs=args.requests, n_qubits=args.qubits,
             depth=args.depth, shots=args.shots, seed=args.seed)
-    elif args.open_loop:
+    elif args.open_loop or args.slo:
         row = open_loop_latency(
             n_reqs=args.requests, rate_hz=args.rate_hz,
             n_qubits=args.qubits, shots=args.shots, seed=args.seed,
-            devices=args.devices)
+            devices=args.devices, slo=args.slo,
+            warmup_catalog=args.warmup_catalog)
     else:
         row = continuous_batching_comparison(
             n_reqs=args.requests, n_qubits=args.qubits,
             depth=args.depth, shots=args.shots, seed=args.seed,
             max_wait_ms=args.max_wait_ms)
     print(json.dumps(row, indent=2))
+
+
+def cmd_warmup(args):
+    """AOT-compile a learned bucket catalog offline.
+
+    The in-process executable cache dies with this process, so the
+    point of offline warmup is (a) validating that every catalog entry
+    still compiles, with per-spec timings, and (b) with ``--jax-cache``
+    pre-baking the persistent XLA compilation cache that serving
+    processes started with the same cache dir then LOAD instead of
+    recompiling — catalog replay in the server turns into disk reads.
+    """
+    import jax
+    if args.jax_cache:
+        jax.config.update('jax_compilation_cache_dir', args.jax_cache)
+        jax.config.update(
+            'jax_persistent_cache_min_compile_time_secs', 0.0)
+    from .serve.catalog import BucketCatalog
+    from .sim.interpreter import aot_compile_batch
+    specs = BucketCatalog(args.catalog).load()
+    devs = jax.local_devices()[:max(1, args.devices)]
+    compiled, total_ms = 0, 0.0
+    for spec in specs:
+        for d in devs:
+            dt_ms = aot_compile_batch(spec, d) * 1e3
+            compiled += 1 if dt_ms > 0 else 0
+            total_ms += dt_ms
+            print(json.dumps({'spec': spec.label(),
+                              'device': str(d),
+                              'compile_ms': round(dt_ms, 1),
+                              'cached': dt_ms == 0.0}))
+    print(json.dumps({'catalog': args.catalog, 'specs': len(specs),
+                      'devices': len(devs), 'compiled': compiled,
+                      'total_compile_ms': round(total_ms, 1),
+                      'jax_cache': args.jax_cache}))
 
 
 def main(argv=None):
@@ -669,7 +705,35 @@ def main(argv=None):
                         'program set')
     p.add_argument('--programs', type=int, default=4,
                    help='source-mode: distinct programs per tenant')
+    p.add_argument('--slo', action='store_true',
+                   help='open-loop latency-SLO mode: the same seeded '
+                        'arrival trace runs cold (empty catalog, '
+                        'compiles in-window) then warm (catalog '
+                        'replay); asserts warmed p99 < unwarmed p99 '
+                        'with zero cold hits (implies --open-loop)')
+    p.add_argument('--warmup-catalog', metavar='PATH',
+                   help='open-loop: learned bucket catalog to replay '
+                        'at service startup and record new buckets '
+                        'into (serve/catalog.py)')
     p.set_defaults(fn=cmd_serve_bench)
+
+    p = sub.add_parser('warmup',
+                       help='AOT-compile a learned bucket catalog '
+                            'offline: validates every entry with '
+                            'per-spec compile timings and, with '
+                            '--jax-cache, pre-bakes the persistent '
+                            'XLA cache that serving processes load '
+                            'at startup')
+    p.add_argument('catalog',
+                   help='bucket catalog JSON written by '
+                        'ExecutionService(warmup_catalog=...) or '
+                        'serve-bench --warmup-catalog')
+    p.add_argument('--devices', type=int, default=1,
+                   help='compile on the first N local devices')
+    p.add_argument('--jax-cache', metavar='DIR',
+                   help='persistent XLA compilation cache dir to '
+                        'populate (point the server at the same dir)')
+    p.set_defaults(fn=cmd_warmup)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')
     p.add_argument('program')

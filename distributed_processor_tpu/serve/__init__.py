@@ -26,16 +26,21 @@ crashes/hangs/slowdowns under ``_run_batch`` to prove all of it.
 """
 
 from .batcher import Coalescer, bucket_key
+from .bucketspec import BucketSpec
+from .catalog import BucketCatalog
 from .chaos import ChaosError, ChaosMonkey, ChaosPlan, ChaosThreadDeath
 from .request import (CancelledError, DeadlineError, ExecutorLostError,
                       OverloadError, QueueFullError, RequestHandle,
                       ServiceClosedError, ShutdownError)
 from .service import (CANARY_THREAD_PREFIX, DISPATCH_THREAD_PREFIX,
-                      SUPERVISE_THREAD_PREFIX, ExecutionService)
+                      SUPERVISE_THREAD_PREFIX, WARMUP_THREAD_PREFIX,
+                      ExecutionService)
 from .supervise import (HEALTH_LIVE, HEALTH_PROBING,
                         HEALTH_QUARANTINED, CircuitBreaker, RetryPolicy)
 
 __all__ = [
+    'BucketCatalog',
+    'BucketSpec',
     'CANARY_THREAD_PREFIX',
     'CancelledError',
     'ChaosError',
@@ -58,5 +63,6 @@ __all__ = [
     'SUPERVISE_THREAD_PREFIX',
     'ServiceClosedError',
     'ShutdownError',
+    'WARMUP_THREAD_PREFIX',
     'bucket_key',
 ]

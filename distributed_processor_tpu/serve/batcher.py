@@ -13,12 +13,22 @@ executable or change its semantics:
   per-core sample-rate constants (``stack_machine_programs`` would
   reject a mismatch; keying on it means mismatched submissions simply
   land in different buckets instead of failing a batch);
-* the normalized :class:`InterpreterConfig` — a static jit argument.
+* the normalized :class:`InterpreterConfig` — a static jit argument;
+* the :func:`~..sim.interpreter.program_traits` tuple — also a static
+  jit argument, so coalescing across trait sets would both fragment
+  the warm cache (the stacked batch's trait-union picks a third
+  executable neither member compiled) and make the dispatched
+  executable depend on batch composition.
+
+The key is a :class:`~.bucketspec.BucketSpec` (unbound template): the
+same value the AOT warmup path compiles against and the learned bucket
+catalog persists — one identity from admission to XLA.
 
 Shot counts are deliberately NOT part of the key: short requests are
 padded up to the batch's shot count by replicating their own rows
 (deterministic execution makes replica lanes observationally inert;
-``demux_multi_batch`` trims them back off).
+``demux_multi_batch`` trims them back off).  Warmup *binds* the
+template to concrete ``(n_programs, n_shots)`` before compiling.
 
 Inside a bucket, requests order by priority lane (higher first) with
 FIFO arrival as the tiebreak; a bucket becomes ripe when it holds
@@ -31,15 +41,13 @@ from __future__ import annotations
 
 import time
 
-from .. import isa
+from .bucketspec import BucketSpec
 from .request import DeadlineError, Request
 
 
-def bucket_key(mp, cfg) -> tuple:
+def bucket_key(mp, cfg) -> BucketSpec:
     """The coalescing key: requests with equal keys may share a batch."""
-    geom = tuple((ec.samples_per_clk, ec.interp_ratio)
-                 for t in mp.tables for ec in t.elem_cfgs)
-    return (mp.n_cores, isa.shape_bucket(mp.n_instr), geom, cfg)
+    return BucketSpec.from_program(mp, cfg)
 
 
 class Coalescer:

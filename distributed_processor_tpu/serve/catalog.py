@@ -1,0 +1,116 @@
+"""Learned bucket catalog: which executables a deployment actually serves.
+
+The service cannot know ahead of time which ``(shape bucket, cfg,
+occupancy, shots)`` combinations its traffic produces — but its own
+dispatch history does.  :class:`BucketCatalog` persists every bound
+:class:`~.bucketspec.BucketSpec` the service dispatches into a small
+versioned JSON file; ``ExecutionService(warmup_catalog=...)`` replays
+that file at startup on a background thread, AOT-compiling each spec
+per device (``sim.interpreter.aot_compile_batch``) so the first real
+request of the new process hits warm.  With JAX's persistent
+compilation cache enabled the replayed compiles are disk loads, not
+XLA runs — the catalog is what turns that cache from "same process
+shape reuse" into "warm across deploys".
+
+Write discipline mirrors ``compilecache/store.py``: a magic + version
+stamp, tmp-file + ``os.replace`` atomic rewrites (a reader or a crash
+never sees a torn file), and a tolerant loader — any parse/version
+problem means "empty catalog", never an exception into the serving
+path.  The file is small (one dict per distinct bucket; diverse
+production traffic is tens of buckets, not thousands) so each record
+rewrites the whole file rather than appending.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+
+from .bucketspec import BucketSpec
+
+CATALOG_MAGIC = 'dproc-bucket-catalog'
+CATALOG_VERSION = 1
+
+
+class BucketCatalog:
+    """Durable, deduplicated set of bound BucketSpecs at ``path``.
+
+    Thread-safe; every mutation rewrites the file atomically.  I/O
+    errors on record are swallowed after the in-memory set updates —
+    losing a catalog entry costs one future cold compile, never a
+    request.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._specs: dict = {}       # spec.identity() -> spec, ordered
+        self._loaded = False
+
+    # -- read ----------------------------------------------------------
+
+    def load(self) -> list:
+        """Specs in insertion order; [] for a missing/corrupt file."""
+        with self._lock:
+            self._load_locked()
+            return list(self._specs.values())
+
+    def _load_locked(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
+        try:
+            with open(self.path, 'r', encoding='utf-8') as f:
+                doc = json.load(f)
+            if doc.get('magic') != CATALOG_MAGIC \
+                    or doc.get('version') != CATALOG_VERSION:
+                return
+            for d in doc.get('specs', ()):
+                spec = BucketSpec.from_json(d)
+                self._specs.setdefault(spec.identity(), spec)
+        except (OSError, ValueError, TypeError, KeyError):
+            self._specs.clear()
+
+    # -- write ---------------------------------------------------------
+
+    def record(self, spec: BucketSpec) -> bool:
+        """Add one bound spec; False when already present.  The file is
+        rewritten atomically on every new spec."""
+        if not spec.bound:
+            raise ValueError('catalog stores BOUND specs only '
+                             '(BucketSpec.bind)')
+        with self._lock:
+            self._load_locked()
+            if spec.identity() in self._specs:
+                return False
+            self._specs[spec.identity()] = spec
+            try:
+                self._write_locked()
+            except OSError:
+                pass        # durability is best-effort; serving is not
+            return True
+
+    def _write_locked(self) -> None:
+        doc = {'magic': CATALOG_MAGIC, 'version': CATALOG_VERSION,
+               'specs': [s.to_json() for s in self._specs.values()]}
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix='.catalog-',
+                                   suffix='.tmp')
+        try:
+            with os.fdopen(fd, 'w', encoding='utf-8') as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def __len__(self) -> int:
+        with self._lock:
+            self._load_locked()
+            return len(self._specs)

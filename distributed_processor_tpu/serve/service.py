@@ -66,11 +66,15 @@ import jax
 from .. import isa
 from ..decoder import machine_program_from_cmds, stack_machine_programs
 from ..sim.interpreter import (ENGINES, InterpreterConfig, FaultError,
-                               demux_multi_batch, fault_shot_counts,
-                               is_infrastructure_error, resolve_engine,
-                               simulate_batch, simulate_multi_batch)
+                               aot_compile_batch, demux_multi_batch,
+                               fault_shot_counts,
+                               is_infrastructure_error, program_traits,
+                               resolve_engine, simulate_batch,
+                               simulate_multi_batch)
 from ..utils import profiling
 from .batcher import Coalescer, bucket_key
+from .bucketspec import BucketSpec
+from .catalog import BucketCatalog
 from .request import (CancelledError, DeadlineError, ExecutorLostError,
                       OverloadError, QueueFullError, Request,
                       RequestHandle, ServiceClosedError, ShutdownError)
@@ -85,6 +89,7 @@ DISPATCH_THREAD_PREFIX = 'dproc-serve-dispatch'
 SUPERVISE_THREAD_PREFIX = 'dproc-serve-supervise'
 CANARY_THREAD_PREFIX = 'dproc-serve-canary'
 COMPILE_THREAD_PREFIX = 'dproc-serve-compile'
+WARMUP_THREAD_PREFIX = 'dproc-serve-warmup'
 
 _SERVICE_SEQ = itertools.count()
 
@@ -140,12 +145,31 @@ def _pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
-def _bucket_label(key: tuple) -> str:
+def _bucket_label(key: BucketSpec) -> str:
     """Human/JSON-able label for a bucket key: the shape part only
     (cores x instruction bucket).  Distinct cfg/geometry variants of
     the same shape share a label — the per-bucket compile stats answer
     "which SHAPES are hot", not "which exact executables"."""
-    return f'c{key[0]}i{key[1]}'
+    return f'c{key.n_cores}i{key.n_instr_bucket}'
+
+
+def _bucket_compile_view(per: dict) -> dict:
+    """One bucket's cold/warm classification with its dispatch latency
+    split: mean timed cold/warm dispatch ms, and their difference as a
+    per-bucket compile-cost estimate (a cold dispatch is
+    trace+compile+execute, a warm one execute only — the difference is
+    what AOT warmup deletes from first-request latency).  The means are
+    None until a timed dispatch of that class lands (AOT warmups
+    classify cold but dispatch nothing)."""
+    cold_ms = (per['cold_s'] * 1e3 / per['cold_timed']
+               if per['cold_timed'] else None)
+    warm_ms = (per['warm_s'] * 1e3 / per['warm_timed']
+               if per['warm_timed'] else None)
+    est = (max(cold_ms - warm_ms, 0.0)
+           if cold_ms is not None and warm_ms is not None else None)
+    return {'cold': per['cold'], 'warm': per['warm'],
+            'cold_ms_mean': cold_ms, 'warm_ms_mean': warm_ms,
+            'compile_ms_est': est}
 
 
 class _DeviceExecutor:
@@ -288,6 +312,15 @@ class ExecutionService:
         be met is rejected early instead of queueing to expire.
         Default None = off (the bounded queue / QueueFullError is
         then the only admission control, exactly as before).
+
+    ``warmup_catalog`` names a learned bucket catalog file
+    (serve/catalog.py): every bucket this service dispatches is
+    recorded there, and at construction any previously-recorded specs
+    are replayed — AOT-compiled per device on a background
+    ``dproc-serve-warmup-*`` thread (admission never blocks on it) —
+    so a restarted service's first requests hit warm.  Progress is in
+    ``stats()['warmup']``.  Default None = no catalog (explicit
+    :meth:`warmup` calls still work).
     """
 
     def __init__(self, cfg: InterpreterConfig = None, *,
@@ -303,7 +336,8 @@ class ExecutionService:
                  supervise_interval_ms: float = 25.0,
                  max_est_wait_ms: float = None,
                  compile_cache=None, compile_workers: int = 2,
-                 compile_cache_dir: str = None):
+                 compile_cache_dir: str = None,
+                 warmup_catalog: str = None):
         if max_batch_programs < 1:
             raise ValueError('max_batch_programs must be >= 1')
         if max_queue < 1:
@@ -368,9 +402,18 @@ class ExecutionService:
         self._programs_dispatched = 0
         self._steals = 0
         self._warmups = 0
+        # AOT warmup / learned-catalog state (docs/SERVING.md "cold
+        # start & warmup"; guarded by _cv's lock)
+        self._warmup_aot = 0           # executables actually compiled
+        self._warmup_replayed = 0      # catalog specs replayed
+        self._warmup_pending = 0       # (spec, device) replays still due
+        self._warmup_thread = None
         self._occupancy = collections.Counter()   # batch size -> count
         self._engine_dispatches = collections.Counter()  # engine -> count
-        self._bucket_compiles = {}     # bucket label -> {'cold','warm'}
+        # bucket label -> {'cold','warm'} counts plus timed dispatch
+        # latency totals ({cold,warm}_s / _timed) for the compile-vs-
+        # execute split stats() reports
+        self._bucket_compiles = {}
         self._latency_s = collections.deque(maxlen=4096)
         # -- supervision state (guarded by _cv's lock) -------------------
         # requests waiting out a retry backoff: (eligible_t, key, req),
@@ -401,8 +444,25 @@ class ExecutionService:
         self._compile_pool = None      # lazily created on first submit_source
         self._source_submitted = 0
         self._source_handles = set()   # outer handles awaiting compile
+        # learned bucket catalog: record every served bucket; replay
+        # it at startup on a background thread so admission never
+        # waits on warmup compiles
+        self._catalog = None
+        self._catalog_seen = set()
+        replay_specs = []
+        if warmup_catalog:
+            self._catalog = BucketCatalog(warmup_catalog)
+            replay_specs = self._catalog.load()
+            self._catalog_seen.update(s.identity() for s in replay_specs)
         for ex in self._executors:
             ex.thread.start()
+        if replay_specs:
+            self._warmup_pending = len(replay_specs) * len(self._executors)
+            self._warmup_thread = threading.Thread(
+                target=self._warmup_replay, args=(replay_specs,),
+                name=f'{WARMUP_THREAD_PREFIX}-{self.name}',
+                daemon=True)
+            self._warmup_thread.start()
         self._supervisor = None
         if self._supervision:
             self._supervisor = threading.Thread(
@@ -1034,7 +1094,7 @@ class ExecutionService:
         return t
 
     def _execute(self, ex: _DeviceExecutor, key, batch):
-        cfg = key[-1]
+        cfg = key.cfg
         t0 = time.monotonic()
         try:
             results = self._run_batch(ex, key, batch, cfg)
@@ -1133,11 +1193,15 @@ class ExecutionService:
             scfg = replace(cfg, engine=self.singleton_engine)
             eng = resolve_engine(req.mp, scfg)
             self._count_engine_locked(ex, eng)
-            self._classify_compile(ex, key, ('solo', eng, req.n_shots,
-                                             req.init_regs is None))
+            cold = self._classify_compile(
+                ex, key, ('solo', eng, req.n_shots,
+                          req.init_regs is None))
+            t0 = time.monotonic()
             out = simulate_batch(req.mp, req.meas_bits, req.init_regs,
                                  cfg=scfg, jax_device=ex.device)
-            return [jax.tree.map(np.asarray, out)]
+            res = [jax.tree.map(np.asarray, out)]
+            self._record_bucket_ms(key, cold, time.monotonic() - t0)
+            return res
         B = max(r.n_shots for r in batch)
         P = _pow2(len(batch)) if self.pad_programs else len(batch)
         pad = P - len(batch)
@@ -1159,10 +1223,22 @@ class ExecutionService:
             [r.mp for r in batch] + [batch[-1].mp] * pad,
             pad_to=key_bucket(batch))
         self._count_engine_locked(ex, 'generic')
-        self._classify_compile(ex, key, ('multi', P, B, init is None))
+        cold = self._classify_compile(ex, key,
+                                      ('multi', P, B, init is None))
+        # the catalog stores the EXACT executable identity: the
+        # stacked batch's trait union, not any one member's traits
+        self._record_catalog(
+            replace(key, traits=program_traits(mmp)).bind(
+                n_programs=P, n_shots=B,
+                has_init_regs=init is not None))
+        t0 = time.monotonic()
         out = simulate_multi_batch(mmp, meas, init, cfg=cfg,
                                    jax_device=ex.device)
+        # np.asarray blocks on the device result, so the timed window
+        # covers trace+compile+execute — the cold/warm latency split
+        # stats() turns into a compile-cost estimate per bucket
         host = jax.tree.map(np.asarray, out)
+        self._record_bucket_ms(key, cold, time.monotonic() - t0)
         return [demux_multi_batch(host, i, n_shots=r.n_shots)
                 for i, r in enumerate(batch)]
 
@@ -1194,57 +1270,153 @@ class ExecutionService:
                 ex.cold_compiles += 1
             else:
                 ex.warm_hits += 1
-            per = self._bucket_compiles.setdefault(
-                _bucket_label(key), {'cold': 0, 'warm': 0})
+            per = self._bucket_label_entry_locked(key)
             per['cold' if cold else 'warm'] += 1
         profiling.counter_inc(
             'serve.compile.cold' if cold else 'serve.compile.warm')
         return cold
 
+    def _bucket_label_entry_locked(self, key) -> dict:
+        return self._bucket_compiles.setdefault(
+            _bucket_label(key),
+            {'cold': 0, 'warm': 0, 'cold_s': 0.0, 'warm_s': 0.0,
+             'cold_timed': 0, 'warm_timed': 0})
+
+    def _record_bucket_ms(self, key, cold: bool, dt_s: float) -> None:
+        """Accrue one timed dispatch into the bucket's cold/warm
+        latency split (warmup classifications are untimed, so counts
+        and timed-sample counts are tracked separately)."""
+        with self._cv:
+            per = self._bucket_label_entry_locked(key)
+            which = 'cold' if cold else 'warm'
+            per[which + '_s'] += dt_s
+            per[which + '_timed'] += 1
+
+    def _record_catalog(self, spec: BucketSpec) -> None:
+        """Persist a dispatched bucket into the learned catalog (no-op
+        without one; deduped in memory so steady-state dispatch never
+        touches the filesystem)."""
+        if self._catalog is None:
+            return
+        with self._cv:
+            if spec.identity() in self._catalog_seen:
+                return
+            self._catalog_seen.add(spec.identity())
+        self._catalog.record(spec)
+
     # -- warmup ----------------------------------------------------------
 
-    def warmup(self, mp, *, shots: int = 1, n_programs: int = None,
-               cfg: InterpreterConfig = None) -> list:
-        """Pre-compile ``mp``'s bucket on EVERY device executor by
-        running one representative batch synchronously, so the first
-        real request in the bucket does not eat the XLA compile inside
-        its latency budget (the ROADMAP "AOT warmup" groundwork — and
-        the reason cold/warm hits are tracked at all).
-
-        The jit cache keys on the full batch SHAPE — (programs, shots,
-        cores, instruction bucket, cfg) — so warm coverage needs
-        representative ``shots`` and ``n_programs`` (default
-        ``max_batch_programs``; padded to a power of two exactly like
-        live dispatch when ``pad_programs``).  Counted in
-        ``stats()['compile']`` and the ``serve.compile.*`` counters
-        like any dispatch.  Returns per-executor
-        ``{'device', 'cold'}`` dicts."""
-        with self._cv:
-            if self._closing:
-                raise ServiceClosedError(
-                    f'service {self.name!r} is shut down')
+    def bucket_spec(self, mp, *, shots: int = 1, n_programs: int = None,
+                    cfg: InterpreterConfig = None) -> BucketSpec:
+        """The BOUND :class:`BucketSpec` a ``(mp, cfg)`` submission
+        would dispatch into at ``n_programs`` batch occupancy (default
+        ``max_batch_programs``; pow2-padded exactly like live
+        dispatch) and ``shots`` — the value :meth:`warmup` compiles
+        and the catalog stores."""
         n_programs = n_programs if n_programs is not None \
             else self.max_batch_programs
         n_programs = max(1, min(n_programs, self.max_batch_programs))
         base = cfg if cfg is not None else self._default_cfg
         ncfg, _ = _normalize_cfg(base, isa.shape_bucket(mp.n_instr))
-        meas = np.zeros((int(shots), mp.n_cores, ncfg.max_meas),
-                        np.int32)
-        key = bucket_key(mp, ncfg)
-        batch = [Request(mp=mp, meas_bits=meas, init_regs=None,
-                         cfg=ncfg, strict=False, n_shots=int(shots),
-                         priority=0, deadline=None, seq=-1)
-                 for _ in range(n_programs)]
+        P = _pow2(n_programs) if self.pad_programs else n_programs
+        return bucket_key(mp, ncfg).bind(n_programs=P,
+                                         n_shots=int(shots))
+
+    def warmup(self, specs=None, *, shots: int = 1,
+               n_programs: int = None,
+               cfg: InterpreterConfig = None) -> list:
+        """AOT-precompile serving executables on EVERY device executor
+        (``sim.interpreter.aot_compile_batch`` — ``lower().compile()``
+        against abstract shapes, no real program dispatched), so the
+        first real request in a warmed bucket never eats the XLA
+        compile inside its latency budget.
+
+        ``specs`` is a bound :class:`BucketSpec`, an iterable of them,
+        or (backward compatible) a machine program — then
+        ``shots``/``n_programs``/``cfg`` describe the representative
+        batch exactly as before and :meth:`bucket_spec` derives the
+        spec.  An executable is shape-exact — (programs, shots, cores,
+        instruction bucket, cfg) — so warm coverage needs the
+        occupancies traffic will actually dispatch (the benches warm
+        every power of two up to ``max_batch_programs``).
+
+        Counted in ``stats()['compile']`` / ``serve.compile.*`` like a
+        dispatch (warmup compiles classify cold; the first real
+        request then classifies warm).  Returns one ``{'device',
+        'spec', 'cold', 'compile_ms'}`` dict per (spec, executor) —
+        ``compile_ms`` 0.0 when the executable was already cached.
+
+        Covers the coalesced multi-program path; a ``singleton_engine``
+        fallback dispatch is content-keyed and cannot be AOT-compiled
+        from a shape alone."""
+        with self._cv:
+            if self._closing:
+                raise ServiceClosedError(
+                    f'service {self.name!r} is shut down')
+        if specs is None:
+            raise ValueError('warmup needs a bound BucketSpec, an '
+                             'iterable of them, or a machine program')
+        if hasattr(specs, 'n_instr'):      # a MachineProgram (legacy)
+            specs = [self.bucket_spec(specs, shots=shots,
+                                      n_programs=n_programs, cfg=cfg)]
+        elif isinstance(specs, BucketSpec):
+            specs = [specs]
+        else:
+            specs = list(specs)
         report = []
-        for ex in self._executors:
-            seen0 = ex.cold_compiles
-            self._run_batch(ex, key, batch, ncfg)
-            with self._cv:
-                self._warmups += 1
-                cold = ex.cold_compiles > seen0
-            profiling.counter_inc('serve.warmups')
-            report.append({'device': ex.label(), 'cold': cold})
+        for spec in specs:
+            if not spec.bound:
+                raise ValueError(
+                    f'warmup needs BOUND specs (BucketSpec.bind / '
+                    f'bucket_spec); got template {spec.label()!r}')
+            for ex in self._executors:
+                dt = aot_compile_batch(spec, ex.device)
+                cold = self._classify_compile(ex, spec.template(),
+                                              spec.shape_sig())
+                with self._cv:
+                    self._warmups += 1
+                    if dt > 0:
+                        self._warmup_aot += 1
+                profiling.counter_inc('serve.warmups')
+                report.append({'device': ex.label(),
+                               'spec': spec.label(), 'cold': cold,
+                               'compile_ms': dt * 1e3})
         return report
+
+    def _warmup_replay(self, specs: list) -> None:
+        """Background catalog replay (the ``dproc-serve-warmup-*``
+        thread): AOT-compile every recorded spec on every executor.
+        Never blocks admission — dispatch takes the lazy path for any
+        bucket whose replay has not landed yet — and a bad catalog
+        entry is skipped, never surfaced to a request."""
+        for spec in specs:
+            compiled_any = False
+            for ex in self._executors:
+                with self._cv:
+                    if self._closing:
+                        self._warmup_pending = 0
+                        return
+                try:
+                    dt = aot_compile_batch(spec, ex.device)
+                except Exception:   # noqa: BLE001 - tolerate bad entries
+                    with self._cv:
+                        self._warmup_pending -= 1
+                    continue
+                # mark the (bucket, shape) seen so the first real
+                # request classifies warm — which it IS, it will hit
+                # the precompiled executable
+                self._classify_compile(ex, spec.template(),
+                                       spec.shape_sig())
+                with self._cv:
+                    self._warmup_pending -= 1
+                    if dt > 0:
+                        self._warmup_aot += 1
+                    compiled_any = True
+            with self._cv:
+                if compiled_any:
+                    self._warmup_replayed += 1
+                self._cv.notify_all()
+        profiling.counter_inc('serve.warmup_replays')
 
     # -- introspection / lifecycle ---------------------------------------
 
@@ -1307,6 +1479,11 @@ class ExecutionService:
                 'work_stealing': self._stealing,
                 'steals': self._steals,
                 'warmups': self._warmups,
+                'warmup': {
+                    'aot_compiled': self._warmup_aot,
+                    'replayed': self._warmup_replayed,
+                    'in_progress': self._warmup_pending,
+                },
                 'supervision': self._supervision,
                 'health': {state: health.get(state, 0)
                            for state in (HEALTH_LIVE,
@@ -1330,8 +1507,9 @@ class ExecutionService:
                                 for ex in self._executors),
                     'warm': sum(ex.warm_hits
                                 for ex in self._executors),
-                    'per_bucket': {k: dict(v) for k, v in sorted(
-                        self._bucket_compiles.items())},
+                    'per_bucket': {
+                        k: _bucket_compile_view(v) for k, v in sorted(
+                            self._bucket_compiles.items())},
                 },
                 'source': {
                     'submitted': self._source_submitted,
@@ -1413,6 +1591,11 @@ class ExecutionService:
                 with self._cv:
                     self._cancelled += n
                 profiling.counter_inc('serve.cancelled', n)
+        wt = self._warmup_thread
+        if wt is not None:
+            # the replay loop observes _closing between compiles and
+            # exits; join keeps the thread-leak probe clean
+            wt.join(timeout)
         for ex in self._executors:
             ex.thread.join(timeout)
         if self._supervisor is not None:

@@ -40,7 +40,10 @@ bits from demodulated waveforms when physics-in-the-loop is wanted.
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
+import time
 from dataclasses import dataclass, replace
 
 import numpy as np
@@ -2685,6 +2688,121 @@ def _run_multi_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
     return jax.vmap(one_program)(soa, sync_part, meas_bits, init_regs)
 
 
+# ---------------------------------------------------------------------------
+# AOT bucket precompilation (docs/SERVING.md "cold start & warmup")
+#
+# jit dispatch populates its cache lazily — the first real request in a
+# shape bucket pays the full XLA compile inside its latency budget.
+# ``aot_compile_batch`` pays that compile AHEAD of traffic from a bare
+# shape description (no program needed): it lowers
+# ``_run_multi_batch_jit`` against abstract ``ShapeDtypeStruct`` inputs
+# and holds the resulting ``Compiled`` executable in an explicit
+# process-level cache.  The explicit cache is load-bearing:
+# ``lower().compile()`` does NOT seed jit's own dispatch cache (they
+# are separate tables), so ``simulate_multi_batch`` consults this one
+# first and calls the precompiled executable directly on a hit.  A
+# ``Compiled`` is shape/dtype-exact — exactly the bound-BucketSpec
+# contract (serve/bucketspec.py) — and produces bit-identical results
+# to the lazy path (tests/test_aot_warmup.py pins this per stat,
+# fault word included).
+
+_AOT_LOCK = threading.Lock()
+_AOT_CACHE: dict = {}     # _aot_cache_key(...) -> jax.stages.Compiled
+
+
+def _aot_cache_key(P, B, C, N, E, max_meas, cfg, traits, device):
+    dev = None if device is None else (device.platform, device.id)
+    return (int(P), int(B), int(C), int(N), int(E), int(max_meas),
+            cfg, traits, dev)
+
+
+def aot_compile_batch(spec, jax_device=None) -> float:
+    """Ahead-of-time compile the multi-program executable a bound
+    :class:`~..serve.bucketspec.BucketSpec` describes, pinned to
+    ``jax_device`` (None = the default device).
+
+    ``spec`` is duck-typed (``n_programs``/``n_shots``/``n_cores``/
+    ``n_instr_bucket``/``max_elems``/``cfg``/``traits``) so this module
+    needs no serve import.  ``spec.cfg`` must be jit-normalized the
+    same way the dispatch path normalizes (the service's
+    ``_normalize_cfg`` output already is; raw cfgs are re-normalized
+    here defensively so both paths land on one cache key).
+
+    Returns wall-clock compile seconds, or 0.0 when the executable was
+    already cached (idempotent — safe to replay a catalog on every
+    restart; JAX's persistent compilation cache makes the replays cheap
+    across processes).
+    """
+    P, B = spec.n_programs, spec.n_shots
+    if P is None or B is None:
+        raise ValueError('aot_compile_batch needs a BOUND spec '
+                         '(n_programs/n_shots set — BucketSpec.bind)')
+    cfg = spec.cfg
+    if cfg.straightline or cfg.engine in ('straightline', 'block',
+                                          'pallas'):
+        raise ValueError('AOT precompilation covers the generic '
+                         'multi-program engine only (content-keyed '
+                         'engines have no shape-only executable)')
+    if cfg.straightline is None or cfg.engine is not None:
+        cfg = replace(cfg, straightline=False, engine=None)
+    cfg, _ = _fault_policy(cfg)
+    C, N, E = spec.n_cores, spec.n_instr_bucket, spec.max_elems
+    key = _aot_cache_key(P, B, C, N, E, cfg.max_meas, cfg, spec.traits,
+                         jax_device)
+    with _AOT_LOCK:
+        if key in _AOT_CACHE:
+            return 0.0
+    sds = jax.ShapeDtypeStruct
+    soa = sds((P, C, N, len(_FIELDS)), jnp.int32)
+    spc = sds((C, E), jnp.int32)
+    interp = sds((C, E), jnp.int32)
+    sync_part = sds((P, C), jnp.bool_)
+    meas_bits = sds((P, B, C, cfg.max_meas), jnp.int32)
+    init_regs = sds((P, B, C, isa.N_REGS), jnp.int32)
+    ctx = jax.default_device(jax_device) if jax_device is not None \
+        else contextlib.nullcontext()
+    t0 = time.perf_counter()
+    with ctx:
+        compiled = _run_multi_batch_jit.lower(
+            soa, spc, interp, sync_part, meas_bits, cfg, C, init_regs,
+            spec.traits).compile()
+    dt = time.perf_counter() - t0
+    with _AOT_LOCK:
+        # keep the first on a race — callers treat dt as "work done"
+        _AOT_CACHE.setdefault(key, compiled)
+    counter_inc('aot_compile')
+    return dt
+
+
+def _aot_lookup(P, B, C, N, E, max_meas, cfg, traits, device):
+    with _AOT_LOCK:
+        return _AOT_CACHE.get(
+            _aot_cache_key(P, B, C, N, E, max_meas, cfg, traits, device))
+
+
+def aot_cache_size() -> int:
+    with _AOT_LOCK:
+        return len(_AOT_CACHE)
+
+
+def clear_aot_cache() -> int:
+    """Drop every precompiled executable (tests/conftest.py calls this
+    at module boundaries alongside ``jax.clear_caches()`` so the
+    per-process compiler footprint stays bounded).  Returns the number
+    of entries dropped."""
+    with _AOT_LOCK:
+        n = len(_AOT_CACHE)
+        _AOT_CACHE.clear()
+    return n
+
+
+def aot_compile_count() -> int:
+    """How many AOT executables this process has compiled (named
+    counter ``'aot_compile'``); ``'aot_hit'`` counts dispatches served
+    by one."""
+    return counter_get('aot_compile')
+
+
 def span_trace_count() -> int:
     """How many times any span runner has been traced in this process —
     a sweep whose span divides its batch count must move it by one.
@@ -2736,7 +2854,7 @@ def make_span_runner(step):
 
 def simulate_multi_batch(mps, meas_bits, init_regs=None,
                          cfg: InterpreterConfig = None, pad_to: int = None,
-                         jax_device=None, **kw) -> dict:
+                         jax_device=None, _aot_device=None, **kw) -> dict:
     """Execute N programs x B shots in one compiled call.
 
     ``jax_device`` pins the dispatch to one accelerator device (inputs
@@ -2769,9 +2887,13 @@ def simulate_multi_batch(mps, meas_bits, init_regs=None,
     being amortized away (``straightline=True`` raises).
     """
     if jax_device is not None:
+        # recurse under the placement context; remember the device so
+        # the AOT-cache lookup below keys on it (an executable compiled
+        # for one device must not serve a dispatch pinned to another)
         with jax.default_device(jax_device):
             return simulate_multi_batch(mps, meas_bits, init_regs,
-                                        cfg=cfg, pad_to=pad_to, **kw)
+                                        cfg=cfg, pad_to=pad_to,
+                                        _aot_device=jax_device, **kw)
     from ..decoder import MultiMachineProgram, stack_machine_programs
     mmp = mps if isinstance(mps, MultiMachineProgram) \
         else stack_machine_programs(mps, pad_to=pad_to)
@@ -2822,10 +2944,19 @@ def simulate_multi_batch(mps, meas_bits, init_regs=None,
                     f'form); got {tuple(init_regs.shape)}')
             init_regs = jnp.broadcast_to(
                 init_regs[:, None], (P, B) + tuple(init_regs.shape[1:]))
-    return _check_strict(
-        _run_multi_batch_jit(soa, spc, interp, sync_part, meas_bits,
-                             cfg, C, init_regs, program_traits(mmp)),
-        strict)
+    traits = program_traits(mmp)
+    # AOT front door: a precompiled executable for this exact shape
+    # bucket (and device pin) serves the dispatch with zero compile
+    # risk; otherwise fall through to jit's lazy dispatch cache.
+    fn = _aot_lookup(P, B, C, soa.shape[2], spc.shape[1], cfg.max_meas,
+                     cfg, traits, _aot_device)
+    if fn is not None:
+        counter_inc('aot_hit')
+        out = fn(soa, spc, interp, sync_part, meas_bits, init_regs)
+    else:
+        out = _run_multi_batch_jit(soa, spc, interp, sync_part,
+                                   meas_bits, cfg, C, init_regs, traits)
+    return _check_strict(out, strict)
 
 
 # per-program scalars of the simulate_multi_batch result: every other

@@ -85,9 +85,13 @@ def _clear_jax_caches_between_modules():
     same modules compile cleanly in a fresh process).  Dropping the
     executable caches at file boundaries keeps the per-process compiler
     footprint bounded; within-file sharing (where almost all reuse
-    lives) is untouched."""
+    lives) is untouched.  The interpreter's AOT warmup cache
+    (sim/interpreter.py ``_AOT_CACHE``) holds Compiled executables
+    outside jax's own tables, so it drops here too."""
     yield
     jax.clear_caches()
+    from distributed_processor_tpu.sim.interpreter import clear_aot_cache
+    clear_aot_cache()
 
 
 def pytest_collection_modifyitems(config, items):

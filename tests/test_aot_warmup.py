@@ -1,0 +1,337 @@
+"""AOT bucket precompilation: BucketSpec, aot_compile_batch, the
+learned catalog, and the service warmup paths (docs/SERVING.md "Cold
+start & warmup").
+
+The load-bearing properties, in order:
+
+* **Identity**: a BucketSpec survives the JSON round-trip exactly
+  (including traits and the cfg's tuple fields), equality/hash ignore
+  ``traits`` (the coalescing contract — mixed-trait programs share a
+  batch) while ``identity()`` includes them (the exact-executable key).
+* **Bit-identity**: a request served by an AOT-precompiled executable
+  equals the lazily jit-compiled dispatch per stat, including the
+  fault word — warmup is a latency optimization, never a semantic one.
+* **Durability**: a catalog recorded by one service replays in a
+  FRESH PROCESS, where the startup warmup thread precompiles every
+  spec and the first real request classifies warm.
+* **Liveness**: catalog replay runs on a background thread; admission
+  and dispatch never wait for it, even if a compile wedges.
+
+This module is listed in tools/check_junit.py NO_SKIP_MODULES: it runs
+on the forced CPU mesh and has no legitimate skip condition.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.models import (active_reset,
+                                              make_default_qchip,
+                                              rb_ensemble)
+from distributed_processor_tpu.pipeline import compile_to_machine
+from distributed_processor_tpu.serve import (BucketCatalog, BucketSpec,
+                                             ExecutionService,
+                                             bucket_key)
+from distributed_processor_tpu.serve import service as service_mod
+from distributed_processor_tpu.serve.service import _normalize_cfg
+from distributed_processor_tpu.sim.interpreter import (
+    InterpreterConfig, aot_cache_size, aot_compile_batch,
+    clear_aot_cache, program_traits, simulate_batch)
+from distributed_processor_tpu.utils import profiling
+
+pytestmark = pytest.mark.serve
+
+
+def _ensemble(n_qubits, depth, n_seqs, seed):
+    qubits = [f'Q{i}' for i in range(n_qubits)]
+    qchip = make_default_qchip(n_qubits)
+    return [compile_to_machine(active_reset(qubits) + prog, qchip,
+                               n_qubits=n_qubits)
+            for prog in rb_ensemble(qubits, depth, n_seqs, seed=seed)]
+
+
+def _cfg_for(mps, **kw):
+    bucket = max(isa.shape_bucket(mp.n_instr) for mp in mps)
+    base = dict(max_steps=2 * bucket + 64, max_pulses=bucket + 2,
+                max_meas=2, max_resets=2, record_pulses=False)
+    base.update(kw)
+    return InterpreterConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# BucketSpec: round-trip, hashing, traits semantics
+# ---------------------------------------------------------------------------
+
+def test_bucketspec_roundtrip_and_identity():
+    mps = _ensemble(2, 2, 1, seed=3)
+    cfg = _cfg_for(mps)
+    ncfg, _ = _normalize_cfg(cfg, isa.shape_bucket(mps[0].n_instr))
+    tmpl = bucket_key(mps[0], ncfg)
+    assert isinstance(tmpl, BucketSpec) and not tmpl.bound
+    assert tmpl.traits == program_traits(mps[0])
+
+    spec = tmpl.bind(n_programs=4, n_shots=8)
+    assert spec.bound and spec.template() == tmpl
+    assert spec.label() == f'{tmpl.label()}p4s8'
+    assert spec.shape_sig() == ('multi', 4, 8, True)
+
+    # exact JSON round trip: equality AND the traits __eq__ ignores
+    back = BucketSpec.from_json(json.loads(json.dumps(spec.to_json())))
+    assert back == spec and hash(back) == hash(spec)
+    assert back.identity() == spec.identity()
+    assert back.cfg == spec.cfg and back.traits == spec.traits
+
+    # traits are excluded from equality/hash (mixed-trait programs
+    # must coalesce into one bucket) but included in identity()
+    from dataclasses import replace
+    stripped = replace(spec, traits=None)
+    assert stripped == spec and hash(stripped) == hash(spec)
+    assert stripped.identity() != spec.identity()
+
+    # version skew is rejected, not silently misparsed
+    doc = spec.to_json()
+    doc['version'] = 999
+    with pytest.raises(ValueError):
+        BucketSpec.from_json(doc)
+
+
+def test_bucket_spec_matches_dispatch_padding():
+    """service.bucket_spec pads occupancy exactly like live dispatch
+    (pow2) and normalizes cfg exactly like _execute."""
+    mps = _ensemble(2, 2, 1, seed=4)
+    cfg = _cfg_for(mps)
+    svc = ExecutionService(cfg, max_batch_programs=8, max_wait_ms=1.0)
+    try:
+        spec = svc.bucket_spec(mps[0], shots=16, n_programs=3)
+        assert spec.n_programs == 4 and spec.n_shots == 16  # 3 -> pow2
+        ncfg, _ = _normalize_cfg(cfg, isa.shape_bucket(mps[0].n_instr))
+        assert spec.template() == bucket_key(mps[0], ncfg)
+        # unbound templates are rejected by warmup and the catalog
+        with pytest.raises(ValueError):
+            svc.warmup(spec.template())
+    finally:
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# AOT executable bit-identity (including the fault word)
+# ---------------------------------------------------------------------------
+
+def test_aot_dispatch_bit_identical_to_lazy():
+    """The same coalesced batch served (a) by the lazily jit-compiled
+    path and (b) by the AOT-precompiled executable must agree per stat,
+    including 'faults' — and (b) must actually hit the AOT cache."""
+    mps = _ensemble(2, 2, 2, seed=7)
+    cfg = _cfg_for(mps)
+    rng = np.random.default_rng(9)
+    bits = [rng.integers(0, 2, (8, mps[0].n_cores, 2)).astype(np.int32)
+            for _ in mps]
+    ncfg, _ = _normalize_cfg(cfg, isa.shape_bucket(mps[0].n_instr))
+    refs = [jax.tree.map(np.asarray, simulate_batch(mp, b, cfg=ncfg))
+            for mp, b in zip(mps, bits)]
+
+    def serve_once(warm):
+        svc = ExecutionService(cfg, max_batch_programs=2,
+                               max_wait_ms=50.0)
+        try:
+            if warm:
+                report = svc.warmup(svc.bucket_spec(mps[0], shots=8,
+                                                    n_programs=2))
+                assert report and all(r['compile_ms'] >= 0.0
+                                      for r in report)
+            handles = [svc.submit(mp, b) for mp, b in zip(mps, bits)]
+            res = [h.result(timeout=600) for h in handles]
+            st = svc.stats()
+        finally:
+            svc.shutdown()
+        return res, st
+
+    clear_aot_cache()
+    lazy_res, _ = serve_once(warm=False)   # lazy jit dispatch
+    assert aot_cache_size() == 0
+
+    hits0 = profiling.counter_get('aot_hit')
+    aot_res, st = serve_once(warm=True)    # AOT executable dispatch
+    assert aot_cache_size() >= 1
+    assert profiling.counter_get('aot_hit') - hits0 >= 1
+    assert st['warmup']['aot_compiled'] >= 1
+
+    for i, want in enumerate(refs):
+        for got in (lazy_res[i], aot_res[i]):
+            assert set(got) == set(want)
+            assert 'fault' in want
+            for k in want:
+                np.testing.assert_array_equal(
+                    np.asarray(got[k]), np.asarray(want[k]),
+                    err_msg=f'prog{i}:{k}')
+
+
+# ---------------------------------------------------------------------------
+# catalog: record in one process, replay in a fresh one
+# ---------------------------------------------------------------------------
+
+_REPLAY_CHILD = r'''
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ['JAX_PLATFORMS'] = 'cpu'
+from distributed_processor_tpu.serve import BucketCatalog, ExecutionService
+from distributed_processor_tpu.serve.benchmark import _workload
+
+specs = BucketCatalog({path!r}).load()
+mps, bits, cfg = _workload(1, 2, 2, {shots}, 7)
+svc = ExecutionService(cfg, max_batch_programs=2, max_wait_ms=5.0,
+                       warmup_catalog={path!r})
+try:
+    deadline = time.monotonic() + 300.0
+    while svc.stats()['warmup']['in_progress'] > 0:
+        assert time.monotonic() < deadline, 'replay never finished'
+        time.sleep(0.01)
+    pre = svc.stats()
+    res = svc.submit(mps[0], bits[0]).result(timeout=300)
+    st = svc.stats()
+finally:
+    svc.shutdown()
+print(json.dumps({{
+    'n_specs': len(specs),
+    'aot_compiled': st['warmup']['aot_compiled'],
+    'replayed': st['warmup']['replayed'],
+    'cold_after_replay': st['compile']['cold'] - pre['compile']['cold'],
+    'regs_sum': int(__import__('numpy').asarray(res['regs']).sum()),
+}}))
+'''
+
+
+def test_catalog_replay_across_restart(tmp_path):
+    """A service with ``warmup_catalog`` learns its dispatched buckets;
+    a FRESH PROCESS replaying that catalog precompiles them at startup
+    and serves its first request warm (the cold-start kill shot)."""
+    from distributed_processor_tpu.serve.benchmark import _workload
+    path = str(tmp_path / 'buckets.json')
+    mps, bits, cfg = _workload(1, 2, 2, 4, 7)
+    svc = ExecutionService(cfg, max_batch_programs=2, max_wait_ms=5.0,
+                           warmup_catalog=path)
+    try:
+        ref = svc.submit(mps[0], bits[0]).result(timeout=600)
+    finally:
+        svc.shutdown()
+    cat = BucketCatalog(path)
+    specs = cat.load()
+    assert len(specs) >= 1 and all(s.bound for s in specs)
+    assert os.path.exists(path)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = _REPLAY_CHILD.format(repo=repo, path=path, shots=4)
+    proc = subprocess.run([sys.executable, '-c', child],
+                          capture_output=True, text=True, timeout=570)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row['n_specs'] == len(specs)
+    assert row['replayed'] == len(specs)
+    assert row['aot_compiled'] >= len(specs)   # per device executor
+    # the first real request after replay classifies WARM: the compile
+    # happened at startup, outside any request's latency budget
+    assert row['cold_after_replay'] == 0
+    assert row['regs_sum'] == int(np.asarray(ref['regs']).sum())
+
+
+def test_catalog_tolerates_corruption(tmp_path):
+    path = tmp_path / 'buckets.json'
+    path.write_text('{definitely not json')
+    assert BucketCatalog(str(path)).load() == []
+    # a valid catalog with a bad magic is treated as empty, not fatal
+    path.write_text(json.dumps({'magic': 'other', 'version': 1,
+                                'specs': []}))
+    assert len(BucketCatalog(str(path))) == 0
+
+
+# ---------------------------------------------------------------------------
+# liveness: replay never blocks admission
+# ---------------------------------------------------------------------------
+
+def test_warmup_replay_never_blocks_admission(tmp_path, monkeypatch):
+    """Requests must admit and complete while catalog replay is still
+    wedged mid-compile: the warmup thread is an optimization running
+    beside the dispatch path, never in front of it."""
+    mps = _ensemble(2, 2, 1, seed=11)
+    cfg = _cfg_for(mps)
+    ncfg, _ = _normalize_cfg(cfg, isa.shape_bucket(mps[0].n_instr))
+    path = str(tmp_path / 'buckets.json')
+    cat = BucketCatalog(path)
+    cat.record(bucket_key(mps[0], ncfg).bind(n_programs=1, n_shots=4))
+
+    gate = threading.Event()
+    stalled = threading.Event()
+
+    def wedged_compile(spec, jax_device=None):
+        stalled.set()
+        gate.wait(60.0)     # held until the request has completed
+        return 0.0
+
+    # the replay thread resolves the name through the service module
+    monkeypatch.setattr(service_mod, 'aot_compile_batch',
+                        wedged_compile)
+    rng = np.random.default_rng(0)
+    bits = rng.integers(0, 2, (4, mps[0].n_cores, 2)).astype(np.int32)
+    svc = ExecutionService(cfg, max_batch_programs=2, max_wait_ms=1.0,
+                           warmup_catalog=path)
+    try:
+        assert stalled.wait(30.0)
+        assert svc.stats()['warmup']['in_progress'] > 0
+        res = svc.submit(mps[0], bits).result(timeout=600)
+        assert np.asarray(res['regs']).shape[0] == 4
+        # the whole request lifecycle ran with replay still wedged
+        assert svc.stats()['warmup']['in_progress'] > 0
+        gate.set()
+        deadline = time.monotonic() + 60.0
+        while svc.stats()['warmup']['in_progress'] > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+    finally:
+        gate.set()
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# stats: the cold/warm split and the warmup block
+# ---------------------------------------------------------------------------
+
+def test_warmup_stats_cold_warm_split():
+    """Warmup classifies cold (untimed); the first real request then
+    classifies warm and contributes a timed warm sample, so the
+    per-bucket view separates compile cost from execute cost."""
+    clear_aot_cache()       # process-level cache would zero compile_ms
+    mps = _ensemble(2, 2, 1, seed=13)
+    cfg = _cfg_for(mps)
+    rng = np.random.default_rng(1)
+    bits = rng.integers(0, 2, (4, mps[0].n_cores, 2)).astype(np.int32)
+    svc = ExecutionService(cfg, max_batch_programs=2, max_wait_ms=1.0)
+    try:
+        spec = svc.bucket_spec(mps[0], shots=4, n_programs=1)
+        report = svc.warmup(spec)
+        assert [r['cold'] for r in report] == [True]
+        assert report[0]['compile_ms'] > 0.0
+        st = svc.stats()
+        assert st['warmup'] == {'aot_compiled': 1, 'replayed': 0,
+                                'in_progress': 0}
+        label = spec.template().label()
+        per = st['compile']['per_bucket'][label]
+        assert per['cold'] == 1 and per['warm'] == 0
+        assert per['cold_ms_mean'] is None    # warmups are untimed
+        assert per['compile_ms_est'] is None
+
+        svc.submit(mps[0], bits).result(timeout=600)
+        per = svc.stats()['compile']['per_bucket'][label]
+        assert per['warm'] == 1
+        assert per['warm_ms_mean'] is not None \
+            and per['warm_ms_mean'] > 0.0
+    finally:
+        svc.shutdown()

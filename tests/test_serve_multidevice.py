@@ -34,6 +34,7 @@ from distributed_processor_tpu.serve import (CancelledError, Coalescer,
 from distributed_processor_tpu.serve.request import Request
 from distributed_processor_tpu.serve.service import _normalize_cfg
 from distributed_processor_tpu.sim.interpreter import (InterpreterConfig,
+                                                       clear_aot_cache,
                                                        simulate_batch)
 from distributed_processor_tpu.utils import profiling
 
@@ -203,15 +204,26 @@ def test_warmup_and_compile_stats():
     rng = np.random.default_rng(5)
     cold0 = profiling.counter_get('serve.compile.cold')
     warm0 = profiling.counter_get('serve.compile.warm')
+    # the AOT executable cache is process-level (idempotent across
+    # services); drop it so this test's warmup compiles are observable
+    clear_aot_cache()
     with ExecutionService(_CFG, max_batch_programs=2, max_wait_ms=5.0,
                           devices=2) as svc:
         report = svc.warmup(mp, shots=4, n_programs=2)
         assert [r['cold'] for r in report] == [True, True]
+        # AOT warmup really compiled (not dispatched) an executable
+        # per device — compile_ms is the lower().compile() wall clock
+        assert all(r['compile_ms'] > 0 for r in report)
         st = svc.stats()
-        assert st['compile'] == {
-            'cold': 2, 'warm': 0,
-            'per_bucket': {'c1i8': {'cold': 2, 'warm': 0}}}
+        assert st['compile']['cold'] == 2
+        assert st['compile']['warm'] == 0
+        per = st['compile']['per_bucket']['c1i8']
+        assert per['cold'] == 2 and per['warm'] == 0
+        # warmup classifications are untimed: no dispatch happened yet
+        assert per['cold_ms_mean'] is None
         assert st['warmups'] == 2
+        assert st['warmup']['aot_compiled'] == 2
+        assert st['dispatches'] == 0
         # a live batch of the warmed shape is a warm hit on its home
         handles = [svc.submit(mp, _bits(rng, 4)) for _ in range(2)]
         for h in handles:
@@ -219,7 +231,12 @@ def test_warmup_and_compile_stats():
         st = svc.stats()
     assert st['compile']['cold'] == 2
     assert st['compile']['warm'] == 1
-    assert st['compile']['per_bucket']['c1i8'] == {'cold': 2, 'warm': 1}
+    per = st['compile']['per_bucket']['c1i8']
+    assert per['cold'] == 2 and per['warm'] == 1
+    # the warm dispatch was timed; the cold side still has no timed
+    # dispatch (both cold classifications were AOT warmups)
+    assert per['warm_ms_mean'] is not None and per['warm_ms_mean'] > 0
+    assert per['cold_ms_mean'] is None and per['compile_ms_est'] is None
     assert st['devices'][0]['warm_hits'] == 1   # home = first-sighted
     assert profiling.counter_get('serve.compile.cold') - cold0 == 2
     assert profiling.counter_get('serve.compile.warm') - warm0 == 1

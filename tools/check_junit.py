@@ -38,6 +38,12 @@ NO_SKIP_MODULES = {
         'content hashing), there is no legitimate skip condition — a '
         'skip means the cache/singleflight/invalidation contract '
         'stopped being exercised (see docs/COMPILE_CACHE.md)',
+    'test_aot_warmup':
+        'AOT warmup tests run on the forced CPU mesh (BucketSpec '
+        'round-trips, aot_compile_batch bit-identity, catalog replay) '
+        'with no hardware dependency — a skip means the cold-start '
+        'contract (docs/SERVING.md "Cold start & warmup") stopped '
+        'being exercised',
 }
 
 # the multi-device serve suite may skip ONLY on a genuinely
