@@ -47,7 +47,10 @@ count; defaults 48/40/single-device),
 BENCH_COMPILE_TENANTS/BENCH_COMPILE_PROGRAMS/BENCH_COMPILE_DEPTH/
 BENCH_COMPILE_SHOTS/BENCH_COMPILE_THREADS (the compile front-door row:
 tenants x distinct programs of that RB depth, shots per submit_source
-request, stampede width; defaults 4/4/4/8/8).
+request, stampede width; defaults 4/4/4/8/8),
+BENCH_OBS_REQS/BENCH_OBS_SHOTS/BENCH_OBS_SAMPLE (the observability
+overhead row: workload shape and the intermediate trace-sampling
+fraction, defaults 32/32/0.25; BENCH_OBS=0 skips the row).
 
 Besides the final stdout line, every completed row is written
 incrementally and atomically to BENCH_ARTIFACT (default
@@ -91,6 +94,7 @@ import json
 import os
 import signal
 import sys
+import tempfile
 import time
 import zlib
 
@@ -893,6 +897,7 @@ def _degraded_rerun(attempts):
                  ('BENCH_COMPILE_PROGRAMS', '2'),
                  ('BENCH_COMPILE_DEPTH', '2'),
                  ('BENCH_COMPILE_SHOTS', '8'),
+                 ('BENCH_OBS_REQS', '8'), ('BENCH_OBS_SHOTS', '8'),
                  # exec_profile row under the kernel interpreter: tiny
                  # batches, one rep — the (a, b) fit is still real
                  ('PROFILE_BATCHES', '64,128,256'),
@@ -989,6 +994,49 @@ def _serve_chaos_row():
         p_crash=float(os.environ.get('BENCH_CHAOS_P_CRASH', 0.08)),
         p_hang=float(os.environ.get('BENCH_CHAOS_P_HANG', 0.02)),
         p_slow=float(os.environ.get('BENCH_CHAOS_P_SLOW', 0.10)))
+
+
+def _observability_overhead_row():
+    """What request tracing costs: the continuous-batching workload at
+    trace_sample 0 (the default), a sampled fraction, and 1.0 — the
+    tracing-off throughput must stay within noise of the untraced
+    baseline (docs/OBSERVABILITY.md; asserted by the acceptance
+    criterion, reported here).  ``BENCH_OBS_REQS`` / ``BENCH_OBS_SHOTS``
+    size the workload, ``BENCH_OBS_SAMPLE`` sets the middle point."""
+    n_reqs = int(os.environ.get('BENCH_OBS_REQS', 32))
+    shots = int(os.environ.get('BENCH_OBS_SHOTS', 32))
+    sampled = float(os.environ.get('BENCH_OBS_SAMPLE', 0.25))
+    out = {'n_reqs': n_reqs, 'shots_per_req': shots}
+    base_svc_s = None
+    for label, sample in (('off', 0.0), ('sampled', sampled),
+                          ('full', 1.0)):
+        # dump to a throwaway file so trace_events reports the real
+        # retained span-event count at each sampling level
+        fd, tmp = tempfile.mkstemp(suffix='.trace.json')
+        os.close(fd)
+        try:
+            row = continuous_batching_comparison(
+                n_reqs=n_reqs, shots=shots, trace_sample=sample,
+                trace_out=tmp)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        entry = {
+            'trace_sample': sample,
+            'service_warm_s': row['service_warm_s'],
+            'throughput_ratio': row['throughput_ratio'],
+            'latency_p99_ms': row['latency_p99_ms'],
+            'trace_events': row['trace_events'],
+        }
+        if base_svc_s is None:
+            base_svc_s = row['service_warm_s']
+        elif base_svc_s > 0:
+            entry['overhead_vs_off'] = round(
+                row['service_warm_s'] / base_svc_s - 1.0, 4)
+        out[label] = entry
+    return out
 
 
 def _compile_front_door_row():
@@ -1492,6 +1540,20 @@ def main():
         front_door = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('compile_front_door', front_door)
 
+    # observability-overhead row: the continuous-batching workload at
+    # trace_sample off / sampled / full — what the flight-deck costs
+    # when it is off (nothing) and when it is on (BENCH_OBS_* knobs)
+    if secondaries and os.environ.get('BENCH_OBS', '1') != '0':
+        try:
+            obs_row = _timed_row(_observability_overhead_row)
+        except _RowTimeout as e:
+            obs_row = {'error': 'timeout', 'detail': str(e)}
+        except Exception as e:  # pragma: no cover - defensive
+            obs_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+    else:
+        obs_row = None
+    artifact.row('observability_overhead', obs_row)
+
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
     result = {
@@ -1542,6 +1604,7 @@ def main():
             'serve_open_loop': serve_open,
             'availability_under_chaos': serve_chaos,
             'compile_front_door': front_door,
+            'observability_overhead': obs_row,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
@@ -426,17 +427,34 @@ def cmd_serve_bench(args):
             n_reqs=args.requests, n_qubits=args.qubits,
             depth=args.depth, shots=args.shots, seed=args.seed)
     elif args.open_loop or args.slo:
+        # --trace-out with the sample left at 0 means "trace them all"
+        sample = args.trace_sample or (1.0 if args.trace_out else 0.0)
         row = open_loop_latency(
             n_reqs=args.requests, rate_hz=args.rate_hz,
             n_qubits=args.qubits, shots=args.shots, seed=args.seed,
             devices=args.devices, slo=args.slo,
-            warmup_catalog=args.warmup_catalog)
+            warmup_catalog=args.warmup_catalog,
+            trace_sample=sample, trace_out=args.trace_out)
     else:
+        sample = args.trace_sample or (1.0 if args.trace_out else 0.0)
         row = continuous_batching_comparison(
             n_reqs=args.requests, n_qubits=args.qubits,
             depth=args.depth, shots=args.shots, seed=args.seed,
-            max_wait_ms=args.max_wait_ms)
+            max_wait_ms=args.max_wait_ms,
+            trace_sample=sample, trace_out=args.trace_out)
     print(json.dumps(row, indent=2))
+
+
+def cmd_trace_view(args):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'tools'))
+    from traceview import format_table, summarize
+    summary = summarize(args.trace)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(format_table(summary))
 
 
 def cmd_warmup(args):
@@ -715,7 +733,24 @@ def main(argv=None):
                    help='open-loop: learned bucket catalog to replay '
                         'at service startup and record new buckets '
                         'into (serve/catalog.py)')
+    p.add_argument('--trace-sample', type=float, default=0.0,
+                   help='fraction of requests carrying a lifecycle '
+                        'trace (docs/OBSERVABILITY.md); default 0=off')
+    p.add_argument('--trace-out', metavar='PATH',
+                   help='export the measured round as Chrome Trace '
+                        'Event JSON (Perfetto / chrome://tracing '
+                        'loadable; implies --trace-sample 1.0 unless '
+                        'set); summarize with `trace-view`')
     p.set_defaults(fn=cmd_serve_bench)
+
+    p = sub.add_parser('trace-view',
+                       help='per-stage p50/p99 waterfall of an '
+                            'exported request trace (serve-bench '
+                            '--trace-out, ExecutionService.dump_trace)')
+    p.add_argument('trace', help='Chrome Trace Event JSON file')
+    p.add_argument('--json', action='store_true',
+                   help='emit the summary as JSON instead of a table')
+    p.set_defaults(fn=cmd_trace_view)
 
     p = sub.add_parser('warmup',
                        help='AOT-compile a learned bucket catalog '

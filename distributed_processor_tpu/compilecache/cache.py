@@ -38,6 +38,7 @@ import time
 
 from .key import content_key
 from .store import PersistentStore
+from ..utils import profiling
 
 # get_or_compile outcome labels (the `status` the caller sees)
 HIT = 'hit'            # in-memory LRU hit
@@ -87,6 +88,9 @@ class CompileCache:
         self._invalidations = 0         # epoch flush events
         self._invalidated_entries = 0   # entries flushed by them
         self._validation_rejects = 0
+        # optional FlightRecorder (set by ExecutionService) — epoch
+        # invalidations land in the serving tier's incident timeline
+        self.recorder = None
 
     # -- the front door --------------------------------------------------
 
@@ -115,6 +119,7 @@ class CompileCache:
                 if hit is not None:
                     self._lru.move_to_end(key)
                     self._hits += 1
+                    profiling.counter_inc('compilecache.hits')
                     return hit[0], HIT, key
                 flight = self._flights.get(key)
                 if flight is None:
@@ -123,6 +128,7 @@ class CompileCache:
                     owner = True
                 else:
                     self._singleflight_waits += 1
+                    profiling.counter_inc('compilecache.singleflight_waits')
                     owner = False
             if not owner:
                 flight.event.wait()
@@ -145,6 +151,7 @@ class CompileCache:
                 status = DISK
                 with self._lock:
                     self._disk_hits += 1
+                profiling.counter_inc('compilecache.disk_hits')
             else:
                 status = MISS
                 mp = self._compile(program, qchip, channel_configs,
@@ -194,6 +201,8 @@ class CompileCache:
         with self._lock:
             self._misses += 1
             self._compile_s.append(dt)
+        profiling.counter_inc('compilecache.misses')
+        profiling.registry().observe('compilecache.compile_ms', dt * 1e3)
         return mp
 
     def _admit(self, key, qchip_fp, mp, write_disk: bool):
@@ -240,6 +249,10 @@ class CompileCache:
         with self._lock:
             self._invalidations += 1
             self._invalidated_entries += n
+        profiling.counter_inc('compilecache.invalidations')
+        if self.recorder is not None:
+            self.recorder.record('cache_invalidate', qchip_fp=qchip_fp,
+                                 entries=n)
         return n
 
     # -- introspection ---------------------------------------------------

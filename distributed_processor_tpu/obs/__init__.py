@@ -1,0 +1,31 @@
+"""Flight-deck observability for the serving stack (docs/OBSERVABILITY.md).
+
+Three stdlib-only, jax-free pieces the serve / compilecache / sim
+layers emit into:
+
+:mod:`.trace`     per-request lifecycle spans + Chrome Trace export
+:mod:`.metrics`   typed registry (counters / gauges / histograms) with
+                  Prometheus text exposition — the backing store for
+                  ``utils.profiling``'s counter namespace
+:mod:`.recorder`  flight recorder — lock-cheap ring buffer of
+                  supervision / chaos events
+"""
+
+from .metrics import (DEFAULT_BUCKETS, Histogram, MetricsRegistry,
+                      default_registry)
+from .recorder import FlightRecorder
+from .trace import (STAGE_ORDER, TraceContext, Tracer,
+                    chrome_trace_events, write_chrome_trace)
+
+__all__ = [
+    'DEFAULT_BUCKETS',
+    'Histogram',
+    'MetricsRegistry',
+    'default_registry',
+    'FlightRecorder',
+    'STAGE_ORDER',
+    'TraceContext',
+    'Tracer',
+    'chrome_trace_events',
+    'write_chrome_trace',
+]

@@ -1,0 +1,245 @@
+"""Typed metrics registry: counters, gauges, fixed-bucket histograms.
+
+The flat named-counter dict in ``utils/profiling.py`` grew organically
+from the interpreter's retrace probes into the serving tier's whole
+metrics surface.  This module is the typed replacement it delegates to:
+one process-wide :class:`MetricsRegistry` holding
+
+``counter``    monotone int (the existing ``counter_inc`` namespace —
+               every ``serve.*`` / ``*_trace`` / ``aot_*`` name lands
+               here unchanged)
+``gauge``      last-write-wins float (queue depths, cache sizes)
+``histogram``  fixed-bucket counts + sum/count for exposition, plus a
+               bounded window of raw samples so existing exact-
+               percentile ``stats()`` fields stay byte-compatible
+
+with a Prometheus-style text exposition (:meth:`prometheus_text`) and a
+snapshot/restore API that the test suite uses to isolate counter
+asserts from execution order (tests/conftest.py).
+
+Deliberately stdlib-only and import-cheap: the serve dispatcher
+increments counters on its hot path and the tracing layer must be
+importable without jax.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import re
+import threading
+from collections import deque
+
+# latency-flavoured default bucket ladder (milliseconds); the +inf
+# bucket is implicit — Prometheus convention, cumulative on exposition
+DEFAULT_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                   250.0, 500.0, 1000.0, 2500.0, 5000.0)
+
+_NAME_RE = re.compile(r'[^a-zA-Z0-9_:]')
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize a dotted counter name into a Prometheus metric name."""
+    out = _NAME_RE.sub('_', name)
+    if out and out[0].isdigit():
+        out = '_' + out
+    return out
+
+
+class Histogram:
+    """Fixed-bucket histogram with a bounded exact-sample window.
+
+    The buckets feed the Prometheus exposition; the window keeps the
+    raw samples (newest ``window`` of them) so callers that previously
+    ran ``np.percentile`` over a deque — the service's latency
+    percentiles, the compile cache's compile-time percentiles — keep
+    producing the exact same numbers after migrating onto the registry.
+    """
+
+    def __init__(self, name: str, buckets=None, window: int = 4096):
+        self.name = name
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._counts = [0] * (len(self.buckets) + 1)   # +1 = +inf
+        self._sum = 0.0
+        self._n = 0
+        self._window = deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._n += 1
+        # deque.append is atomic; keeping it outside the lock keeps the
+        # hot path to one short critical section
+        self._window.append(value)
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def values(self) -> list:
+        """Snapshot of the retained raw-sample window (newest last)."""
+        return list(self._window)
+
+    def percentile(self, p: float):
+        """Exact percentile over the retained window (linear
+        interpolation, numpy-compatible); None when empty."""
+        vals = sorted(self._window)
+        if not vals:
+            return None
+        if len(vals) == 1:
+            return vals[0]
+        rank = (p / 100.0) * (len(vals) - 1)
+        lo = int(math.floor(rank))
+        hi = min(lo + 1, len(vals) - 1)
+        frac = rank - lo
+        return vals[lo] * (1.0 - frac) + vals[hi] * frac
+
+    def state(self) -> dict:
+        with self._lock:
+            return {'buckets': self.buckets,
+                    'counts': list(self._counts),
+                    'sum': self._sum, 'n': self._n,
+                    'window': list(self._window),
+                    'maxlen': self._window.maxlen}
+
+    @classmethod
+    def from_state(cls, name: str, st: dict) -> 'Histogram':
+        h = cls(name, buckets=st['buckets'], window=st['maxlen'])
+        h._counts = list(st['counts'])
+        h._sum = st['sum']
+        h._n = st['n']
+        h._window.extend(st['window'])
+        return h
+
+
+class MetricsRegistry:
+    """One process-wide home for every counter, gauge, and histogram."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._histograms: dict = {}
+
+    # -- counters (the utils.profiling namespace) -----------------------
+
+    def inc(self, name: str, amount: int = 1) -> int:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + amount
+            return self._counters[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return dict(self._counters)
+
+    # -- gauges ---------------------------------------------------------
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauge(self, name: str, default=0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def gauges(self) -> dict:
+        with self._lock:
+            return dict(self._gauges)
+
+    # -- histograms -----------------------------------------------------
+
+    def histogram(self, name: str, buckets=None,
+                  window: int = 4096) -> Histogram:
+        """Get-or-create the named histogram (first caller fixes the
+        bucket ladder and window size)."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = Histogram(name, buckets=buckets, window=window)
+                self._histograms[name] = h
+            return h
+
+    def observe(self, name: str, value: float, buckets=None) -> None:
+        self.histogram(name, buckets=buckets).observe(value)
+
+    def histograms(self) -> dict:
+        with self._lock:
+            return dict(self._histograms)
+
+    # -- snapshot / restore (test isolation) ----------------------------
+
+    def snapshot(self) -> dict:
+        """Deep-copyable state of every metric, for later ``restore``."""
+        with self._lock:
+            return {
+                'counters': dict(self._counters),
+                'gauges': dict(self._gauges),
+                'histograms': {n: h.state()
+                               for n, h in self._histograms.items()},
+            }
+
+    def restore(self, snap: dict) -> None:
+        """Reset the registry to a prior ``snapshot``.  Histogram
+        objects handed out before the snapshot keep working (they are
+        rebuilt fresh in the registry, so post-restore observations via
+        ``observe(name, ...)`` land in the restored instance)."""
+        with self._lock:
+            self._counters = dict(snap.get('counters', {}))
+            self._gauges = dict(snap.get('gauges', {}))
+            self._histograms = {
+                n: Histogram.from_state(n, st)
+                for n, st in snap.get('histograms', {}).items()}
+
+    def reset(self) -> None:
+        self.restore({'counters': {}, 'gauges': {}, 'histograms': {}})
+
+    # -- exposition -----------------------------------------------------
+
+    def prometheus_text(self) -> str:
+        """Prometheus text-format exposition of every metric.
+
+        Dotted names are sanitized (``serve.compile.cold`` →
+        ``serve_compile_cold``); histogram buckets are cumulative with
+        the conventional ``le`` label and trailing ``+Inf``.
+        """
+        lines = []
+        for name, val in sorted(self.counters().items()):
+            pn = _prom_name(name)
+            lines.append(f'# TYPE {pn} counter')
+            lines.append(f'{pn} {val}')
+        for name, val in sorted(self.gauges().items()):
+            pn = _prom_name(name)
+            lines.append(f'# TYPE {pn} gauge')
+            lines.append(f'{pn} {val}')
+        for name, h in sorted(self.histograms().items()):
+            pn = _prom_name(name)
+            st = h.state()
+            lines.append(f'# TYPE {pn} histogram')
+            cum = 0
+            for edge, c in zip(st['buckets'], st['counts']):
+                cum += c
+                lines.append(f'{pn}_bucket{{le="{edge}"}} {cum}')
+            cum += st['counts'][-1]
+            lines.append(f'{pn}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f'{pn}_sum {st["sum"]}')
+            lines.append(f'{pn}_count {st["n"]}')
+        return '\n'.join(lines) + ('\n' if lines else '')
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry ``utils.profiling`` delegates to."""
+    return _DEFAULT

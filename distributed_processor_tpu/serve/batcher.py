@@ -293,6 +293,11 @@ class Coalescer:
                 continue
             req.migrations += 1
             req.handle.migrations = req.migrations
+            if req.handle._trace is not None:
+                # the migration hop in the request's span chain
+                # (steal / quarantine re-home — docs/OBSERVABILITY.md)
+                req.handle._trace.instant('migrate', t=now,
+                                          hop=req.migrations)
             self.push(key, req)
             # the batch already ripened at the victim; keep it
             # immediately dispatchable here even if the migration

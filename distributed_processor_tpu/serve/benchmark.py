@@ -53,9 +53,16 @@ from .service import ExecutionService, _normalize_cfg
 def continuous_batching_comparison(n_reqs: int = 32, n_qubits: int = 2,
                                    depth: int = 2, shots: int = 32,
                                    seed: int = 0,
-                                   max_wait_ms: float = 100.0) -> dict:
+                                   max_wait_ms: float = 100.0,
+                                   trace_sample: float = 0.0,
+                                   trace_out: str = None) -> dict:
     """Warm throughput of ``n_reqs`` service submissions vs the same
-    requests dispatched sequentially; returns a JSON-able row."""
+    requests dispatched sequentially; returns a JSON-able row.
+
+    ``trace_sample`` > 0 turns on per-request tracing in the measured
+    service (the observability-overhead bench varies it); ``trace_out``
+    exports the warm round's Chrome-trace JSON
+    (docs/OBSERVABILITY.md)."""
     qubits = [f'Q{i}' for i in range(n_qubits)]
     qchip = make_default_qchip(n_qubits)
     mps = [compile_to_machine(active_reset(qubits) + prog, qchip,
@@ -79,19 +86,22 @@ def continuous_batching_comparison(n_reqs: int = 32, n_qubits: int = 2,
                 np.asarray, simulate_batch(mp, b, cfg=cfg)))
         return outs, time.perf_counter() - t0
 
-    def run_service():
+    def run_service(dump_to=None):
         svc = ExecutionService(cfg, max_batch_programs=n_reqs,
                                max_wait_ms=max_wait_ms,
-                               max_queue=4 * n_reqs)
+                               max_queue=4 * n_reqs,
+                               trace_sample=trace_sample,
+                               trace_keep=2 * n_reqs)
         try:
             t0 = time.perf_counter()
             handles = [svc.submit(mp, b) for mp, b in zip(mps, bits)]
             res = [h.result(timeout=600) for h in handles]
             dt = time.perf_counter() - t0
             stats = svc.stats()
+            n_events = svc.dump_trace(dump_to) if dump_to else 0
         finally:
             svc.shutdown()
-        return res, dt, stats
+        return res, dt, stats, n_events
 
     # cold round pays the per-bucket compiles on both sides
     run_sequential()
@@ -99,7 +109,7 @@ def continuous_batching_comparison(n_reqs: int = 32, n_qubits: int = 2,
     # warm round is the measurement
     seq_outs, t_seq = run_sequential()
     traces0 = multi_trace_count()
-    svc_res, t_svc, stats = run_service()
+    svc_res, t_svc, stats, n_events = run_service(dump_to=trace_out)
     warm_retraces = multi_trace_count() - traces0
 
     mismatch = []
@@ -124,6 +134,8 @@ def continuous_batching_comparison(n_reqs: int = 32, n_qubits: int = 2,
         'latency_p99_ms': round(stats['latency_p99_ms'], 3),
         'warm_retraces': warm_retraces,
         'bit_identical': True,
+        'trace_sample': trace_sample,
+        'trace_events': n_events,
         'note': 'both sides warm, same generic-engine cfg; ratio is '
                 'N per-program dispatches vs coalesced multi-program '
                 'dispatch(es); results asserted bit-identical first',
@@ -274,7 +286,9 @@ def open_loop_latency(n_reqs: int = 48, rate_hz: float = 40.0,
                       shots: int = 16, seed: int = 0, devices=None,
                       max_batch_programs: int = 4,
                       max_wait_ms: float = 5.0, slo: bool = False,
-                      warmup_catalog: str = None) -> dict:
+                      warmup_catalog: str = None,
+                      trace_sample: float = 0.0,
+                      trace_out: str = None) -> dict:
     """Open-loop serving latency: p50/p99 under a seeded Poisson-ish
     mixed-bucket arrival process.
 
@@ -321,7 +335,9 @@ def open_loop_latency(n_reqs: int = 48, rate_hz: float = 40.0,
         return ExecutionService(max_batch_programs=max_batch_programs,
                                 max_wait_ms=max_wait_ms,
                                 max_queue=4 * n_reqs, devices=devices,
-                                warmup_catalog=catalog)
+                                warmup_catalog=catalog,
+                                trace_sample=trace_sample,
+                                trace_keep=2 * n_reqs)
 
     def _await_replay(svc, timeout_s=600.0):
         deadline = time.monotonic() + timeout_s
@@ -419,6 +435,8 @@ def open_loop_latency(n_reqs: int = 48, rate_hz: float = 40.0,
                         f'COLD after catalog replay — AOT warmup '
                         f'missed their shapes')
                 results, wall, pre, stats = _run_arrivals(svc)
+                if trace_out:
+                    svc.dump_trace(trace_out)
             finally:
                 svc.shutdown()
             _check_bits(results, 'warmed phase')
@@ -474,6 +492,8 @@ def open_loop_latency(n_reqs: int = 48, rate_hz: float = 40.0,
                 _warm_pow2(svc, mps[0], shots, cfg=cfg,
                            max_programs=max_batch_programs)
             results, wall, pre, stats = _run_arrivals(svc)
+            if trace_out:
+                svc.dump_trace(trace_out)
         finally:
             svc.shutdown()
         _check_bits(results, 'open loop')

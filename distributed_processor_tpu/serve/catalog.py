@@ -19,6 +19,16 @@ problem means "empty catalog", never an exception into the serving
 path.  The file is small (one dict per distinct bucket; diverse
 production traffic is tens of buckets, not thousands) so each record
 rewrites the whole file rather than appending.
+
+A catalog left to itself only grows — a retired workload's buckets
+would be AOT-recompiled at every startup forever.  So the catalog
+ages: each process generation that calls :meth:`begin_run` bumps a
+run counter, every dispatch re-stamps its spec's last-seen run, and
+``begin_run`` prunes specs not re-observed within ``max_age_runs``
+runs plus anything over the ``max_specs`` cap (least-recently-seen
+evicted first).  The run/last-seen metadata rides in the same v1
+file under keys old readers ignore, so catalogs written by either
+side of this change stay mutually loadable.
 """
 
 from __future__ import annotations
@@ -41,12 +51,26 @@ class BucketCatalog:
     errors on record are swallowed after the in-memory set updates —
     losing a catalog entry costs one future cold compile, never a
     request.
+
+    ``max_specs`` caps the catalog size (least-recently-seen specs
+    evicted first); ``max_age_runs`` ages out specs not re-observed
+    within that many :meth:`begin_run` generations.  Either may be
+    None (unbounded / no aging).
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_specs: int = None,
+                 max_age_runs: int = None):
+        if max_specs is not None and max_specs < 1:
+            raise ValueError('max_specs must be >= 1')
+        if max_age_runs is not None and max_age_runs < 1:
+            raise ValueError('max_age_runs must be >= 1')
         self.path = path
+        self.max_specs = max_specs
+        self.max_age_runs = max_age_runs
         self._lock = threading.Lock()
         self._specs: dict = {}       # spec.identity() -> spec, ordered
+        self._last_seen: dict = {}   # spec.identity() -> run number
+        self._run = 0
         self._loaded = False
 
     # -- read ----------------------------------------------------------
@@ -55,6 +79,22 @@ class BucketCatalog:
         """Specs in insertion order; [] for a missing/corrupt file."""
         with self._lock:
             self._load_locked()
+            return list(self._specs.values())
+
+    def begin_run(self) -> list:
+        """Open a new process generation: bump the run counter, prune
+        aged/over-cap specs, persist, and return the surviving specs
+        (the startup warmup replay set).  The service calls this once
+        at construction; a catalog opened only via :meth:`load` never
+        ages."""
+        with self._lock:
+            self._load_locked()
+            self._run += 1
+            self._prune_locked()
+            try:
+                self._write_locked()
+            except OSError:
+                pass        # durability is best-effort; serving is not
             return list(self._specs.values())
 
     def _load_locked(self) -> None:
@@ -67,11 +107,47 @@ class BucketCatalog:
             if doc.get('magic') != CATALOG_MAGIC \
                     or doc.get('version') != CATALOG_VERSION:
                 return
+            # aging metadata is optional: a file written before the
+            # aging change loads with every spec treated as just-seen
+            self._run = int(doc.get('runs', 0))
+            last_seen = doc.get('last_seen', {})
+            if not isinstance(last_seen, dict):
+                last_seen = {}
             for d in doc.get('specs', ()):
                 spec = BucketSpec.from_json(d)
-                self._specs.setdefault(spec.identity(), spec)
+                ident = spec.identity()
+                if ident not in self._specs:
+                    self._specs[ident] = spec
+                    self._last_seen[ident] = int(
+                        last_seen.get(self._ident_key(ident), self._run))
         except (OSError, ValueError, TypeError, KeyError):
             self._specs.clear()
+            self._last_seen.clear()
+
+    @staticmethod
+    def _ident_key(ident) -> str:
+        """JSON object keys must be strings; the identity tuple's repr
+        is stable across processes (plain ints/strs/tuples only)."""
+        return repr(ident)
+
+    def _prune_locked(self) -> None:
+        if self.max_age_runs is not None:
+            horizon = self._run - self.max_age_runs
+            stale = [i for i, seen in self._last_seen.items()
+                     if seen < horizon]
+            for ident in stale:
+                del self._specs[ident]
+                del self._last_seen[ident]
+        if self.max_specs is not None \
+                and len(self._specs) > self.max_specs:
+            # least-recently-seen first; insertion order breaks ties
+            order = {i: k for k, i in enumerate(self._specs)}
+            victims = sorted(self._specs,
+                             key=lambda i: (self._last_seen[i],
+                                            order[i]))
+            for ident in victims[:len(self._specs) - self.max_specs]:
+                del self._specs[ident]
+                del self._last_seen[ident]
 
     # -- write ---------------------------------------------------------
 
@@ -83,9 +159,15 @@ class BucketCatalog:
                              '(BucketSpec.bind)')
         with self._lock:
             self._load_locked()
-            if spec.identity() in self._specs:
+            ident = spec.identity()
+            if ident in self._specs:
+                # a re-observation refreshes the age stamp in memory;
+                # persistence rides the next new-spec or begin_run write
+                self._last_seen[ident] = self._run
                 return False
-            self._specs[spec.identity()] = spec
+            self._specs[ident] = spec
+            self._last_seen[ident] = self._run
+            self._prune_locked()
             try:
                 self._write_locked()
             except OSError:
@@ -94,6 +176,9 @@ class BucketCatalog:
 
     def _write_locked(self) -> None:
         doc = {'magic': CATALOG_MAGIC, 'version': CATALOG_VERSION,
+               'runs': self._run,
+               'last_seen': {self._ident_key(i): seen
+                             for i, seen in self._last_seen.items()},
                'specs': [s.to_json() for s in self._specs.values()]}
         d = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(d, exist_ok=True)

@@ -142,6 +142,18 @@ class ChaosMonkey:
 
         def chaotic_run_batch(ex, key, batch, cfg):
             out = self._draw()
+            if out != 'ok':
+                # the injection lands in the service's flight recorder
+                # (and on any traced batch member) so a chaos-soak
+                # failure reads as a timeline, not a moved counter
+                rec = getattr(self.svc, 'flight_recorder', None)
+                if rec is not None:
+                    rec.record('chaos_inject', outcome=out,
+                               executor=ex.label(), n=len(batch))
+                for r in batch:
+                    if r.handle._trace is not None:
+                        r.handle._trace.instant('chaos', outcome=out,
+                                                executor=ex.label())
             if out == 'crash':
                 raise ChaosError(
                     f'injected crash on executor {ex.label()}')

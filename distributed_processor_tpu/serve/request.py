@@ -120,6 +120,10 @@ class RequestHandle:
         # infrastructure failure / migrated between executor queues
         self.retries = 0
         self.migrations = 0
+        # observability context slot: None (untraced — the whole cost
+        # of the tracing-off path) or the obs.trace.TraceContext the
+        # serving layers append lifecycle spans to
+        self._trace = None
 
     # -- submitter side -------------------------------------------------
 
@@ -144,6 +148,15 @@ class RequestHandle:
 
     def cancelled(self) -> bool:
         return isinstance(self._exception, CancelledError)
+
+    def trace(self) -> list | None:
+        """Recorded lifecycle spans (docs/OBSERVABILITY.md), or None
+        when this request was not sampled for tracing.  Each span is a
+        dict ``{name, t0, t1, args}`` with monotonic-clock seconds and
+        ``t1 is None`` for instant hop events; the list is a snapshot
+        and safe to mutate."""
+        ctx = self._trace
+        return None if ctx is None else list(ctx.spans)
 
     def cancel(self) -> bool:
         """Cancel if still queued.  Returns True when this call won —
@@ -181,7 +194,9 @@ class RequestHandle:
             self._state = _QUEUED
             self._attempt += 1
             self.retries += 1
-            return True
+        if self._trace is not None:
+            self._trace.instant('requeue', attempt=self.retries)
+        return True
 
     def _fulfill(self, result: dict, token: int = None) -> bool:
         with self._lock:
@@ -191,6 +206,8 @@ class RequestHandle:
                 return False        # stale dispatch: retried elsewhere
             self._state = _DONE
             self._result = result
+        if self._trace is not None:
+            self._trace.instant('done', outcome='ok')
         self._event.set()
         return True
 
@@ -204,6 +221,8 @@ class RequestHandle:
                 return False        # stale dispatch: retried elsewhere
             self._state = _DONE
             self._exception = exc
+        if self._trace is not None:
+            self._trace.instant('done', outcome=type(exc).__name__)
         self._event.set()
         return True
 

@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -65,9 +66,10 @@ import jax
 
 from .. import isa
 from ..decoder import machine_program_from_cmds, stack_machine_programs
+from ..obs import FlightRecorder, Histogram, Tracer, write_chrome_trace
 from ..sim.interpreter import (ENGINES, InterpreterConfig, FaultError,
-                               aot_compile_batch, demux_multi_batch,
-                               fault_shot_counts,
+                               aot_batch_cached, aot_compile_batch,
+                               demux_multi_batch, fault_shot_counts,
                                is_infrastructure_error, program_traits,
                                resolve_engine, simulate_batch,
                                simulate_multi_batch)
@@ -320,7 +322,28 @@ class ExecutionService:
     ``dproc-serve-warmup-*`` thread (admission never blocks on it) —
     so a restarted service's first requests hit warm.  Progress is in
     ``stats()['warmup']``.  Default None = no catalog (explicit
-    :meth:`warmup` calls still work).
+    :meth:`warmup` calls still work).  Each construction opens a new
+    catalog generation: specs not re-observed within
+    ``catalog_max_age_runs`` generations are pruned, and the catalog
+    is capped at ``catalog_max_specs`` entries (least-recently-seen
+    evicted first) — a retired workload's buckets stop being
+    recompiled at every startup.
+
+    Observability (docs/OBSERVABILITY.md): ``trace_sample`` is the
+    fraction of submissions that carry a per-request trace context
+    recording typed lifecycle spans (queued / compile / coalesce-ripen
+    / dispatch / execute / demux plus retry/steal/migration/park hops)
+    readable via ``handle.trace()`` and exportable as Chrome Trace
+    Event JSON via :meth:`dump_trace`.  Default 0.0 = off — the only
+    per-request cost is the ``None`` context slot every handle already
+    carries.  ``trace_keep`` bounds how many sampled traces are
+    retained for export.  Every service also owns a
+    :class:`~..obs.FlightRecorder` (``flight_events`` ring slots) that
+    supervision, overload control, the chaos harness, and the compile
+    cache record structured events into; it is dumped automatically on
+    supervisor-detected executor deaths/hangs when ``flight_dump_dir``
+    (or ``$DPROC_FLIGHT_DIR``) is set, and on demand via
+    :meth:`dump_flight`.
     """
 
     def __init__(self, cfg: InterpreterConfig = None, *,
@@ -337,7 +360,12 @@ class ExecutionService:
                  max_est_wait_ms: float = None,
                  compile_cache=None, compile_workers: int = 2,
                  compile_cache_dir: str = None,
-                 warmup_catalog: str = None):
+                 warmup_catalog: str = None,
+                 catalog_max_specs: int = 512,
+                 catalog_max_age_runs: int = 32,
+                 trace_sample: float = 0.0, trace_keep: int = 1024,
+                 flight_events: int = 512,
+                 flight_dump_dir: str = None):
         if max_batch_programs < 1:
             raise ValueError('max_batch_programs must be >= 1')
         if max_queue < 1:
@@ -371,6 +399,19 @@ class ExecutionService:
             raise ValueError('hang_timeout_s must be positive or None')
         if max_est_wait_ms is not None and max_est_wait_ms <= 0:
             raise ValueError('max_est_wait_ms must be positive or None')
+        if trace_sample < 0 or trace_sample > 1:
+            raise ValueError('trace_sample must be in [0, 1]')
+        # observability: per-request tracing (sampled) + flight
+        # recorder — created before the executors so the first
+        # dispatch can already emit into them
+        self._tracer = Tracer(trace_sample, keep=trace_keep)
+        self.flight_recorder = FlightRecorder(flight_events)
+        self._flight_dump_dir = flight_dump_dir
+        # submit→done latency in ms: per-service exact-percentile
+        # window (stats() p50/p99, byte-compatible with the old
+        # deque), mirrored into the process registry's fleet-wide
+        # 'serve.latency_ms' histogram for Prometheus exposition
+        self._latency_h = Histogram('serve.latency_ms', window=4096)
         self._supervision = bool(supervision)
         self._retry_policy = retry_policy if retry_policy is not None \
             else RetryPolicy()
@@ -414,7 +455,6 @@ class ExecutionService:
         # latency totals ({cold,warm}_s / _timed) for the compile-vs-
         # execute split stats() reports
         self._bucket_compiles = {}
-        self._latency_s = collections.deque(maxlen=4096)
         # -- supervision state (guarded by _cv's lock) -------------------
         # requests waiting out a retry backoff: (eligible_t, key, req),
         # pumped back into the queues by dispatchers and the supervisor
@@ -439,6 +479,9 @@ class ExecutionService:
         if compile_workers < 1:
             raise ValueError('compile_workers must be >= 1')
         self._compile_cache = compile_cache
+        if compile_cache is not None:
+            # cache invalidations become flight-recorder events
+            compile_cache.recorder = self.flight_recorder
         self._compile_cache_dir = compile_cache_dir
         self._compile_workers = compile_workers
         self._compile_pool = None      # lazily created on first submit_source
@@ -451,8 +494,12 @@ class ExecutionService:
         self._catalog_seen = set()
         replay_specs = []
         if warmup_catalog:
-            self._catalog = BucketCatalog(warmup_catalog)
-            replay_specs = self._catalog.load()
+            self._catalog = BucketCatalog(
+                warmup_catalog, max_specs=catalog_max_specs,
+                max_age_runs=catalog_max_age_runs)
+            # begin_run opens a new generation: aged-out / over-cap
+            # specs are pruned before the replay set is taken
+            replay_specs = self._catalog.begin_run()
             self._catalog_seen.update(s.identity() for s in replay_specs)
         for ex in self._executors:
             ex.thread.start()
@@ -564,11 +611,23 @@ class ExecutionService:
                           init_regs=init_regs, cfg=cfg, strict=strict,
                           n_shots=n_shots, priority=priority,
                           deadline=deadline, seq=next(self._seq), **hkw)
+            # tracing: submit_source already made the sampling call
+            # for its outer handle; everything else draws here.  With
+            # sampling off maybe_start returns None without allocating
+            # — the handle's context slot stays None
+            ctx = req.handle._trace if _handle is not None \
+                else self._tracer.maybe_start()
+            if ctx is not None:
+                req.handle._trace = ctx
+                ctx.instant('submit', t=req.submit_t, seq=req.seq,
+                            bucket=key.label(), priority=priority)
             tgt = self._route_locked(key)
             if tgt is None:
                 # every executor is quarantined/probing: park the
                 # request; the first re-admission pumps it back in
                 self._parked.append((time.monotonic(), key, req))
+                if ctx is not None:
+                    ctx.instant('park', reason='no-live-executor')
             else:
                 tgt.q.push(key, req)
             self._submitted += 1
@@ -587,6 +646,7 @@ class ExecutionService:
                 from ..compilecache import CompileCache
                 self._compile_cache = CompileCache(
                     cache_dir=self._compile_cache_dir)
+                self._compile_cache.recorder = self.flight_recorder
             return self._compile_cache
 
     def submit_source(self, program, qchip, *, shots: int = None,
@@ -615,6 +675,13 @@ class ExecutionService:
         against it).
         """
         handle = RequestHandle()
+        # the sampling decision for a source submission happens here,
+        # at the tenant-visible boundary, so the compile span lands on
+        # the same context the dispatch spans will
+        ctx = self._tracer.maybe_start()
+        if ctx is not None:
+            handle._trace = ctx
+            ctx.instant('submit_source')
         with self._cv:
             if self._closing:
                 raise ServiceClosedError(
@@ -633,11 +700,15 @@ class ExecutionService:
             try:
                 if handle.cancelled():
                     return
+                t_c = time.monotonic()
                 mp, _status, _key = cache.get_or_compile(
                     program, qchip, channel_configs=channel_configs,
                     fpga_config=fpga_config,
                     compiler_flags=compiler_flags, n_qubits=n_qubits,
                     pad_to=pad_to)
+                if handle._trace is not None:
+                    handle._trace.span('compile', t_c, time.monotonic(),
+                                       status=_status)
                 self.submit(mp, meas_bits, shots=shots,
                             init_regs=init_regs, cfg=cfg,
                             priority=priority, deadline_ms=deadline_ms,
@@ -676,6 +747,9 @@ class ExecutionService:
         if deadline is not None and now + est_s >= deadline:
             self._overload_rejected += 1
             profiling.counter_inc('serve.overload_rejected')
+            self.flight_recorder.record(
+                'overload_reject', reason='deadline-unmeetable',
+                est_wait_ms=round(est_s * 1e3, 3))
             raise OverloadError(
                 f'deadline cannot be met: estimated queue wait '
                 f'{est_s * 1e3:.1f} ms exceeds the '
@@ -686,6 +760,9 @@ class ExecutionService:
         if self._shed_locked(priority) is None:
             self._overload_rejected += 1
             profiling.counter_inc('serve.overload_rejected')
+            self.flight_recorder.record(
+                'overload_reject', reason='nothing-to-shed',
+                est_wait_ms=round(est_s * 1e3, 3))
             raise OverloadError(
                 f'overloaded: estimated queue wait {est_s * 1e3:.1f} '
                 f'ms exceeds max_est_wait_ms='
@@ -736,6 +813,8 @@ class ExecutionService:
                 f'a higher-priority request arrived')):
             self._shed += 1
             profiling.counter_inc('serve.shed')
+            self.flight_recorder.record('shed', req=req.seq,
+                                        priority=req.priority)
         return req
 
     # -- routing / stealing ----------------------------------------------
@@ -800,6 +879,15 @@ class ExecutionService:
         thief.steals += 1
         self._steals += 1
         profiling.counter_inc('serve.steals')
+        self.flight_recorder.record('steal', victim=victim.label(),
+                                    thief=thief.label(),
+                                    bucket=key.label(), n=len(reqs))
+        if self._tracer.enabled:
+            for r in reqs:
+                if r.handle._trace is not None:
+                    r.handle._trace.instant('steal',
+                                            src=victim.label(),
+                                            dst=thief.label())
         expired = thief.q.absorb(key, reqs, now)
         self._count_expired_locked(expired)
         return True
@@ -844,6 +932,9 @@ class ExecutionService:
             if tgt is None:
                 keep.append(item)
                 continue
+            if req.handle._trace is not None:
+                req.handle._trace.instant('unpark',
+                                          executor=tgt.label())
             tgt.q.push(key, req, forced=True)
         self._parked = keep
 
@@ -857,11 +948,22 @@ class ExecutionService:
         ex.breaker.trip(now)
         self._breaker_trips += 1
         profiling.counter_inc('serve.breaker_trips')
+        self.flight_recorder.record('breaker_trip',
+                                    executor=ex.label(),
+                                    breaker=ex.breaker.snapshot())
         for key in [k for k, i in self._home.items() if i == ex.idx]:
             del self._home[key]
             self._home_counts[ex.idx] -= 1
         for key, reqs in ex.q.migrate_all().items():
             tgt = self._route_locked(key)
+            if self._tracer.enabled:
+                dst = 'parked' if tgt is None else tgt.label()
+                for r in reqs:
+                    if r.handle._trace is not None:
+                        r.handle._trace.instant('migrate',
+                                                src=ex.label(),
+                                                dst=dst,
+                                                reason='quarantine')
             if tgt is None:
                 self._parked.extend((now, key, r) for r in reqs)
             else:
@@ -904,6 +1006,9 @@ class ExecutionService:
         self._executor_deaths += 1
         ex.deaths += 1
         profiling.counter_inc('serve.executor_deaths')
+        self.flight_recorder.record(
+            'executor_death', executor=ex.label(),
+            inflight=0 if ex.inflight is None else len(ex.inflight[1]))
         inflight, ex.inflight = ex.inflight, None
         ex.busy = False
         ex.dispatch_deadline = None
@@ -916,6 +1021,9 @@ class ExecutionService:
         ex.respawns += 1
         ex.spawn_thread(self)
         ex.thread.start()
+        self.flight_recorder.record('respawn', executor=ex.label(),
+                                    respawns=ex.respawns)
+        self._dump_flight_auto()
         self._cv.notify_all()
 
     def _on_executor_hang_locked(self, ex: _DeviceExecutor,
@@ -928,6 +1036,10 @@ class ExecutionService:
         self._hangs += 1
         ex.hangs += 1
         profiling.counter_inc('serve.hangs')
+        self.flight_recorder.record(
+            'hang', executor=ex.label(),
+            hang_timeout_s=self._hang_timeout_s,
+            inflight=0 if ex.inflight is None else len(ex.inflight[1]))
         inflight, ex.inflight = ex.inflight, None
         ex.dispatch_deadline = None
         self._quarantine_locked(ex, now)
@@ -936,6 +1048,7 @@ class ExecutionService:
             self._retry_batch_locked(key, batch, ExecutorLostError(
                 f'dispatch on executor {ex.label()} exceeded '
                 f'hang_timeout_s={self._hang_timeout_s}'), now)
+        self._dump_flight_auto()
         self._cv.notify_all()
 
     def _start_canary_locked(self, ex: _DeviceExecutor):
@@ -994,6 +1107,8 @@ class ExecutionService:
         now = time.monotonic()
         with self._cv:
             ex.canary_thread = None
+            self.flight_recorder.record('canary', executor=ex.label(),
+                                        ok=ok)
             if ok:
                 ex.canary_ok += 1
                 self._canary_ok += 1
@@ -1002,6 +1117,8 @@ class ExecutionService:
                 ex.breaker.readmit()
                 self._readmissions += 1
                 profiling.counter_inc('serve.readmissions')
+                self.flight_recorder.record('readmission',
+                                            executor=ex.label())
                 self._pump_parked_locked(now)
             else:
                 ex.canary_fail += 1
@@ -1035,6 +1152,7 @@ class ExecutionService:
                         if key is not None:
                             ex.busy = True
                             ex.inflight = (key, batch)
+                            self._trace_claimed(ex, key, batch, now)
                             if self._hang_timeout_s is not None:
                                 ex.dispatch_deadline = \
                                     now + self._hang_timeout_s
@@ -1093,6 +1211,48 @@ class ExecutionService:
             t = tp if t is None else min(t, tp)
         return t
 
+    # -- tracing emission (docs/OBSERVABILITY.md) ------------------------
+
+    def _trace_claimed(self, ex: _DeviceExecutor, key, batch,
+                       now: float) -> None:
+        """Close the queued + coalesce-ripen spans of every traced
+        batch member at the moment the dispatcher claims the batch
+        (called under the cv, right where pop_batch claimed)."""
+        if not self._tracer.enabled:
+            return
+        oldest = min(r.submit_t for r in batch)
+        for r in batch:
+            ctx = r.handle._trace
+            if ctx is None:
+                continue
+            # a retried request re-queues mid-flight: clamp the queued
+            # span to start no earlier than its previous claim so the
+            # per-attempt chain stays ordered
+            t_q = r.submit_t if ctx.last_claim is None \
+                else max(r.submit_t, ctx.last_claim)
+            ctx.span('queued', t_q, now, bucket=key.label(),
+                     executor=ex.label(),
+                     attempt=r.handle.retries + 1)
+            ctx.span('coalesce.ripen', max(oldest, t_q), now,
+                     occupancy=len(batch))
+            ctx.last_claim = now
+
+    def _trace_dispatch(self, batch, ex: _DeviceExecutor, label: str,
+                        klass: str, engine: str,
+                        occupancy: int) -> None:
+        """Record the dispatch span (claim → simulate entry) with the
+        device, the bound-bucket identity, and the compile
+        classification (cold / warm / aot)."""
+        now = time.monotonic()
+        for r in batch:
+            ctx = r.handle._trace
+            if ctx is None:
+                continue
+            t0 = now if ctx.last_claim is None else ctx.last_claim
+            ctx.span('dispatch', t0, now, device=ex.label(),
+                     bucket=label, classification=klass,
+                     engine=engine, occupancy=occupancy)
+
     def _execute(self, ex: _DeviceExecutor, key, batch):
         cfg = key.cfg
         t0 = time.monotonic()
@@ -1101,6 +1261,7 @@ class ExecutionService:
         except Exception as exc:      # noqa: BLE001 - fail the batch, live on
             self._on_batch_failure(ex, key, batch, exc)
             return
+        t_run = time.monotonic()
         completed = failed = 0
         for req, res in zip(batch, results):
             # every completion presents the attempt token: if this
@@ -1116,6 +1277,13 @@ class ExecutionService:
             if req.handle._fulfill(res, token=req.claim_token):
                 completed += 1
         now = time.monotonic()
+        if self._tracer.enabled:
+            for req in batch:
+                ctx = req.handle._trace
+                if ctx is not None:
+                    ctx.span('execute', t0, t_run, device=ex.label(),
+                             bucket=key.label())
+                    ctx.span('demux', t_run, now)
         with self._cv:
             self._dispatches += 1
             self._programs_dispatched += len(batch)
@@ -1130,7 +1298,10 @@ class ExecutionService:
             self._ewma_prog_s = per_prog if self._ewma_prog_s is None \
                 else 0.25 * per_prog + 0.75 * self._ewma_prog_s
             for req in batch:
-                self._latency_s.append(now - req.submit_t)
+                lat_ms = (now - req.submit_t) * 1e3
+                self._latency_h.observe(lat_ms)
+                profiling.registry().observe('serve.latency_ms',
+                                             lat_ms)
         profiling.counter_inc('serve.dispatches')
         profiling.counter_inc('serve.programs_dispatched', len(batch))
         profiling.counter_inc('serve.batch_ms',
@@ -1144,7 +1315,19 @@ class ExecutionService:
         executor's circuit breaker and send the batch through the
         bounded-retry path."""
         profiling.counter_inc('serve.batch_failures')
-        if not is_infrastructure_error(exc):
+        infra = is_infrastructure_error(exc)
+        self.flight_recorder.record('batch_failure',
+                                    executor=ex.label(),
+                                    error=type(exc).__name__,
+                                    infra=infra, n=len(batch))
+        if self._tracer.enabled:
+            for req in batch:
+                ctx = req.handle._trace
+                if ctx is not None:
+                    ctx.instant('batch_error',
+                                error=type(exc).__name__,
+                                executor=ex.label())
+        if not infra:
             failed = 0
             for req in batch:
                 if req.handle._fail(exc, token=req.claim_token):
@@ -1178,10 +1361,24 @@ class ExecutionService:
                     self._failed += 1
                     self._retry_exhausted += 1
                     profiling.counter_inc('serve.retry_exhausted')
+                    self.flight_recorder.record(
+                        'retry_exhausted', req=req.seq,
+                        attempts=req.handle.retries + 1,
+                        error=type(req.last_error).__name__)
             elif req.handle._requeue(req.claim_token):
                 self._retries += 1
                 profiling.counter_inc('serve.retries')
                 delay = policy.delay_s(req.handle.retries - 1)
+                self.flight_recorder.record(
+                    'retry', req=req.seq, attempt=req.handle.retries,
+                    delay_ms=round(delay * 1e3, 3),
+                    error=type(exc).__name__)
+                ctx = req.handle._trace
+                if ctx is not None:
+                    ctx.instant('retry', attempt=req.handle.retries,
+                                backoff_ms=round(delay * 1e3, 3),
+                                error=type(exc).__name__)
+                    ctx.instant('park', reason='retry-backoff')
                 self._parked.append((now + delay, key, req))
 
     def _run_batch(self, ex: _DeviceExecutor, key, batch, cfg):
@@ -1196,6 +1393,10 @@ class ExecutionService:
             cold = self._classify_compile(
                 ex, key, ('solo', eng, req.n_shots,
                           req.init_regs is None))
+            if self._tracer.enabled:
+                self._trace_dispatch(batch, ex, key.label(),
+                                     'cold' if cold else 'warm', eng,
+                                     1)
             t0 = time.monotonic()
             out = simulate_batch(req.mp, req.meas_bits, req.init_regs,
                                  cfg=scfg, jax_device=ex.device)
@@ -1227,10 +1428,17 @@ class ExecutionService:
                                       ('multi', P, B, init is None))
         # the catalog stores the EXACT executable identity: the
         # stacked batch's trait union, not any one member's traits
-        self._record_catalog(
-            replace(key, traits=program_traits(mmp)).bind(
-                n_programs=P, n_shots=B,
-                has_init_regs=init is not None))
+        bspec = replace(key, traits=program_traits(mmp)).bind(
+            n_programs=P, n_shots=B, has_init_regs=init is not None)
+        self._record_catalog(bspec)
+        if self._tracer.enabled:
+            # three-way dispatch classification: a precompiled AOT
+            # executable beats the cold/warm jit split (the lookup the
+            # interpreter itself makes on dispatch)
+            klass = 'aot' if aot_batch_cached(bspec, ex.device) \
+                else ('cold' if cold else 'warm')
+            self._trace_dispatch(batch, ex, bspec.label(), klass,
+                                 'generic', P)
         t0 = time.monotonic()
         out = simulate_multi_batch(mmp, meas, init, cfg=cfg,
                                    jax_device=ex.device)
@@ -1428,7 +1636,7 @@ class ExecutionService:
         depth, occupancy, steals, compile hits) for the multi-device
         pool."""
         with self._cv:
-            lat = np.asarray(self._latency_s, np.float64)
+            lat = np.asarray(self._latency_h.values(), np.float64)
             occ = dict(sorted(self._occupancy.items()))
             devices = [{
                 'device': ex.label(),
@@ -1523,12 +1731,53 @@ class ExecutionService:
         # first submit_source/compile_cache touch
         snap['compile_cache'] = None if cache is None else cache.stats()
         if lat.size:
-            snap['latency_p50_ms'] = float(np.percentile(lat, 50) * 1e3)
-            snap['latency_p99_ms'] = float(np.percentile(lat, 99) * 1e3)
+            # the histogram window holds ms already (obs.metrics);
+            # same exact-percentile math the old seconds deque used
+            snap['latency_p50_ms'] = float(np.percentile(lat, 50))
+            snap['latency_p99_ms'] = float(np.percentile(lat, 99))
         else:
             snap['latency_p50_ms'] = snap['latency_p99_ms'] = 0.0
         snap['latency_samples'] = int(lat.size)
+        # mirror the load-shaped readings into the registry as gauges
+        # (per-service names: a process may run several services)
+        reg = profiling.registry()
+        reg.set_gauge(f'serve.{self.name}.queue_depth',
+                      snap['queue_depth'])
+        reg.set_gauge(f'serve.{self.name}.parked', snap['parked'])
         return snap
+
+    # -- observability export (docs/OBSERVABILITY.md) --------------------
+
+    def dump_trace(self, path: str) -> int:
+        """Export every retained sampled request trace as Chrome Trace
+        Event JSON — loadable in Perfetto / ``chrome://tracing``, and
+        summarized per stage by ``cli trace-view``
+        (tools/traceview.py).  Returns the event count written."""
+        return write_chrome_trace(path, self._tracer.contexts(),
+                                  pid=self.name)
+
+    def dump_flight(self, path: str = None) -> str | None:
+        """Write the flight-recorder ring to ``path``.  With no path,
+        falls back to ``flight_dump_dir`` (or ``$DPROC_FLIGHT_DIR``),
+        writing ``flight-<service>.json`` there; returns the written
+        path, or None when no destination is configured."""
+        if path is None:
+            d = self._flight_dump_dir \
+                or os.environ.get('DPROC_FLIGHT_DIR')
+            if not d:
+                return None
+            path = os.path.join(d, f'flight-{self.name}.json')
+        self.flight_recorder.dump(path)
+        return path
+
+    def _dump_flight_auto(self) -> None:
+        """Supervisor-detected failure: capture the evidence now,
+        best-effort — observability I/O must never take supervision
+        down with it."""
+        try:
+            self.dump_flight()
+        except OSError:
+            pass
 
     def shutdown(self, drain: bool = True, timeout: float = None):
         """Stop the service.  ``drain=True`` (default) flushes every

@@ -124,3 +124,12 @@ class CircuitBreaker:
         self.consecutive = 0
         self.cooldown_until = None
         self._next_cooldown_s = self.base_cooldown_s
+
+    def snapshot(self) -> dict:
+        """JSON-able breaker state — the payload the service attaches
+        to ``breaker_trip`` flight-recorder events
+        (docs/OBSERVABILITY.md)."""
+        return {'trips': self.trips,
+                'consecutive': self.consecutive,
+                'readmissions': self.readmissions,
+                'next_cooldown_s': self._next_cooldown_s}

@@ -2780,6 +2780,28 @@ def _aot_lookup(P, B, C, N, E, max_meas, cfg, traits, device):
             _aot_cache_key(P, B, C, N, E, max_meas, cfg, traits, device))
 
 
+def aot_batch_cached(spec, jax_device=None) -> bool:
+    """Dispatch-classification hook: would a multi-batch dispatch of
+    this bound spec hit a precompiled AOT executable on ``jax_device``
+    right now?  Pure lookup — compiles nothing, never raises on an
+    unbound or non-generic spec (returns False).  The serving tier's
+    request tracing uses this to label dispatch spans cold / warm /
+    aot (docs/OBSERVABILITY.md)."""
+    P, B = spec.n_programs, spec.n_shots
+    if P is None or B is None:
+        return False
+    cfg = spec.cfg
+    if cfg.straightline or cfg.engine in ('straightline', 'block',
+                                          'pallas'):
+        return False
+    if cfg.straightline is None or cfg.engine is not None:
+        cfg = replace(cfg, straightline=False, engine=None)
+    cfg, _ = _fault_policy(cfg)
+    return _aot_lookup(P, B, spec.n_cores, spec.n_instr_bucket,
+                       spec.max_elems, cfg.max_meas, cfg, spec.traits,
+                       jax_device) is not None
+
+
 def aot_cache_size() -> int:
     with _AOT_LOCK:
         return len(_AOT_CACHE)

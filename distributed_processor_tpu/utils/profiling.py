@@ -8,7 +8,6 @@ instruction trace and (b) the JAX/XLA device profiler wrapped below.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 
 import numpy as np
@@ -20,8 +19,9 @@ import jax
 # named counters: one process-wide registry for trace/step probes
 # ---------------------------------------------------------------------------
 # The interpreter's retrace probes (multi_trace_count / span_trace_count /
-# block_trace_count) were separate module globals; they now share this
-# registry so tests and bench rows can snapshot every probe uniformly.
+# block_trace_count) were separate module globals; they now live in the
+# typed metrics registry (obs/metrics.py) so tests and bench rows can
+# snapshot every probe uniformly and export the lot as Prometheus text.
 # Counters are ints incremented at Python (trace) time — NOT inside traced
 # code — so they count host events (jit cache misses, dispatches), which
 # is exactly what the retrace-contract tests assert on.
@@ -30,28 +30,49 @@ import jax
 # from its dispatcher thread while submitters read snapshots, and a bare
 # dict read-modify-write would drop increments under that interleaving
 # (and let trace-count asserts misfire on torn snapshots).
+#
+# These functions are the stable facade — every pre-existing counter name
+# (`serve.*`, `aot_*`, `*_trace`) keeps working unchanged; gauges and
+# histograms are reached through `registry()`.
 
-_COUNTERS: dict = {}
-_COUNTERS_LOCK = threading.Lock()
+from ..obs.metrics import default_registry as _default_registry
+
+
+def registry():
+    """The process-wide typed metrics registry backing these counters."""
+    return _default_registry()
 
 
 def counter_inc(name: str, amount: int = 1) -> int:
     """Increment (and return) the named counter."""
-    with _COUNTERS_LOCK:
-        _COUNTERS[name] = _COUNTERS.get(name, 0) + amount
-        return _COUNTERS[name]
+    return _default_registry().inc(name, amount)
 
 
 def counter_get(name: str) -> int:
     """Current value of the named counter (0 if never incremented)."""
-    with _COUNTERS_LOCK:
-        return _COUNTERS.get(name, 0)
+    return _default_registry().get(name)
 
 
 def counters() -> dict:
     """Consistent snapshot of every named counter."""
-    with _COUNTERS_LOCK:
-        return dict(_COUNTERS)
+    return _default_registry().counters()
+
+
+def registry_snapshot() -> dict:
+    """Deep snapshot of the whole registry (counters + gauges +
+    histograms) — pair with :func:`registry_restore` to isolate
+    counter-asserting tests from execution order."""
+    return _default_registry().snapshot()
+
+
+def registry_restore(snap: dict) -> None:
+    """Restore a :func:`registry_snapshot`."""
+    return _default_registry().restore(snap)
+
+
+def prometheus_text() -> str:
+    """Prometheus text-format exposition of every registered metric."""
+    return _default_registry().prometheus_text()
 
 
 @contextlib.contextmanager

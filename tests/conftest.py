@@ -75,6 +75,20 @@ def _serve_thread_leak_probe():
         print(f'SERVICE THREAD LEAK: {leaked}')
 
 
+@pytest.fixture(autouse=True)
+def _profiling_counter_isolation():
+    """Snapshot/restore the process-wide metrics registry around every
+    test: counters, gauges and histograms a test bumps (serve.* /
+    compilecache.* / interpreter trace counters all live there now —
+    utils/profiling.py fronts obs/metrics.py) never leak into another
+    test's assertions, and tests may assert exact counter deltas
+    without caring what ran before them."""
+    from distributed_processor_tpu.utils import profiling
+    snap = profiling.registry_snapshot()
+    yield
+    profiling.registry_restore(snap)
+
+
 @pytest.fixture(autouse=True, scope='module')
 def _clear_jax_caches_between_modules():
     """Free compiled executables between test FILES.

@@ -253,6 +253,63 @@ def test_catalog_tolerates_corruption(tmp_path):
     assert len(BucketCatalog(str(path))) == 0
 
 
+def test_catalog_aging_and_cap(tmp_path):
+    """Unbounded growth is the catalog's failure mode: a retired
+    workload's buckets would be AOT-recompiled at every startup
+    forever.  Specs not re-observed within ``max_age_runs``
+    :meth:`begin_run` generations are pruned; ``max_specs`` caps the
+    size with least-recently-seen eviction; files written before the
+    aging change still load."""
+    mps = _ensemble(2, 2, 1, seed=9)
+    cfg = _cfg_for(mps)
+    ncfg, _ = _normalize_cfg(cfg, isa.shape_bucket(mps[0].n_instr))
+    tmpl = bucket_key(mps[0], ncfg)
+
+    def spec(p):
+        return tmpl.bind(n_programs=p, n_shots=4)
+
+    path = str(tmp_path / 'cat.json')
+
+    def reopen():
+        return BucketCatalog(path, max_specs=8, max_age_runs=2)
+
+    # generation 1: two specs recorded
+    cat = reopen()
+    cat.begin_run()
+    assert cat.record(spec(1)) and cat.record(spec(2))
+    assert not cat.record(spec(1))    # dup refreshes, doesn't re-add
+    assert len(cat) == 2
+
+    # generations 2-4: only spec(1) re-observed each run; spec(2)'s
+    # last-seen falls beyond the 2-run horizon and is pruned
+    for _ in range(3):
+        cat = reopen()
+        cat.begin_run()
+        cat.record(spec(1))
+    live = reopen().begin_run()
+    idents = {s.identity() for s in live}
+    assert spec(1).identity() in idents
+    assert spec(2).identity() not in idents
+
+    # size cap: least-recently-seen evicted first, the newest survives
+    capped = BucketCatalog(str(tmp_path / 'cap.json'), max_specs=2)
+    capped.begin_run()
+    for p in (1, 2, 4):
+        capped.record(spec(p))
+    kept = {s.identity() for s in capped.load()}
+    assert len(kept) == 2 and spec(4).identity() in kept
+
+    # a pre-aging v1 file (no runs/last_seen keys) still loads, and a
+    # post-aging file is still read by a plain no-limit catalog
+    doc = json.load(open(path))
+    assert doc['version'] == 1 and 'runs' in doc and 'last_seen' in doc
+    doc.pop('runs'), doc.pop('last_seen')
+    old = str(tmp_path / 'old.json')
+    json.dump(doc, open(old, 'w'))
+    assert {s.identity() for s in BucketCatalog(old).load()} == idents
+    assert {s.identity() for s in BucketCatalog(path).load()} == idents
+
+
 # ---------------------------------------------------------------------------
 # liveness: replay never blocks admission
 # ---------------------------------------------------------------------------

@@ -1,0 +1,521 @@
+"""Flight-deck observability (obs/): the contract.
+
+Three load-bearing properties, per docs/OBSERVABILITY.md:
+
+* **Tracing is complete and honest** — a sampled request's span chain
+  connects submit -> queued -> coalesce.ripen -> dispatch -> execute ->
+  demux -> done, retry/steal/migration hops included under seeded
+  chaos; the Chrome-trace export is schema-valid; sampling 0 produces
+  ZERO spans (the off path is the default and must stay free).
+* **The metrics registry is exact** — counters/gauges/histograms round
+  through snapshot/restore unchanged, histogram percentiles match
+  numpy over the raw window, and the Prometheus exposition is parseable.
+* **Telemetry names are frozen** — every pre-existing ``stats()`` key
+  and ``serve.*`` counter name is pinned by a literal manifest here;
+  renaming one breaks dashboards, so it must break this test first.
+
+The flight recorder's ring/dump mechanics are covered here too; its
+integration (breaker trips, chaos injections landing in the ring) rides
+the chaos span test.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.decoder import machine_program_from_cmds
+from distributed_processor_tpu.obs import (DEFAULT_BUCKETS,
+                                           FlightRecorder, Histogram,
+                                           MetricsRegistry, STAGE_ORDER,
+                                           Tracer, chrome_trace_events,
+                                           write_chrome_trace)
+from distributed_processor_tpu.serve import (ChaosMonkey, ChaosPlan,
+                                             ExecutionService,
+                                             RetryPolicy)
+from distributed_processor_tpu.serve.service import _normalize_cfg
+from distributed_processor_tpu.sim.interpreter import (InterpreterConfig,
+                                                       simulate_batch)
+from distributed_processor_tpu.utils import profiling
+
+pytestmark = [pytest.mark.obs, pytest.mark.serve]
+
+_N_DEV = len(jax.devices())
+
+
+def _mp(salt=0):
+    core = [isa.pulse_cmd(amp_word=1000 + 7 * salt + 13 * i, cfg_word=0,
+                          env_word=3, cmd_time=10 + 20 * i)
+            for i in range(3)] + [isa.done_cmd()]
+    return machine_program_from_cmds([core])
+
+
+_CFG = InterpreterConfig(max_steps=2 * 8 + 64, max_pulses=8 + 2,
+                         max_meas=2, max_resets=2)
+
+
+def _bits(rng, shots=3):
+    return rng.integers(0, 2, size=(shots, 1, 2)).astype(np.int32)
+
+
+def _solo(mp, bits):
+    ncfg, _ = _normalize_cfg(_CFG, isa.shape_bucket(mp.n_instr))
+    return jax.tree.map(np.asarray, simulate_batch(mp, bits, cfg=ncfg))
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counters_gauges_histograms():
+    reg = MetricsRegistry()
+    assert reg.inc('a.b') == 1
+    assert reg.inc('a.b', 4) == 5
+    assert reg.get('a.b') == 5
+    assert reg.get('missing') == 0
+    reg.set_gauge('depth', 7)
+    assert reg.gauge('depth') == 7
+    assert reg.gauge('nope', default=-1.0) == -1.0
+    h = reg.histogram('lat_ms')
+    for v in (1.0, 2.0, 3.0, 100.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(106.0)
+    # get-or-create returns the same object
+    assert reg.histogram('lat_ms') is h
+    assert reg.counters()['a.b'] == 5
+
+
+def test_histogram_percentiles_match_numpy():
+    h = Histogram('x', window=512)
+    rng = np.random.default_rng(3)
+    vals = rng.exponential(10.0, size=300)
+    for v in vals:
+        h.observe(float(v))
+    for p in (50, 90, 99):
+        assert h.percentile(p) == pytest.approx(
+            float(np.percentile(np.asarray(h.values()), p)))
+    assert Histogram('empty').percentile(50) is None
+
+
+def test_histogram_window_is_bounded():
+    h = Histogram('x', window=16)
+    for v in range(100):
+        h.observe(float(v))
+    assert len(h.values()) == 16       # raw window bounded...
+    assert h.count == 100              # ...cumulative counts are not
+
+
+def test_registry_snapshot_restore_roundtrip():
+    reg = MetricsRegistry()
+    reg.inc('c', 3)
+    reg.set_gauge('g', 1.5)
+    reg.observe('h', 12.0)
+    snap = reg.snapshot()
+    reg.inc('c', 10)
+    reg.inc('new', 1)
+    reg.set_gauge('g', 9.0)
+    reg.observe('h', 99.0)
+    reg.restore(snap)
+    assert reg.get('c') == 3
+    assert reg.get('new') == 0
+    assert reg.gauge('g') == 1.5
+    assert reg.histogram('h').count == 1
+    assert reg.histogram('h').values() == [12.0]
+
+
+def test_prometheus_text_exposition():
+    reg = MetricsRegistry()
+    reg.inc('serve.submitted', 2)
+    reg.set_gauge('serve.svc0.queue_depth', 3)
+    reg.observe('serve.latency_ms', 1.7)
+    text = reg.prometheus_text()
+    assert '# TYPE serve_submitted counter' in text
+    assert 'serve_submitted 2' in text
+    assert '# TYPE serve_svc0_queue_depth gauge' in text
+    assert '# TYPE serve_latency_ms histogram' in text
+    # cumulative buckets: every boundary present plus +Inf, and the
+    # 1.7 observation lands in every le >= 2.5 bucket
+    assert 'serve_latency_ms_bucket{le="+Inf"} 1' in text
+    assert f'serve_latency_ms_bucket{{le="{DEFAULT_BUCKETS[0]}"}} 0' \
+        in text
+    assert 'serve_latency_ms_count 1' in text
+
+
+def test_profiling_facade_delegates_to_registry():
+    profiling.counter_inc('obs.test.facade', 2)
+    assert profiling.counter_get('obs.test.facade') == 2
+    assert profiling.counters()['obs.test.facade'] == 2
+    assert profiling.registry().get('obs.test.facade') == 2
+    assert 'obs_test_facade 2' in profiling.prometheus_text()
+    # the conftest autouse fixture restores around every test; verify
+    # the snapshot API it uses round-trips
+    snap = profiling.registry_snapshot()
+    profiling.counter_inc('obs.test.facade', 100)
+    profiling.registry_restore(snap)
+    assert profiling.counter_get('obs.test.facade') == 2
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+
+    def worker():
+        for _ in range(500):
+            reg.inc('n')
+            reg.observe('h', 1.0)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.get('n') == 4000
+    assert reg.histogram('h').count == 4000
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record('retry', seq=i)
+    rec.record('breaker_trip', executor='cpu:0')
+    assert rec.recorded == 11
+    events = rec.events()
+    assert len(events) == 4                      # ring keeps the tail
+    assert events[-1]['kind'] == 'breaker_trip'
+    assert events[-1]['executor'] == 'cpu:0'
+    assert [e['seq'] for e in events[:-1]] == [7, 8, 9]
+    assert rec.events(kind='breaker_trip') == [events[-1]]
+    assert rec.counts() == {'retry': 3, 'breaker_trip': 1}
+    p = tmp_path / 'flight.json'
+    rec.dump(str(p))
+    doc = json.loads(p.read_text())
+    assert doc['capacity'] == 4
+    assert doc['recorded'] == 11
+    assert doc['counts'] == {'retry': 3, 'breaker_trip': 1}
+    assert [e['kind'] for e in doc['events']] \
+        == ['retry', 'retry', 'retry', 'breaker_trip']
+    # monotonic sequence numbers survive the ring
+    seqs = [e['seq'] for e in doc['events']]
+    assert seqs == sorted(seqs)
+
+
+# ---------------------------------------------------------------------------
+# tracer unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_sampling_zero_allocates_nothing():
+    t = Tracer(0.0)
+    assert not t.enabled
+    assert all(t.maybe_start() is None for _ in range(100))
+    assert t.contexts() == []
+
+
+def test_tracer_sampling_fraction_is_deterministic():
+    t = Tracer(0.25, keep=100)
+    got = [t.maybe_start() for _ in range(100)]
+    assert sum(1 for c in got if c is not None) == 25
+    t2 = Tracer(0.25, keep=100)
+    got2 = [t2.maybe_start() for _ in range(100)]
+    assert [c is None for c in got] == [c is None for c in got2]
+
+
+def test_chrome_trace_event_shape(tmp_path):
+    t = Tracer(1.0)
+    ctx = t.maybe_start()
+    t0 = 100.0
+    ctx.instant('submit', t=t0, seq=0)
+    ctx.span('queued', t0, t0 + 0.5, bucket='b')
+    ctx.span('execute', t0 + 0.5, t0 + 0.7, device='cpu:0')
+    ctx.instant('done', t=t0 + 0.7, outcome='ok')
+    events = chrome_trace_events(t.contexts(), pid='svc')
+    assert len(events) == 4
+    for e in events:
+        assert e['pid'] == 'svc'
+        assert e['tid'] == f'req-{ctx.trace_id}'
+        assert e['ts'] >= 0
+        assert e['ph'] in ('X', 'i')
+    x = [e for e in events if e['ph'] == 'X']
+    assert [e['name'] for e in x] == ['queued', 'execute']
+    assert x[0]['dur'] == pytest.approx(0.5e6)   # seconds -> us
+    assert x[0]['args'] == {'bucket': 'b'}
+    p = tmp_path / 'trace.json'
+    n = write_chrome_trace(str(p), t.contexts())
+    doc = json.loads(p.read_text())
+    assert set(doc) == {'traceEvents', 'displayTimeUnit'}
+    assert doc['displayTimeUnit'] == 'ms'
+    assert len(doc['traceEvents']) == n == 4
+
+
+# ---------------------------------------------------------------------------
+# end-to-end tracing through the service
+# ---------------------------------------------------------------------------
+
+
+def test_service_trace_off_by_default():
+    rng = np.random.default_rng(0)
+    with ExecutionService(_CFG, max_batch_programs=4,
+                          max_wait_ms=2.0) as svc:
+        h = svc.submit(_mp(), _bits(rng))
+        h.result(timeout=60)
+        assert h.trace() is None
+        assert svc._tracer.maybe_start() is None
+        assert svc.dump_trace(os.devnull) == 0
+
+
+def test_service_trace_full_chain(tmp_path):
+    rng = np.random.default_rng(1)
+    mps = [_mp(s) for s in range(3)]
+    with ExecutionService(_CFG, max_batch_programs=4, max_wait_ms=2.0,
+                          trace_sample=1.0) as svc:
+        handles = [svc.submit(mp, _bits(rng)) for mp in mps]
+        for mp, h in zip(mps, handles):
+            h.result(timeout=60)
+        for h in handles:
+            spans = h.trace()
+            assert spans is not None
+            names = [s['name'] for s in spans]
+            for need in ('submit', 'queued', 'coalesce.ripen',
+                         'dispatch', 'execute', 'demux', 'done'):
+                assert need in names, f'missing {need!r} in {names}'
+            # the duration chain is connected and ordered: each stage
+            # starts no earlier than the previous stage's start, and
+            # queued -> dispatch -> execute ends are monotonic
+            by = {s['name']: s for s in spans}
+            assert by['queued']['t0'] <= by['queued']['t1'] \
+                <= by['dispatch']['t1'] <= by['execute']['t1'] \
+                <= by['demux']['t1']
+            assert by['dispatch']['args']['classification'] \
+                in ('cold', 'warm', 'aot')
+            done = [s for s in spans if s['name'] == 'done']
+            assert done[-1]['args']['outcome'] == 'ok'
+        p = tmp_path / 'trace.json'
+        n = svc.dump_trace(str(p))
+    doc = json.loads(p.read_text())
+    evs = doc['traceEvents']
+    assert len(evs) == n > 0
+    assert {e['ph'] for e in evs} <= {'X', 'i'}
+    # one tid per request, all three requests present
+    assert len({e['tid'] for e in evs}) == 3
+    # stage names are drawn from the documented taxonomy
+    assert {e['name'] for e in evs if e['ph'] == 'X'} \
+        <= set(STAGE_ORDER)
+
+
+def test_service_trace_sampling_fraction():
+    rng = np.random.default_rng(2)
+    with ExecutionService(_CFG, max_batch_programs=8, max_wait_ms=2.0,
+                          trace_sample=0.5) as svc:
+        handles = [svc.submit(_mp(s % 3), _bits(rng))
+                   for s in range(8)]
+        for h in handles:
+            h.result(timeout=60)
+        traced = [h for h in handles if h.trace() is not None]
+        assert len(traced) == 4        # deterministic 1-in-2
+
+
+@pytest.mark.chaos
+@pytest.mark.skipif(
+    _N_DEV < 2,
+    reason=f'multi-hop trace test needs >=2 devices (host advertises '
+           f'{_N_DEV} device(s); off-TPU force more with '
+           f'--xla_force_host_platform_device_count)')
+def test_trace_multi_hop_retry_chain_under_chaos(tmp_path):
+    """Scripted crashes trip a breaker on a dp=2 pool while every
+    request is traced: some retried/migrated request must show the
+    full multi-hop chain — retry instants, >= 2 queued spans (one per
+    attempt), a migrate or unpark hop — and the breaker trip + chaos
+    injections must land in the flight recorder, with the whole chain
+    visible in the exported Chrome trace."""
+    mps = [_mp(s) for s in range(4)]
+    plan = ChaosPlan(seed=7, script=('crash',) * 4)
+    with ExecutionService(
+            _CFG, max_batch_programs=4, max_wait_ms=2.0,
+            max_queue=1024, devices=2,
+            retry_policy=RetryPolicy(max_attempts=6, backoff_s=0.005),
+            breaker_threshold=2, breaker_cooldown_ms=60.0,
+            supervise_interval_ms=10.0, trace_sample=1.0,
+            trace_keep=256) as svc:
+        for n_programs in (1, 2, 4):
+            svc.warmup(mps[0], shots=3, n_programs=n_programs)
+        rng = np.random.default_rng(7)
+        with ChaosMonkey(svc, plan) as monkey:
+            pairs = [(mps[i % 4], _bits(rng)) for i in range(24)]
+            handles = [svc.submit(mp, b) for mp, b in pairs]
+            for (mp, b), h in zip(pairs, handles):
+                got = h.result(timeout=120)
+                want = _solo(mp, b)
+                for k in want:
+                    np.testing.assert_array_equal(
+                        np.asarray(got[k]), np.asarray(want[k]))
+        assert monkey.script_exhausted()
+        retried = [h for h in handles if h.retries >= 1]
+        assert retried, 'scripted crashes produced no retried request'
+        chains = 0
+        for h in retried:
+            spans = h.trace()
+            names = [s['name'] for s in spans]
+            if 'retry' not in names:
+                continue       # retried as an uninvolved batch-mate
+            assert names.count('queued') >= 2, \
+                f'retried request missing per-attempt queued spans: ' \
+                f'{names}'
+            assert 'batch_error' in names
+            assert 'chaos' in names
+            done = [s for s in spans if s['name'] == 'done']
+            assert len(done) == 1 and done[0]['args']['outcome'] == 'ok'
+            chains += 1
+        assert chains >= 1
+        # the incident is in the flight recorder, in event order
+        kinds = [e['kind'] for e in svc.flight_recorder.events()]
+        assert 'chaos_inject' in kinds
+        assert 'retry' in kinds
+        assert 'breaker_trip' in kinds
+        trip = svc.flight_recorder.events(kind='breaker_trip')[0]
+        assert set(trip) >= {'seq', 't', 'mono', 'kind', 'executor',
+                             'breaker'}
+        assert trip['breaker']['trips'] >= 1
+        # chaos injection precedes the breaker trip it caused
+        assert kinds.index('chaos_inject') < kinds.index('breaker_trip')
+        p = tmp_path / 'chaos-trace.json'
+        n = svc.dump_trace(str(p))
+    doc = json.loads(p.read_text())
+    names = {e['name'] for e in doc['traceEvents']}
+    assert {'retry', 'queued', 'chaos', 'execute', 'done'} <= names
+    assert n == len(doc['traceEvents'])
+
+
+def test_flight_auto_dump_on_executor_death(tmp_path):
+    """An injected dispatcher death makes the supervisor dump the
+    flight ring into flight_dump_dir — the post-mortem exists without
+    anyone asking for it."""
+    plan = ChaosPlan(seed=0, script=('die',))
+    rng = np.random.default_rng(0)
+    with ExecutionService(
+            _CFG, max_batch_programs=4, max_wait_ms=2.0,
+            retry_policy=RetryPolicy(max_attempts=6, backoff_s=0.005),
+            supervise_interval_ms=10.0,
+            flight_dump_dir=str(tmp_path)) as svc:
+        svc.warmup(_mp(), shots=3, n_programs=1)
+        with ChaosMonkey(svc, plan):
+            h = svc.submit(_mp(), _bits(rng))
+            h.result(timeout=120)
+        deadline = time.monotonic() + 30.0
+        dump = tmp_path / f'flight-{svc.name}.json'
+        while not dump.exists() and time.monotonic() < deadline:
+            time.sleep(0.02)
+    assert dump.exists(), 'supervisor did not auto-dump the flight ring'
+    doc = json.loads(dump.read_text())
+    assert 'executor_death' in doc['counts']
+
+
+# ---------------------------------------------------------------------------
+# frozen telemetry manifests
+# ---------------------------------------------------------------------------
+
+# every pre-existing stats() key, frozen: renaming one breaks dashboards
+_STATS_KEYS = {
+    'queue_depth', 'submitted', 'completed', 'failed', 'cancelled',
+    'expired', 'rejected', 'dispatches', 'programs_dispatched',
+    'batch_occupancy', 'engine_dispatches', 'coalesce_efficiency',
+    'n_devices', 'work_stealing', 'steals', 'warmups', 'warmup',
+    'supervision', 'health', 'parked', 'retries', 'retry_exhausted',
+    'shed', 'overload_rejected', 'breaker_trips', 'readmissions',
+    'executor_deaths', 'hangs', 'canary', 'est_wait_ms', 'compile',
+    'source', 'devices', 'compile_cache', 'latency_p50_ms',
+    'latency_p99_ms', 'latency_samples',
+}
+_WARMUP_KEYS = {'aot_compiled', 'replayed', 'in_progress'}
+_HEALTH_KEYS = {'live', 'quarantined', 'probing'}
+_CANARY_KEYS = {'ok', 'fail'}
+_COMPILE_KEYS = {'cold', 'warm', 'per_bucket'}
+_SOURCE_KEYS = {'submitted', 'pending_compile'}
+_DEVICE_KEYS = {
+    'device', 'index', 'busy', 'health', 'queue_depth', 'dispatches',
+    'programs_dispatched', 'batch_occupancy', 'engine_dispatches',
+    'steals', 'stolen_from', 'cold_compiles', 'warm_hits',
+    'home_buckets', 'breaker_trips', 'consecutive_failures',
+    'readmissions', 'hangs', 'deaths', 'respawns', 'canary_ok',
+    'canary_fail',
+}
+# serve.* counters the service maintains in the global registry
+_SERVE_COUNTERS = {
+    'serve.submitted', 'serve.dispatches',
+    'serve.programs_dispatched', 'serve.compile.cold',
+    'serve.compile.warm',
+}
+
+
+def test_stats_key_manifest_is_byte_compatible():
+    rng = np.random.default_rng(5)
+    with ExecutionService(_CFG, max_batch_programs=4,
+                          max_wait_ms=2.0) as svc:
+        handles = [svc.submit(_mp(s), _bits(rng)) for s in range(3)]
+        for h in handles:
+            h.result(timeout=60)
+        snap = svc.stats()
+    assert set(snap) == _STATS_KEYS
+    assert set(snap['warmup']) == _WARMUP_KEYS
+    assert set(snap['health']) == _HEALTH_KEYS
+    assert set(snap['canary']) == _CANARY_KEYS
+    assert set(snap['compile']) == _COMPILE_KEYS
+    assert set(snap['source']) == _SOURCE_KEYS
+    for dev in snap['devices']:
+        assert set(dev) == _DEVICE_KEYS
+    for label, row in snap['compile']['per_bucket'].items():
+        assert set(row) == {'cold', 'warm', 'cold_ms_mean',
+                            'warm_ms_mean', 'compile_ms_est'}
+    assert snap['latency_samples'] == 3
+
+
+def test_serve_counter_names_preserved():
+    rng = np.random.default_rng(6)
+    before = {k: profiling.counter_get(k) for k in _SERVE_COUNTERS}
+    with ExecutionService(_CFG, max_batch_programs=4,
+                          max_wait_ms=2.0) as svc:
+        h = svc.submit(_mp(), _bits(rng))
+        h.result(timeout=60)
+        # second same-shape round hits the warm jit cache
+        h2 = svc.submit(_mp(), _bits(rng))
+        h2.result(timeout=60)
+    after = profiling.counters()
+    for name in _SERVE_COUNTERS:
+        assert after.get(name, 0) > before[name], \
+            f'counter {name!r} did not advance under a served request'
+    # the service's latency histogram also feeds the fleet-wide one
+    assert profiling.registry().histogram('serve.latency_ms').count >= 1
+
+
+def test_compile_cache_counters_on_registry():
+    from distributed_processor_tpu.compilecache import CompileCache
+    from distributed_processor_tpu.models import make_default_qchip
+
+    qchip = make_default_qchip(2)
+    prog = [{'name': 'X90', 'qubit': ['Q0']}]
+    cache = CompileCache(capacity=8)
+    cache.get_or_compile(prog, qchip, n_qubits=2)
+    cache.get_or_compile(prog, qchip, n_qubits=2)
+    assert profiling.counter_get('compilecache.misses') == 1
+    assert profiling.counter_get('compilecache.hits') == 1
+    assert profiling.registry().histogram(
+        'compilecache.compile_ms').count == 1
+    # cache_invalidate lands in an attached flight recorder
+    rec = FlightRecorder()
+    cache.recorder = rec
+    st = cache.stats()
+    cache.invalidate_epoch('nonexistent-fp')
+    ev = rec.events(kind='cache_invalidate')
+    assert len(ev) == 1 and ev[0]['entries'] == 0
+    assert cache.stats()['invalidations'] == st['invalidations'] + 1

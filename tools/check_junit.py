@@ -62,6 +62,15 @@ MULTIDEV_OK_SKIP = 'host advertises 1 device'
 CHAOS_MODULE = 'test_serve_chaos'
 CHAOS_OK_SKIP = 'host advertises 1 device'
 
+# the observability suite (request tracing, metrics registry, flight
+# recorder — docs/OBSERVABILITY.md) is pure CPU except the multi-hop
+# chaos-trace test, which may skip only on a genuinely single-device
+# host; anything else means the telemetry contract (frozen stats()
+# manifest, span completeness, sampling-off cost) stopped being
+# exercised
+OBS_MODULE = 'test_obs'
+OBS_OK_SKIP = 'host advertises 1 device'
+
 
 def _is_fault_test(tc) -> bool:
     ident = f'{tc.get("classname", "")}.{tc.get("name", "")}'.lower()
@@ -82,7 +91,7 @@ def main(path: str) -> int:
         print('FAILURE: no tests ran')
         return 1
     leaks, thread_leaks, bad_skips, dev_skips = [], [], [], []
-    chaos_skips = []
+    chaos_skips, obs_skips = [], []
     for tc in root.iter('testcase'):
         ident = f'{tc.get("classname")}.{tc.get("name")}'
         skipped = tc.find('skipped')
@@ -103,6 +112,12 @@ def main(path: str) -> int:
                 (skipped.text or '')
             if CHAOS_OK_SKIP not in reason:
                 chaos_skips.append(ident)
+        if skipped is not None \
+                and OBS_MODULE in tc.get('classname', ''):
+            reason = (skipped.get('message') or '') + \
+                (skipped.text or '')
+            if OBS_OK_SKIP not in reason:
+                obs_skips.append(ident)
         for out in (tc.findall('system-out') + tc.findall('system-err')):
             if not out.text:
                 continue
@@ -135,7 +150,14 @@ def main(path: str) -> int:
                   f'self-healing failure paths (retry/breaker/canary) '
                   f'stopped being exercised (see docs/ROBUSTNESS.md '
                   f'"serving-layer failures")')
-    if leaks or thread_leaks or bad_skips or dev_skips or chaos_skips:
+    if obs_skips:
+        for name in obs_skips:
+            print(f'BAD SKIP: {name}: observability tests skipped — '
+                  f'the tracing/metrics/flight-recorder contract '
+                  f'stopped being exercised (see '
+                  f'docs/OBSERVABILITY.md)')
+    if leaks or thread_leaks or bad_skips or dev_skips or chaos_skips \
+            or obs_skips:
         return 1
     print(f'junit OK: {n_tests} tests, no failures, no fault leaks, '
           f'no leaked service threads, no gated skips')

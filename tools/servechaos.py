@@ -65,6 +65,13 @@ def main(argv=None) -> int:
                     help='injected hang duration (past the watchdog)')
     ap.add_argument('--json', action='store_true',
                     help='emit the report as JSON on stdout')
+    ap.add_argument('--flight-out', default=None, metavar='PATH',
+                    help='dump the full flight-recorder ring to PATH '
+                         '(the exit report always carries counts + the '
+                         'event tail)')
+    ap.add_argument('--trace-out', default=None, metavar='PATH',
+                    help='trace every request (sample=1.0) and export '
+                         'the Chrome-trace JSON to PATH')
     args = ap.parse_args(argv)
 
     from distributed_processor_tpu.serve import (ChaosMonkey, ChaosPlan,
@@ -88,12 +95,19 @@ def main(argv=None) -> int:
             retry_policy=RetryPolicy(max_attempts=6, backoff_s=0.01),
             hang_timeout_s=0.4, breaker_threshold=3,
             breaker_cooldown_ms=100.0,
-            supervise_interval_ms=10.0) as svc:
+            supervise_interval_ms=10.0,
+            trace_sample=1.0 if args.trace_out else 0.0,
+            trace_keep=4 * n) as svc:
         with ChaosMonkey(svc, plan) as monkey:
             report = soak(svc, mps, cfg, n_requests=n,
                           shots=args.shots, seed=args.seed,
                           result_timeout_s=120.0)
         stats = svc.stats()
+        flight = svc.flight_recorder
+        if args.flight_out:
+            flight.dump(args.flight_out)
+        if args.trace_out:
+            svc.dump_trace(args.trace_out)
     wall_s = time.monotonic() - t0
 
     out = {
@@ -114,6 +128,13 @@ def main(argv=None) -> int:
         'hangs_detected': stats['hangs'],
         'executor_deaths': stats['executor_deaths'],
         'wall_s': round(wall_s, 3),
+        # the incident timeline: what the chaos actually did, in order
+        # (docs/OBSERVABILITY.md "flight recorder")
+        'flight_recorder': {
+            'recorded': flight.recorded,
+            'counts': flight.counts(),
+            'tail': flight.events()[-20:],
+        },
     }
     failures = []
     if report.hung:

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Per-stage latency waterfall from an exported Chrome trace.
+
+Reads the Chrome Trace Event JSON written by
+``ExecutionService.dump_trace`` / ``cli serve-bench --trace-out`` /
+``tools/servechaos.py --trace-out`` and summarizes the request
+lifecycle stage by stage: for every duration span name (queued,
+compile, coalesce.ripen, dispatch, execute, demux, ...) the count,
+p50/p99/max milliseconds, and the share of total traced time — the
+five-second answer to "where does my p99 live?" without opening
+Perfetto.  Instant events (retries, steals, migrations, chaos
+injections, ...) are tallied by name below the waterfall.
+
+Also wired as ``python -m distributed_processor_tpu.cli trace-view``.
+
+    python tools/traceview.py trace.json
+    python tools/traceview.py trace.json --json
+"""
+
+import argparse
+import json
+import sys
+
+# canonical lifecycle order (obs.trace.STAGE_ORDER); stages absent
+# from a trace are skipped, names outside it sort after, alphabetical
+STAGE_ORDER = ('submit', 'submit_source', 'compile', 'queued',
+               'coalesce.ripen', 'dispatch', 'execute', 'demux')
+
+
+def _pct(sorted_vals, p):
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            int(p / 100.0 * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+def summarize(path: str) -> dict:
+    """Stage waterfall + instant tallies for one Chrome-trace file."""
+    with open(path, 'r', encoding='utf-8') as f:
+        doc = json.load(f)
+    events = doc.get('traceEvents', [])
+    durs = {}       # name -> [dur_ms, ...]
+    instants = {}   # name -> count
+    requests = set()
+    for e in events:
+        requests.add(e.get('tid'))
+        name = e.get('name', '?')
+        if e.get('ph') == 'X':
+            durs.setdefault(name, []).append(e.get('dur', 0) / 1e3)
+        elif e.get('ph') == 'i':
+            instants[name] = instants.get(name, 0) + 1
+    total_ms = sum(sum(v) for v in durs.values())
+    rank = {n: i for i, n in enumerate(STAGE_ORDER)}
+    stages = []
+    for name in sorted(durs, key=lambda n: (rank.get(n, len(rank)), n)):
+        vals = sorted(durs[name])
+        stage_ms = sum(vals)
+        stages.append({
+            'stage': name,
+            'count': len(vals),
+            'p50_ms': round(_pct(vals, 50), 3),
+            'p99_ms': round(_pct(vals, 99), 3),
+            'max_ms': round(vals[-1], 3),
+            'total_ms': round(stage_ms, 3),
+            'share': round(stage_ms / total_ms, 4) if total_ms else 0.0,
+        })
+    return {
+        'path': path,
+        'events': len(events),
+        'requests': len(requests),
+        'stages': stages,
+        'instants': dict(sorted(instants.items())),
+    }
+
+
+def format_table(summary: dict) -> str:
+    lines = [f"{summary['path']}: {summary['events']} events, "
+             f"{summary['requests']} traced request(s)", '']
+    hdr = (f"{'stage':>16} {'count':>6} {'p50_ms':>9} {'p99_ms':>9} "
+           f"{'max_ms':>9} {'total_ms':>10} {'share':>6}")
+    lines.append(hdr)
+    lines.append('-' * len(hdr))
+    for s in summary['stages']:
+        lines.append(f"{s['stage']:>16} {s['count']:>6} "
+                     f"{s['p50_ms']:>9.3f} {s['p99_ms']:>9.3f} "
+                     f"{s['max_ms']:>9.3f} {s['total_ms']:>10.3f} "
+                     f"{s['share']:>6.1%}")
+    if summary['instants']:
+        lines.append('')
+        lines.append('events: ' + '  '.join(
+            f'{k}={v}' for k, v in summary['instants'].items()))
+    return '\n'.join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument('trace', help='Chrome Trace Event JSON '
+                                  '(ExecutionService.dump_trace output)')
+    ap.add_argument('--json', action='store_true',
+                    help='emit the summary as JSON instead of a table')
+    args = ap.parse_args(argv)
+    try:
+        summary = summarize(args.trace)
+    except (OSError, ValueError) as e:
+        print(f'traceview: cannot read {args.trace}: {e}',
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(format_table(summary))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
