@@ -131,8 +131,8 @@ from distributed_processor_tpu.models import (
     active_reset, rb_program, make_default_qchip, couplings_from_qchip)
 from distributed_processor_tpu.serve.benchmark import (
     availability_under_chaos, compile_front_door,
-    continuous_batching_comparison, multi_device_scaling,
-    open_loop_latency)
+    continuous_batching_comparison, fleet_failover,
+    multi_device_scaling, open_loop_latency)
 from distributed_processor_tpu.sim.interpreter import InterpreterConfig
 from distributed_processor_tpu.sim.physics import (
     ReadoutPhysics, run_physics_batch, prepare_physics_tables)
@@ -893,6 +893,8 @@ def _degraded_rerun(attempts):
                  ('BENCH_SERVE_OPEN_SHOTS', '8'),
                  ('BENCH_CHAOS_REQS', '24'),
                  ('BENCH_CHAOS_RATE', '40'),
+                 ('BENCH_FLEET_REQS', '24'),
+                 ('BENCH_FLEET_RATE', '20'),
                  ('BENCH_COMPILE_TENANTS', '3'),
                  ('BENCH_COMPILE_PROGRAMS', '2'),
                  ('BENCH_COMPILE_DEPTH', '2'),
@@ -994,6 +996,23 @@ def _serve_chaos_row():
         p_crash=float(os.environ.get('BENCH_CHAOS_P_CRASH', 0.08)),
         p_hang=float(os.environ.get('BENCH_CHAOS_P_HANG', 0.02)),
         p_slow=float(os.environ.get('BENCH_CHAOS_P_SLOW', 0.10)))
+
+
+def _fleet_failover_row():
+    """Fleet-tier availability: goodput + p99 of an open-loop stream
+    over N replica PROCESSES while the loaded replica is SIGKILLed
+    mid-stream (timed kill window) and respawned from the shared warm
+    tiers.  Bit-identity, zero-hang, and positive kill-window goodput
+    are asserted before any number is reported
+    (serve/benchmark.py fleet_failover)."""
+    return fleet_failover(
+        n_replicas=int(os.environ.get('BENCH_FLEET_REPLICAS', 2)),
+        n_reqs=int(os.environ.get('BENCH_FLEET_REQS', 60)),
+        rate_hz=float(os.environ.get('BENCH_FLEET_RATE', 30)),
+        shots=int(os.environ.get('BENCH_FLEET_SHOTS', 8)),
+        seed=int(os.environ.get('BENCH_FLEET_SEED', 0)),
+        kill_window_s=float(os.environ.get('BENCH_FLEET_KILL_WINDOW',
+                                           2.0)))
 
 
 def _observability_overhead_row():
@@ -1528,6 +1547,18 @@ def main():
         serve_chaos = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('availability_under_chaos', serve_chaos)
 
+    # fleet-failover row: the same discipline one tier up — replica
+    # PROCESSES behind the FleetRouter, a timed SIGKILL of the loaded
+    # replica, goodput required positive through the kill window
+    try:
+        fleet_row = _timed_row(_fleet_failover_row) \
+            if secondaries else None
+    except _RowTimeout as e:
+        fleet_row = {'error': 'timeout', 'detail': str(e)}
+    except Exception as e:      # pragma: no cover - defensive
+        fleet_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+    artifact.row('fleet_failover', fleet_row)
+
     # compile front-door row: duplicate-program tenant traffic through
     # the content-addressed source->MachineProgram cache (dedup,
     # singleflight, submit_source bit-identity asserted inside)
@@ -1603,6 +1634,7 @@ def main():
             'continuous_batching': serve_row,
             'serve_open_loop': serve_open,
             'availability_under_chaos': serve_chaos,
+            'fleet_failover': fleet_row,
             'compile_front_door': front_door,
             'observability_overhead': obs_row,
             'preflight': preflight,

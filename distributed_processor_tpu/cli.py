@@ -397,9 +397,19 @@ def cmd_serve_bench(args):
     from .serve.benchmark import (availability_under_chaos,
                                   compile_front_door,
                                   continuous_batching_comparison,
+                                  fleet_failover,
                                   multi_device_scaling,
                                   open_loop_latency)
-    if args.source_mode:
+    if args.fleet:
+        # fleet-federation mode: N replica PROCESSES behind the
+        # FleetRouter; SIGKILL the loaded replica mid-stream and require
+        # goodput to stay positive inside the kill window with every
+        # completion bit-identical (docs/FLEET.md)
+        row = fleet_failover(
+            n_replicas=args.fleet, n_reqs=args.requests,
+            rate_hz=args.rate_hz, n_qubits=args.qubits,
+            depth=args.depth, shots=args.shots, seed=args.seed)
+    elif args.source_mode:
         # the compile front door: tenants submit SOURCE programs via
         # submit_source; content-addressed dedup + singleflight +
         # bit-identity vs compile+submit asserted inside the row
@@ -741,6 +751,13 @@ def main(argv=None):
                         'Event JSON (Perfetto / chrome://tracing '
                         'loadable; implies --trace-sample 1.0 unless '
                         'set); summarize with `trace-view`')
+    p.add_argument('--fleet', type=int, default=0, metavar='N',
+                   help='fleet-federation mode: route the open-loop '
+                        'stream across N replica processes behind the '
+                        'FleetRouter, SIGKILL the loaded replica '
+                        'mid-stream, and report kill-window goodput, '
+                        'failovers and respawns (bit-identity '
+                        'asserted; docs/FLEET.md)')
     p.set_defaults(fn=cmd_serve_bench)
 
     p = sub.add_parser('trace-view',

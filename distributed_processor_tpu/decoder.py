@@ -208,6 +208,13 @@ class ProgramValidationError(ValueError):
         super().__init__('program validation failed:\n  '
                          + '\n  '.join(lines))
 
+    def __reduce__(self):
+        # rebuild from the structured error list, not the rendered
+        # message — default exception pickling would replay __init__
+        # with the message string and corrupt ``errors`` on the far
+        # side of the fleet wire (serve/transport.py)
+        return (ProgramValidationError, (self.errors,))
+
     @property
     def codes(self) -> set:
         return {e[0] for e in self.errors}

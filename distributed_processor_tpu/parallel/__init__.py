@@ -1,9 +1,12 @@
 from .mesh import make_mesh, shot_sharding
 from .driver import run_physics_sweep, run_multi_sweep
-from .sweep import (sharded_simulate, sweep_stats, sharded_demod,
-                    sharded_physics_stats, sharded_multi_stats,
+from .sweep import (sharded_simulate, sweep_stats, sweep_stat_sums,
+                    sharded_demod, sharded_physics_stats,
+                    sharded_physics_stat_sums, sharded_multi_stats,
                     run_spanned)
 from .param_sweep import (swept_pulse_machine_program, grid_init_regs,
                           sweep_cfg, AMP_REG, FREQ_REG)
 from .multihost import (initialize_multihost, make_global_mesh,
-                        host_local_batch, global_shot_array)
+                        host_local_batch, host_local_mesh,
+                        dp_row_offset, cross_host_sum,
+                        global_shot_array)

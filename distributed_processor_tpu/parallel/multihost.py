@@ -8,12 +8,29 @@ host-local (the dp axis is ordered so each host's shard lives on its own
 devices — collectives for statistics ride ICI within a host and DCN
 across hosts only for the final psum).
 
+Two reduction strategies coexist:
+
+* **Global-mesh collectives** (`make_global_mesh` + the sweep
+  functions): the psum itself crosses DCN inside XLA.  This is the TPU
+  pod path; the CPU backend refuses multiprocess jit computations
+  outright, so it cannot back the 2-process CI test.
+* **Host-local compute + coordination-service reduction**
+  (`host_local_mesh` + `dp_row_offset` + `cross_host_sum`): every
+  controller jits only over its OWN devices (so any backend works),
+  produces exact integer partial sums, and the final reduction rides
+  the `jax.distributed` KV store in deterministic process order —
+  bit-identical on every controller and to a single-process run of the
+  same global batch.  This is also the wire the serve-tier fleet's
+  coordinator-less siblings (serve/transport.py) mirror one level up.
+
 Single-process runs fall back transparently, so everything here is
 exercised by the regular test suite; multi-host needs no code changes,
 only `initialize_multihost()` before first jax use on each controller.
 """
 
 from __future__ import annotations
+
+import json
 
 import jax
 import numpy as np
@@ -63,6 +80,73 @@ def host_local_batch(mesh: Mesh, global_shots: int) -> tuple[int, int]:
                   if mesh.devices[i, 0].process_index == jax.process_index()]
     return per_dev * len(local_rows), per_dev * (local_rows[0]
                                                  if local_rows else 0)
+
+
+def host_local_mesh(n_mp: int = 1) -> Mesh:
+    """A ('dp', 'mp') mesh over THIS process's devices only.
+
+    Computations jitted over it never require cross-process XLA
+    collectives, so they run on every backend (the CPU runtime rejects
+    multiprocess computations); pair with :func:`dp_row_offset` and
+    :func:`cross_host_sum` to reproduce a global-mesh reduction
+    exactly."""
+    devs = sorted(jax.local_devices(), key=lambda d: d.id)
+    if n_mp < 1 or len(devs) % n_mp:
+        raise ValueError(
+            f'{len(devs)} local devices not divisible by n_mp={n_mp}')
+    n_dp = len(devs) // n_mp
+    return Mesh(np.asarray(devs).reshape(n_dp, n_mp), ('dp', 'mp'))
+
+
+def dp_row_offset(global_mesh: Mesh) -> int:
+    """This process's first dp row on the global mesh — the offset that
+    places a host-local mesh's shards on the global dp grid (for
+    key-derivation parity: `sweep.sharded_physics_stat_sums` folds
+    ``axis_index('dp') + dp_offset``)."""
+    n_dp = global_mesh.devices.shape[0]
+    rows = [i for i in range(n_dp)
+            if global_mesh.devices[i, 0].process_index
+            == jax.process_index()]
+    return rows[0] if rows else 0
+
+
+def cross_host_sum(tag: str, tree, timeout_s: float = 120.0):
+    """Sum a pytree of integer arrays across every process, through the
+    ``jax.distributed`` coordination-service KV store (host-level DCN,
+    no XLA collectives).
+
+    Each process publishes its partial sums under ``tag`` and its
+    process index, then folds every peer's contribution IN PROCESS
+    ORDER — integer addition, deterministic order, so all controllers
+    compute bit-identical totals.  ``tag`` must be unique per
+    logical reduction (keys are never deleted from the store).
+    Single-process: returns the tree as host numpy unchanged."""
+    leaves, treedef = jax.tree.flatten(tree)
+    local = [np.asarray(leaf) for leaf in leaves]
+    if jax.process_count() == 1:
+        return jax.tree.unflatten(treedef, local)
+    from jax._src.distributed import global_state
+    client = global_state.client
+    if client is None:
+        raise RuntimeError('cross_host_sum needs '
+                           'jax.distributed.initialize '
+                           '(initialize_multihost) first')
+    payload = json.dumps([{'shape': list(leaf.shape),
+                           'dtype': str(leaf.dtype),
+                           'data': leaf.ravel().tolist()}
+                          for leaf in local])
+    client.key_value_set(
+        f'dproc/sum/{tag}/{jax.process_index()}', payload)
+    total = None
+    for pid in range(jax.process_count()):
+        raw = client.blocking_key_value_get(
+            f'dproc/sum/{tag}/{pid}', int(timeout_s * 1e3))
+        peer = [np.asarray(d['data'], dtype=d['dtype']).reshape(
+                    d['shape'])
+                for d in json.loads(raw)]
+        total = peer if total is None \
+            else [a + b for a, b in zip(total, peer)]
+    return jax.tree.unflatten(treedef, total)
 
 
 def global_shot_array(mesh: Mesh, local_data, global_shape) -> jax.Array:

@@ -102,13 +102,21 @@ def sharded_simulate(mp, meas_bits, mesh, init_regs=None,
     return jax.jit(fn)(meas_bits, init_regs)
 
 
-def sweep_stats(mp, meas_bits, mesh, init_regs=None,
-                cfg: InterpreterConfig = None, **kw):
-    """Sharded run reduced to global statistics (no per-shot outputs
-    leave the devices): mean pulse counts, error rate, mean final qclk.
+def sweep_stat_sums(mp, meas_bits, mesh, init_regs=None,
+                    cfg: InterpreterConfig = None, **kw):
+    """The un-normalized integer sums under :func:`sweep_stats`:
+    ``pulse_sum [n_cores]``, ``err_shots``, ``qclk_sum [n_cores]``,
+    ``fault_shots`` — psum-reduced over the mesh's dp axis only.
 
-    The reduction is a ``psum`` over the dp axis — the ICI-collective
-    path that replaces the reference's host-side accumulation.
+    This is the multi-controller building block: on a host-local mesh
+    each process computes its shard's exact integer sums here and the
+    final cross-host reduction rides the coordination-service KV store
+    (:func:`.multihost.cross_host_sum`) instead of an XLA collective —
+    integer addition in a deterministic process order, so the global
+    statistics are bit-identical on every controller AND to a
+    single-process run of the same global batch (the CPU backend
+    cannot jit multiprocess computations at all, which is why the DCN
+    hop happens on the host).
     """
     from dataclasses import replace
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
@@ -135,7 +143,20 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
 
     fn = shard_map(local, mesh=mesh, in_specs=(P('dp'), P('dp')),
                    out_specs=P(), check_vma=False)
-    out = jax.jit(fn)(meas_bits, init_regs)
+    return jax.jit(fn)(meas_bits, init_regs)
+
+
+def sweep_stats(mp, meas_bits, mesh, init_regs=None,
+                cfg: InterpreterConfig = None, **kw):
+    """Sharded run reduced to global statistics (no per-shot outputs
+    leave the devices): mean pulse counts, error rate, mean final qclk.
+
+    The reduction is a ``psum`` over the dp axis — the ICI-collective
+    path that replaces the reference's host-side accumulation.
+    """
+    n_shots = np.asarray(meas_bits).shape[0]
+    out = sweep_stat_sums(mp, meas_bits, mesh, init_regs=init_regs,
+                          cfg=cfg, **kw)
     return dict(mean_pulses=out['pulse_sum'] / n_shots,
                 err_rate=out['err_shots'] / n_shots,
                 mean_qclk=out['qclk_sum'] / n_shots,
@@ -243,6 +264,45 @@ def sharded_multi_stats(mps, meas_bits, mesh, init_regs=None,
                 fault_shots=out['fault_shots'])
 
 
+def sharded_physics_stat_sums(mp, model, key, shots: int, mesh,
+                              dp_offset: int = 0, cfg=None, **kw):
+    """The un-normalized sums under :func:`sharded_physics_stats`
+    (psum-reduced over this mesh's dp axis only; see
+    :func:`physics_batch_stats` for the fields).
+
+    ``dp_offset`` places this mesh's dp rows on a larger GLOBAL dp
+    grid for key derivation: shard *i* folds ``i + dp_offset`` into
+    ``key``, so a host-local mesh computing rows ``[offset, offset +
+    n_dp)`` of a multi-controller run draws exactly the noise streams
+    the equivalent single-process global mesh would — per-shard
+    computations are identical and the cross-host sum of these
+    integers reproduces the single-process statistics bit-for-bit.
+    ``shots`` is THIS mesh's shot count (``n_dp * local_shots``).
+    """
+    from ..sim.physics import run_physics_batch
+    from dataclasses import replace
+    from ..sim.interpreter import InterpreterConfig
+    cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    cfg = replace(cfg, record_pulses=False)   # stats never read rec_*
+    n_dp = mesh.shape['dp']
+    if shots % n_dp:
+        raise ValueError(f'{shots} shots not divisible by dp={n_dp}')
+    local_shots = shots // n_dp
+    if isinstance(key, int):
+        key = jax.random.PRNGKey(key)
+
+    def local():
+        k_local = jax.random.fold_in(
+            key, jax.lax.axis_index('dp') + dp_offset)
+        out = run_physics_batch(mp, model, k_local, local_shots, cfg=cfg)
+        return jax.tree.map(lambda x: jax.lax.psum(x, 'dp'),
+                            physics_batch_stats(out))
+
+    fn = shard_map(local, mesh=mesh, in_specs=(), out_specs=P(),
+                   check_vma=False)
+    return jax.jit(fn)()
+
+
 def sharded_physics_stats(mp, model, key, shots: int, mesh,
                           cfg=None, **kw):
     """Physics-closed execution sharded over the mesh dp axis: every
@@ -258,27 +318,8 @@ def sharded_physics_stats(mp, model, key, shots: int, mesh,
     Returns mean_pulses [n_cores], err_rate, meas1_rate [n_cores]
     (fraction of first-slot measurement bits reading 1).
     """
-    from ..sim.physics import run_physics_batch
-    from dataclasses import replace
-    from ..sim.interpreter import InterpreterConfig
-    cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
-    cfg = replace(cfg, record_pulses=False)   # stats never read rec_*
-    n_dp = mesh.shape['dp']
-    if shots % n_dp:
-        raise ValueError(f'{shots} shots not divisible by dp={n_dp}')
-    local_shots = shots // n_dp
-    if isinstance(key, int):
-        key = jax.random.PRNGKey(key)
-
-    def local():
-        k_local = jax.random.fold_in(key, jax.lax.axis_index('dp'))
-        out = run_physics_batch(mp, model, k_local, local_shots, cfg=cfg)
-        return jax.tree.map(lambda x: jax.lax.psum(x, 'dp'),
-                            physics_batch_stats(out))
-
-    fn = shard_map(local, mesh=mesh, in_specs=(), out_specs=P(),
-                   check_vma=False)
-    out = jax.jit(fn)()
+    out = sharded_physics_stat_sums(mp, model, key, shots, mesh,
+                                    cfg=cfg, **kw)
     return dict(mean_pulses=out['pulse_sum'] / shots,
                 err_rate=out['err_shots'] / shots,
                 meas1_rate=out['meas1_sum'] / shots,

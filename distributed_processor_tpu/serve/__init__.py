@@ -28,15 +28,22 @@ crashes/hangs/slowdowns under ``_run_batch`` to prove all of it.
 from .batcher import Coalescer, bucket_key
 from .bucketspec import BucketSpec
 from .catalog import BucketCatalog
-from .chaos import ChaosError, ChaosMonkey, ChaosPlan, ChaosThreadDeath
+from .chaos import (ChaosError, ChaosMonkey, ChaosPlan,
+                    ChaosThreadDeath, FleetSoakReport, SoakReport,
+                    fleet_soak, soak)
+from .fleet import Fleet
 from .request import (CancelledError, DeadlineError, ExecutorLostError,
                       OverloadError, QueueFullError, RequestHandle,
                       ServiceClosedError, ShutdownError)
+from .router import (ROUTER_THREAD_PREFIX, FleetRouter,
+                     is_terminal_error)
 from .service import (CANARY_THREAD_PREFIX, DISPATCH_THREAD_PREFIX,
                       SUPERVISE_THREAD_PREFIX, WARMUP_THREAD_PREFIX,
                       ExecutionService)
 from .supervise import (HEALTH_LIVE, HEALTH_PROBING,
                         HEALTH_QUARANTINED, CircuitBreaker, RetryPolicy)
+from .transport import (WIRE_THREAD_PREFIX, ReplicaClient,
+                        ReplicaLostError, ReplicaServer)
 
 __all__ = [
     'BucketCatalog',
@@ -53,16 +60,28 @@ __all__ = [
     'DeadlineError',
     'ExecutionService',
     'ExecutorLostError',
+    'Fleet',
+    'FleetRouter',
+    'FleetSoakReport',
     'HEALTH_LIVE',
     'HEALTH_PROBING',
     'HEALTH_QUARANTINED',
     'OverloadError',
     'QueueFullError',
+    'ROUTER_THREAD_PREFIX',
+    'ReplicaClient',
+    'ReplicaLostError',
+    'ReplicaServer',
     'RequestHandle',
     'RetryPolicy',
     'SUPERVISE_THREAD_PREFIX',
     'ServiceClosedError',
     'ShutdownError',
+    'SoakReport',
     'WARMUP_THREAD_PREFIX',
+    'WIRE_THREAD_PREFIX',
     'bucket_key',
+    'fleet_soak',
+    'is_terminal_error',
+    'soak',
 ]

@@ -18,7 +18,12 @@ never sees a torn file), and a tolerant loader — any parse/version
 problem means "empty catalog", never an exception into the serving
 path.  The file is small (one dict per distinct bucket; diverse
 production traffic is tens of buckets, not thousands) so each record
-rewrites the whole file rather than appending.
+rewrites the whole file rather than appending.  Because fleet replicas
+share ONE catalog file as a warm tier (docs/FLEET.md), every rewrite
+happens under an advisory ``flock`` on a ``.lock`` sidecar with a
+merge-from-disk first — concurrent recorders compose their entries
+instead of last-writer-wins, and a replica's ``begin_run`` replays
+what its peers learned, not just its own history.
 
 A catalog left to itself only grows — a retired workload's buckets
 would be AOT-recompiled at every startup forever.  So the catalog
@@ -33,15 +38,54 @@ side of this change stay mutually loadable.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import tempfile
 import threading
 
+try:
+    import fcntl
+except ImportError:          # pragma: no cover - non-posix
+    fcntl = None
+
 from .bucketspec import BucketSpec
 
 CATALOG_MAGIC = 'dproc-bucket-catalog'
 CATALOG_VERSION = 1
+
+
+@contextlib.contextmanager
+def _file_lock(path: str):
+    """Advisory cross-process writer lock on ``path + '.lock'``.
+
+    Fleet replicas share one catalog file (docs/FLEET.md "shared warm
+    tiers"); without a lock two concurrent record()s interleave their
+    read-modify-rewrite cycles and the later ``os.replace`` silently
+    drops the earlier writer's specs.  Best-effort like everything else
+    here: if locking is unavailable (non-posix, unwritable dir) the
+    body still runs — atomic rename keeps the file un-torn, and a lost
+    entry costs one future cold compile, never a request.
+    """
+    fd = None
+    try:
+        if fcntl is not None:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            fd = os.open(path + '.lock', os.O_CREAT | os.O_RDWR, 0o644)
+            fcntl.flock(fd, fcntl.LOCK_EX)
+    except OSError:
+        if fd is not None:
+            os.close(fd)
+        fd = None
+    try:
+        yield
+    finally:
+        if fd is not None:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            finally:
+                os.close(fd)
 
 
 class BucketCatalog:
@@ -86,21 +130,34 @@ class BucketCatalog:
         aged/over-cap specs, persist, and return the surviving specs
         (the startup warmup replay set).  The service calls this once
         at construction; a catalog opened only via :meth:`load` never
-        ages."""
+        ages.  Holds the cross-process writer lock around a fresh
+        merge-from-disk, so a fleet replica starting up replays specs
+        its PEERS recorded, not just its own last generation."""
         with self._lock:
-            self._load_locked()
-            self._run += 1
-            self._prune_locked()
-            try:
-                self._write_locked()
-            except OSError:
-                pass        # durability is best-effort; serving is not
+            self._loaded = True
+            with _file_lock(self.path):
+                self._merge_disk_locked()
+                self._run += 1
+                self._prune_locked()
+                try:
+                    self._write_locked()
+                except OSError:
+                    pass    # durability is best-effort; serving is not
             return list(self._specs.values())
 
     def _load_locked(self) -> None:
         if self._loaded:
             return
         self._loaded = True
+        self._merge_disk_locked()
+
+    def _merge_disk_locked(self) -> None:
+        """Fold the on-disk catalog into memory: union of specs, age
+        stamps max-merged, run counter max-merged.  A parse/version
+        problem merges nothing (in-memory state is never discarded);
+        called at first load and — under :func:`_file_lock` — before
+        every rewrite, so concurrent replicas' writes compose instead
+        of last-writer-wins."""
         try:
             with open(self.path, 'r', encoding='utf-8') as f:
                 doc = json.load(f)
@@ -109,20 +166,23 @@ class BucketCatalog:
                 return
             # aging metadata is optional: a file written before the
             # aging change loads with every spec treated as just-seen
-            self._run = int(doc.get('runs', 0))
+            self._run = max(self._run, int(doc.get('runs', 0)))
             last_seen = doc.get('last_seen', {})
             if not isinstance(last_seen, dict):
                 last_seen = {}
             for d in doc.get('specs', ()):
                 spec = BucketSpec.from_json(d)
                 ident = spec.identity()
+                seen = int(last_seen.get(self._ident_key(ident),
+                                         self._run))
                 if ident not in self._specs:
                     self._specs[ident] = spec
-                    self._last_seen[ident] = int(
-                        last_seen.get(self._ident_key(ident), self._run))
+                    self._last_seen[ident] = seen
+                else:
+                    self._last_seen[ident] = max(
+                        self._last_seen[ident], seen)
         except (OSError, ValueError, TypeError, KeyError):
-            self._specs.clear()
-            self._last_seen.clear()
+            pass
 
     @staticmethod
     def _ident_key(ident) -> str:
@@ -167,11 +227,17 @@ class BucketCatalog:
                 return False
             self._specs[ident] = spec
             self._last_seen[ident] = self._run
-            self._prune_locked()
-            try:
-                self._write_locked()
-            except OSError:
-                pass        # durability is best-effort; serving is not
+            with _file_lock(self.path):
+                # merge peers' concurrent writes before rewriting, so
+                # N replicas recording into one shared catalog never
+                # drop each other's entries (two-process contention
+                # test in tests/test_fleet.py)
+                self._merge_disk_locked()
+                self._prune_locked()
+                try:
+                    self._write_locked()
+                except OSError:
+                    pass    # durability is best-effort; serving is not
             return True
 
     def _write_locked(self) -> None:

@@ -1,0 +1,337 @@
+"""Fleet: replica process manager + shared warm tiers + front door.
+
+The top of the serving stack (docs/FLEET.md): a :class:`Fleet` spawns N
+:mod:`.replica_main` processes (each one :class:`ExecutionService` on
+the :mod:`.transport` wire), registers them with a
+:class:`~.router.FleetRouter`, and keeps the population at N — a
+monitor thread detects dead replica processes (SIGKILL, OOM, crash) and
+respawns them with the SAME replica id, so the router sees a
+``replica_down`` followed by a ``replica_up`` on a fresh connection.
+
+Every replica of a fleet shares three warm tiers under ``shared_dir``:
+
+* ``xla/`` — the JAX persistent compilation cache,
+* ``compile/`` — the serve-tier content-addressed
+  :class:`~.compile_cache.PersistentStore`,
+* ``catalog.json`` — the learned AOT warmup :class:`~.catalog.
+  BucketCatalog` (flock-guarded, merge-on-write, so concurrent
+  replicas interleave safely).
+
+A respawned replica therefore replays its warmup from what its PEERS
+compiled: its first served request hits zero cold compiles — the
+fleet's answer to the cold-start problem the single-service AOT warmup
+solved in-process.
+
+Chaos hooks (``kill`` / ``wedge`` / ``unwedge``) drive the fleet soak:
+SIGKILL exercises connection-loss failover, SIGSTOP exercises the
+gossip-staleness path (the TCP connection stays open while the process
+makes no progress), SIGCONT exercises heartbeat re-admission.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from .router import ROUTER_THREAD_PREFIX, FleetRouter
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class _ReplicaProc:
+    __slots__ = ('rid', 'proc', 'address', 'log_path', 'spawned_t',
+                 'wedged', 'respawns')
+
+    def __init__(self, rid):
+        self.rid = rid
+        self.proc = None
+        self.address = None
+        self.log_path = None
+        self.spawned_t = 0.0
+        self.wedged = False
+        self.respawns = 0
+
+
+class Fleet:
+    """N supervised replica processes behind one FleetRouter.
+
+    ``submit`` / ``submit_source`` / ``stats`` mirror the service API;
+    handles resolve bit-identical-or-typed across replica loss.  The
+    ``service`` dict is passed to every replica's ExecutionService
+    (JSON-able kwargs only: ``devices``, ``max_est_wait_ms``,
+    ``breaker_*``, ...); ``interp_cfg`` likewise for the default
+    InterpreterConfig.  ``env`` overrides the replicas' environment
+    (platform / device-count knobs are applied before jax imports).
+    """
+
+    def __init__(self, n_replicas: int = 2, *, shared_dir: str = None,
+                 service: dict = None, interp_cfg: dict = None,
+                 env: dict = None, respawn: bool = True,
+                 respawn_backoff_s: float = 0.25,
+                 monitor_interval_s: float = 0.05,
+                 ready_timeout_s: float = 300.0,
+                 name: str = None, router_kwargs: dict = None):
+        if n_replicas < 1:
+            raise ValueError('n_replicas must be >= 1')
+        self.name = name or 'fleet'
+        self._tmp = None
+        if shared_dir is None:
+            self._tmp = tempfile.TemporaryDirectory(
+                prefix='dproc-fleet-')
+            shared_dir = self._tmp.name
+        self.shared_dir = shared_dir
+        os.makedirs(os.path.join(shared_dir, 'logs'), exist_ok=True)
+        self._service = dict(service or {})
+        self._interp_cfg = dict(interp_cfg) if interp_cfg else None
+        self._env = dict(env or {})
+        self._respawn = bool(respawn)
+        self._respawn_backoff_s = respawn_backoff_s
+        self._monitor_interval_s = monitor_interval_s
+        self._ready_timeout_s = ready_timeout_s
+        self.router = FleetRouter(name=self.name,
+                                  **(router_kwargs or {}))
+        self._lock = threading.Lock()
+        self._closing = False
+        self._replicas = [_ReplicaProc(f'r{i}')
+                          for i in range(n_replicas)]
+        try:
+            self._spawn_all()
+        except BaseException:
+            self.shutdown()
+            raise
+        self._monitor = threading.Thread(
+            target=self._monitor_loop,
+            name=f'{ROUTER_THREAD_PREFIX}-monitor-{self.name}',
+            daemon=True)
+        self._monitor.start()
+
+    # -- spawning --------------------------------------------------------
+
+    def _replica_config(self, rid: str) -> dict:
+        cfg = {
+            'rid': rid,
+            'env': self._env,
+            'jax_cache_dir': os.path.join(self.shared_dir, 'xla'),
+            'service': dict(self._service),
+        }
+        cfg['service'].setdefault(
+            'compile_cache_dir', os.path.join(self.shared_dir,
+                                              'compile'))
+        cfg['service'].setdefault(
+            'warmup_catalog', os.path.join(self.shared_dir,
+                                           'catalog.json'))
+        if self._interp_cfg:
+            cfg['interp_cfg'] = self._interp_cfg
+        return cfg
+
+    def _spawn(self, slot: _ReplicaProc) -> None:
+        env = dict(os.environ)
+        env['PYTHONPATH'] = _PKG_ROOT + os.pathsep \
+            + env.get('PYTHONPATH', '')
+        env.update({k: str(v) for k, v in self._env.items()})
+        slot.log_path = os.path.join(
+            self.shared_dir, 'logs',
+            f'{slot.rid}.{slot.respawns}.log')
+        log = open(slot.log_path, 'wb')
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, '-m',
+                 'distributed_processor_tpu.serve.replica_main',
+                 json.dumps(self._replica_config(slot.rid))],
+                stdout=subprocess.PIPE, stderr=log, env=env,
+                cwd=_PKG_ROOT)
+        finally:
+            log.close()
+        ready = self._read_ready(slot, proc)
+        slot.proc = proc
+        slot.address = (ready['host'], ready['port'])
+        slot.spawned_t = time.monotonic()
+        slot.wedged = False
+        self.router.add_replica(slot.rid, slot.address)
+
+    def _read_ready(self, slot, proc) -> dict:
+        """Block (bounded) for the replica's JSON ready line."""
+        deadline = time.monotonic() + self._ready_timeout_s
+        buf = b''
+        fd = proc.stdout.fileno()
+        while b'\n' not in buf:
+            remain = deadline - time.monotonic()
+            if remain <= 0 or proc.poll() is not None:
+                proc.kill()
+                raise RuntimeError(
+                    f'replica {slot.rid} failed to become ready '
+                    f'(exit={proc.poll()}): {self._log_tail(slot)}')
+            r, _, _ = select.select([fd], [], [], min(remain, 1.0))
+            if r:
+                chunk = os.read(fd, 4096)
+                if not chunk:
+                    continue
+                buf += chunk
+        return json.loads(buf.split(b'\n', 1)[0])
+
+    def _log_tail(self, slot, n: int = 2000) -> str:
+        try:
+            with open(slot.log_path, 'rb') as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - n))
+                return f.read().decode('utf-8', 'replace')
+        except OSError:
+            return '<no log>'
+
+    def _spawn_all(self) -> None:
+        # replicas import jax independently — spawn concurrently so
+        # fleet startup is one replica's boot time, not the sum
+        errs = []
+
+        def boot(slot):
+            try:
+                self._spawn(slot)
+            except BaseException as exc:   # noqa: BLE001
+                errs.append((slot.rid, exc))
+
+        threads = [threading.Thread(
+            target=boot, args=(s,),
+            name=f'{ROUTER_THREAD_PREFIX}-spawn-{s.rid}', daemon=True)
+            for s in self._replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise RuntimeError(
+                f'fleet spawn failed: {errs[0][0]}: {errs[0][1]}')
+
+    # -- supervision -----------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._closing:
+                    return
+                slots = list(self._replicas)
+            for slot in slots:
+                proc = slot.proc
+                if proc is None or proc.poll() is None:
+                    continue
+                if self._closing or not self._respawn:
+                    continue
+                time.sleep(self._respawn_backoff_s)
+                with self._lock:
+                    if self._closing:
+                        return
+                slot.respawns += 1
+                try:
+                    self._spawn(slot)
+                except RuntimeError:
+                    # spawn failed (e.g. mid-shutdown): retry next tick
+                    pass
+            time.sleep(self._monitor_interval_s)
+
+    # -- chaos hooks -----------------------------------------------------
+
+    def pid(self, idx: int) -> int:
+        return self._replicas[idx].proc.pid
+
+    def kill(self, idx: int) -> None:
+        """SIGKILL a replica process: connection-loss failover (the
+        monitor respawns it when ``respawn=True``)."""
+        self._replicas[idx].proc.kill()
+
+    def wedge(self, idx: int) -> None:
+        """SIGSTOP a replica: it stops making progress while its TCP
+        connection stays open — only gossip staleness can catch it."""
+        os.kill(self._replicas[idx].proc.pid, signal.SIGSTOP)
+        self._replicas[idx].wedged = True
+
+    def unwedge(self, idx: int) -> None:
+        """SIGCONT a wedged replica: its next heartbeat re-admits it."""
+        os.kill(self._replicas[idx].proc.pid, signal.SIGCONT)
+        self._replicas[idx].wedged = False
+
+    # -- serving API -----------------------------------------------------
+
+    def submit(self, *args, **kw):
+        return self.router.submit(*args, **kw)
+
+    def submit_source(self, *args, **kw):
+        return self.router.submit_source(*args, **kw)
+
+    def replica_ids(self) -> list:
+        return [s.rid for s in self._replicas]
+
+    def replica_stats(self, idx_or_rid) -> dict:
+        rid = idx_or_rid if isinstance(idx_or_rid, str) \
+            else self._replicas[idx_or_rid].rid
+        return self.router.call_replica(rid, 'stats')
+
+    def stats(self) -> dict:
+        snap = self.router.stats()
+        with self._lock:
+            snap['processes'] = {
+                s.rid: {
+                    'pid': s.proc.pid if s.proc else None,
+                    'running': s.proc is not None
+                    and s.proc.poll() is None,
+                    'wedged': s.wedged,
+                    'respawns': s.respawns,
+                } for s in self._replicas}
+        snap['shared_dir'] = self.shared_dir
+        return snap
+
+    # -- teardown --------------------------------------------------------
+
+    def shutdown(self, timeout_s: float = 20.0) -> None:
+        """Stop the monitor, gracefully stop every replica (escalating
+        to SIGKILL), shut the router down, clean the temp shared dir.
+        Idempotent; after it returns no fleet thread or process
+        remains."""
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+        if getattr(self, '_monitor', None) is not None:
+            self._monitor.join(timeout=5.0)
+        deadline = time.monotonic() + timeout_s
+        for slot in self._replicas:
+            proc = slot.proc
+            if proc is None or proc.poll() is not None:
+                continue
+            try:
+                os.kill(proc.pid, signal.SIGCONT)   # unwedge first
+            except OSError:
+                pass
+            try:
+                self.router.call_replica(slot.rid, 'shutdown',
+                                         timeout_s=2.0)
+            except Exception:          # noqa: BLE001 - escalate below
+                pass
+            proc.terminate()
+        for slot in self._replicas:
+            proc = slot.proc
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+            if proc.stdout is not None:
+                proc.stdout.close()
+        self.router.shutdown()
+        if self._tmp is not None:
+            self._tmp.cleanup()
+            self._tmp = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.shutdown()

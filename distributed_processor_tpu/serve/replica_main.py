@@ -1,0 +1,82 @@
+"""Replica entry point: one ExecutionService process on the fleet wire.
+
+``python -m distributed_processor_tpu.serve.replica_main '<json>'``
+boots one replica of the fleet (docs/FLEET.md): it applies the
+environment knobs from the config BEFORE anything imports jax (device
+count and platform are import-time decisions), builds an
+:class:`~.service.ExecutionService` from the ``service`` kwargs, wraps
+it in a :class:`~.transport.ReplicaServer`, and prints one JSON ready
+line (``{"ready": true, "port": ..., "pid": ...}``) on stdout so the
+spawning :class:`~.fleet.Fleet` learns the bound port without a port
+race.  It then blocks until a ``shutdown`` wire op or SIGTERM arrives.
+
+Config schema (all keys optional)::
+
+    {
+      "env":          {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "..."},
+      "jax_cache_dir": "<shared persistent XLA compile cache>",
+      "interp_cfg":   {"max_steps": 192, ...},   # InterpreterConfig
+      "service":      {"devices": "all", "compile_cache_dir": ...,
+                       "warmup_catalog": ..., ...},
+      "host": "127.0.0.1", "port": 0, "rid": "r0"
+    }
+
+``jax_cache_dir`` / ``compile_cache_dir`` / ``warmup_catalog`` are the
+three shared warm tiers: pointing every replica of a fleet at the same
+directories means a freshly respawned replica replays its warmup from
+what its PEERS compiled and persisted — the zero-cold-restart property
+the fleet tests assert.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    argv = sys.argv if argv is None else argv
+    cfg = json.loads(argv[1]) if len(argv) > 1 and argv[1] else {}
+
+    # environment first: device count / platform are read at jax import
+    for k, v in (cfg.get('env') or {}).items():
+        os.environ[k] = str(v)
+
+    import jax
+    if cfg.get('jax_cache_dir'):
+        jax.config.update('jax_compilation_cache_dir',
+                          cfg['jax_cache_dir'])
+        jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                          0.0)
+
+    from ..sim.interpreter import InterpreterConfig
+    from .service import ExecutionService
+    from .transport import ReplicaServer
+
+    icfg = None
+    if cfg.get('interp_cfg'):
+        icfg = InterpreterConfig(**cfg['interp_cfg'])
+    svc = ExecutionService(icfg, name=cfg.get('rid'),
+                           **(cfg.get('service') or {}))
+
+    stop = threading.Event()
+    server = ReplicaServer(svc, host=cfg.get('host', '127.0.0.1'),
+                           port=int(cfg.get('port', 0)),
+                           on_shutdown=stop.set)
+    signal.signal(signal.SIGTERM, lambda *_a: stop.set())
+
+    print(json.dumps({'ready': True, 'rid': cfg.get('rid'),
+                      'host': server.address[0],
+                      'port': server.address[1],
+                      'pid': os.getpid()}), flush=True)
+    stop.wait()
+    server.close()
+    svc.shutdown(drain=False)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

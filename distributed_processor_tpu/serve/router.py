@@ -1,0 +1,684 @@
+"""FleetRouter: the front door that load-balances across replicas.
+
+One router process fans ``submit`` / ``submit_source`` traffic out over
+N :class:`~.service.ExecutionService` replicas (separate processes,
+reached through :mod:`.transport`), and keeps serving — bit-identical
+or typed — while replicas die, hang, or restart (docs/FLEET.md).  The
+design deliberately re-uses the in-process supervision vocabulary one
+ring out:
+
+* **Health gossip.**  A gossip thread polls every replica's ``stats()``
+  digest (queue depth, est_wait, health mix) on ``gossip_interval_ms``.
+  Each response re-arms the replica's heartbeat; a replica whose last
+  heartbeat exceeds ``liveness_window_ms`` is declared down
+  (``gossip_stale`` + ``replica_down`` flight events) even when its TCP
+  connection still accepts bytes — the wedged-process case a connection
+  error can never surface.  A stale replica that beats again (a SIGCONT
+  after a wedge) is simply re-admitted: its recovered requests already
+  completed elsewhere, and the stale wire callbacks were forgotten, so
+  resuming routing to it is safe.
+* **Fleet-level circuit breakers.**  Each replica carries a
+  :class:`~.supervise.CircuitBreaker`; consecutive infrastructure
+  failures attributed to it (connection loss, ``OverloadError``, chaos
+  crashes) quarantine it for the breaker cooldown, and the first
+  heartbeat after cooldown re-admits it.
+* **Cross-replica retry.**  In-flight requests on a dead replica are
+  recovered from the router's shadow ledger and re-dispatched to a
+  surviving replica under the shared :class:`~.supervise.RetryPolicy`:
+  attempts are bounded, backoff is exponential, exhaustion surfaces the
+  ORIGINAL infrastructure error.  Typed program-class errors
+  (``FaultError``, validation — :func:`is_infrastructure_error`) and
+  terminal request outcomes (``DeadlineError``, ``CancelledError`` /
+  ``ShutdownError``) are NEVER retried.  Every dispatch carries an
+  attempt token (mirroring :class:`~.request.RequestHandle`'s claim
+  tokens): a straggling response or failure report whose token went
+  stale is a silent no-op, so a request can never be double-completed
+  or double-retried no matter how wire callbacks interleave.
+* **Bucket affinity.**  Placement is sticky per
+  :class:`~.bucketspec.BucketSpec` coalescing template: a bucket's home
+  replica keeps its jit/AOT caches hot, exactly like the per-device
+  sticky-bucket map inside the service; ties break to the least-loaded
+  live replica by gossiped est_wait / queue depth.
+
+The router owns no execution and no devices — it is restartable state:
+everything here rebuilds from replicas' gossip within one interval.
+"""
+
+from __future__ import annotations
+
+import collections
+import heapq
+import threading
+import time
+
+import numpy as np
+
+from ..sim.interpreter import is_infrastructure_error
+from ..utils import profiling
+from ..obs import FlightRecorder, Histogram
+from .. import isa
+from .batcher import bucket_key
+from .request import (CancelledError, DeadlineError, RequestHandle,
+                      ServiceClosedError, ShutdownError)
+from .supervise import CircuitBreaker, RetryPolicy
+from .transport import ReplicaClient, ReplicaLostError
+
+ROUTER_THREAD_PREFIX = 'dproc-serve-fleet'
+
+
+def is_terminal_error(exc: BaseException) -> bool:
+    """True when a failed attempt must surface to the caller instead of
+    retrying on another replica: program-class errors reproduce
+    anywhere (:func:`is_infrastructure_error` False), and expired
+    deadlines / cancellations are properties of the REQUEST's clock —
+    infrastructure-class by taxonomy, but re-execution cannot
+    un-expire them."""
+    return (not is_infrastructure_error(exc)
+            or isinstance(exc, (DeadlineError, CancelledError)))
+
+
+class _FleetRequest:
+    """Router-side shadow of one submission: everything needed to
+    re-dispatch it on another replica (the full payload), plus the
+    retry ledger.  ``attempts`` doubles as the attempt token — each
+    dispatch bumps it, and response/failure handlers that present a
+    stale ``(rid, token)`` pair are dropped."""
+
+    __slots__ = ('handle', 'op', 'payload', 'key', 'attempts',
+                 'first_error', 'excluded', 'submit_t', 'rid',
+                 'wire_id', 'done')
+
+    def __init__(self, op, payload, key):
+        self.handle = RequestHandle()
+        self.op = op
+        self.payload = payload
+        self.key = key
+        self.attempts = 0           # executions started == token
+        self.first_error = None     # original infra error, kept for
+        self.excluded = set()       # exhaustion (RetryPolicy rule)
+        self.submit_t = time.monotonic()
+        self.rid = None             # replica of the CURRENT attempt
+        self.wire_id = None
+        self.done = False
+
+
+class _Replica:
+    __slots__ = ('rid', 'client', 'breaker', 'alive', 'quarantined',
+                 'last_beat', 'digest', 'inflight', 'gossip_pending')
+
+    def __init__(self, rid, client, breaker):
+        self.rid = rid
+        self.client = client
+        self.breaker = breaker
+        self.alive = True
+        self.quarantined = False
+        self.last_beat = time.monotonic()
+        self.digest = {}
+        self.inflight = {}          # wire_id -> (_FleetRequest, token)
+        self.gossip_pending = False
+
+    def routable(self) -> bool:
+        return self.alive and not self.quarantined \
+            and self.client is not None and self.client.alive
+
+    def load(self) -> tuple:
+        # gossiped load: est_wait (None sorts as 0) then queue depth
+        ew = self.digest.get('est_wait_ms') or 0.0
+        return (float(ew), int(self.digest.get('queue_depth') or 0),
+                len(self.inflight))
+
+
+class FleetRouter:
+    """Load-balancing, self-healing front door over replica clients.
+
+    Replicas register through :meth:`add_replica` (the
+    :class:`~.fleet.Fleet` process manager calls it at spawn and
+    respawn); ``submit``/``submit_source`` mirror the service's
+    signatures and return local :class:`RequestHandle`\\ s fulfilled
+    from wire responses.  ``shutdown`` fails everything still pending
+    with :class:`ShutdownError` — after it returns no handle can block
+    forever, same contract as the service.
+    """
+
+    def __init__(self, *, default_cfg=None, retry_policy=None,
+                 gossip_interval_ms: float = 25.0,
+                 liveness_window_ms: float = 250.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_ms: float = 500.0,
+                 name: str = None, flight_events: int = 512):
+        if liveness_window_ms <= gossip_interval_ms:
+            raise ValueError('liveness window must exceed the gossip '
+                             'interval (one missed beat is not death)')
+        self.name = name or 'fleet'
+        self._default_cfg = default_cfg
+        self._retry_policy = retry_policy or RetryPolicy()
+        self._gossip_interval_s = gossip_interval_ms / 1e3
+        self._liveness_window_s = liveness_window_ms / 1e3
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = breaker_cooldown_ms / 1e3
+        self.flight_recorder = FlightRecorder(flight_events)
+        self._latency_h = Histogram('fleet.latency_ms')
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._replicas: dict = {}       # rid -> _Replica
+        self._home: dict = {}           # bucket identity -> rid
+        self._pending: list = []        # heap of (eligible_t, seq, freq)
+        self._pending_seq = 0
+        self._closing = False
+        # counters (written under _lock)
+        self._submitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._retries = 0
+        self._retry_exhausted = 0
+        self._failovers = 0             # requests recovered off a dead
+        self._replica_down = 0          # replica and re-queued
+        self._replica_up = 0
+        self._gossip_stale = 0
+        self._breaker_trips = 0
+        self._readmissions = 0
+        self._gossip_thread = threading.Thread(
+            target=self._gossip_loop,
+            name=f'{ROUTER_THREAD_PREFIX}-gossip-{self.name}',
+            daemon=True)
+        self._retry_thread = threading.Thread(
+            target=self._retry_loop,
+            name=f'{ROUTER_THREAD_PREFIX}-retry-{self.name}',
+            daemon=True)
+        self._gossip_thread.start()
+        self._retry_thread.start()
+
+    # -- replica membership ---------------------------------------------
+
+    def add_replica(self, rid: str, address) -> None:
+        """Connect to (or reconnect to a respawned) replica at
+        ``address`` and start routing to it."""
+        client = ReplicaClient(
+            address,
+            # late-bound `client`: the loss guard must name the exact
+            # connection that died, so a replaced client's death can
+            # never take down its successor
+            on_lost=lambda exc: self._replica_lost(rid, exc,
+                                                   via=client))
+        with self._lock:
+            old = self._replicas.get(rid)
+        if old is not None and old.alive:
+            # replacing a live replica: fail its in-flight work over
+            # first so nothing is silently dropped
+            self._replica_lost(rid, ReplicaLostError(f'{rid} replaced'))
+        with self._lock:
+            old = self._replicas.get(rid)
+            self._replicas[rid] = _Replica(
+                rid, client,
+                CircuitBreaker(self._breaker_threshold,
+                               self._breaker_cooldown_s))
+            self._replica_up += 1
+            self._cv.notify_all()
+        if old is not None and old.client is not None:
+            old.client.close()
+        profiling.counter_inc('fleet.replica_up')
+        self.flight_recorder.record('replica_up', rid=rid,
+                                    address=list(address))
+
+    def remove_replica(self, rid: str) -> None:
+        """Forget a replica (fleet scale-down): in-flight work fails
+        over exactly as if it died."""
+        self._replica_lost(rid, ReplicaLostError(f'{rid} removed'))
+        with self._lock:
+            rep = self._replicas.pop(rid, None)
+        if rep is not None and rep.client is not None:
+            rep.client.close()
+
+    def replica_ids(self) -> list:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def primary_replica(self):
+        """The routable replica carrying the most load right now
+        (in-flight wire requests, then gossiped queue depth, then home
+        buckets) — chaos tooling kills THIS one so the fault always
+        lands on the serving path, even when bucket affinity has
+        pinned a single-bucket workload to one home."""
+        with self._lock:
+            homes = collections.Counter(self._home.values())
+            live = [r for r in self._replicas.values()
+                    if r.routable()]
+            if not live:
+                return None
+            best = max(live, key=lambda r: (
+                len(r.inflight),
+                int(r.digest.get('queue_depth') or 0),
+                homes[r.rid], r.rid))
+            return best.rid
+
+    def call_replica(self, rid: str, op: str = 'stats', payload=None,
+                     timeout_s: float = 30.0):
+        """Synchronous wire call to ONE specific replica (fleet tests
+        and tooling inspect individual replicas this way — e.g. the
+        warmed-respawn assertion reads the new replica's compile
+        counters directly)."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            client = rep.client if rep is not None else None
+        if client is None:
+            raise KeyError(f'unknown replica {rid!r}')
+        return client.call(op, payload or {}, timeout_s=timeout_s)
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, mp, meas_bits=None, *, shots: int = None,
+               init_regs=None, cfg=None, priority: int = 0,
+               deadline_ms: float = None,
+               fault_mode: str = None) -> RequestHandle:
+        payload = dict(mp=mp, meas_bits=meas_bits, shots=shots,
+                       init_regs=init_regs,
+                       cfg=cfg if cfg is not None else self._default_cfg,
+                       priority=priority, deadline_ms=deadline_ms,
+                       fault_mode=fault_mode)
+        return self._enqueue('submit', payload,
+                             self._affinity_key(mp, payload['cfg']))
+
+    def submit_source(self, program, qchip, *, shots: int = None,
+                      meas_bits=None, init_regs=None, cfg=None,
+                      priority: int = 0, deadline_ms: float = None,
+                      fault_mode: str = None, n_qubits: int = 8,
+                      pad_to: int = None) -> RequestHandle:
+        payload = dict(program=program, qchip=qchip, shots=shots,
+                       meas_bits=meas_bits, init_regs=init_regs,
+                       cfg=cfg if cfg is not None else self._default_cfg,
+                       priority=priority, deadline_ms=deadline_ms,
+                       fault_mode=fault_mode, n_qubits=n_qubits,
+                       pad_to=pad_to)
+        # no machine program yet, so no bucket: least-loaded placement
+        return self._enqueue('submit_source', payload, None)
+
+    def _affinity_key(self, mp, cfg):
+        """The bucket-affinity identity: the same unbound BucketSpec
+        template the replica's coalescer will key on.  Any failure to
+        compute it (odd cfg, validation the replica will surface typed)
+        degrades to least-loaded placement, never an error."""
+        try:
+            from .service import _normalize_cfg
+            ncfg, _ = _normalize_cfg(cfg, isa.shape_bucket(mp.n_instr))
+            return bucket_key(mp, ncfg).identity()
+        except Exception:               # noqa: BLE001
+            return None
+
+    def _enqueue(self, op, payload, key) -> RequestHandle:
+        freq = _FleetRequest(op, payload, key)
+        with self._lock:
+            if self._closing:
+                raise ServiceClosedError(
+                    f'fleet router {self.name!r} is shut down')
+            self._submitted += 1
+        profiling.counter_inc('fleet.submitted')
+        self._dispatch(freq)
+        return freq.handle
+
+    # -- placement / dispatch -------------------------------------------
+
+    def _place_locked(self, freq):
+        live = [r for r in self._replicas.values() if r.routable()]
+        candidates = [r for r in live if r.rid not in freq.excluded] \
+            or live                     # all excluded: any live one
+        if not candidates:
+            return None
+        if freq.key is not None:
+            home = self._home.get(freq.key)
+            for r in candidates:
+                if r.rid == home:
+                    return r
+        best = min(candidates, key=lambda r: (r.load(), r.rid))
+        if freq.key is not None:
+            self._home[freq.key] = best.rid
+        return best
+
+    def _dispatch(self, freq) -> None:
+        """Place and send one request; parks it (the retry pump re-tries
+        placement) when no replica is routable right now."""
+        with self._lock:
+            if freq.done:
+                return
+            if self._closing:
+                self._fail_locked(freq, ShutdownError(
+                    f'fleet router {self.name!r} is shut down'))
+                return
+            rep = self._place_locked(freq)
+            if rep is None:
+                self._park_locked(freq, time.monotonic() + 0.02)
+                return
+            freq.attempts += 1
+            token = freq.attempts
+            freq.rid = rep.rid
+            freq.wire_id = None
+            client = rep.client
+        try:
+            wire_id = client.call_async(
+                freq.op, freq.payload,
+                lambda ok, resp: self._on_response(
+                    freq, rep.rid, token, ok, resp))
+        except ReplicaLostError as exc:
+            # the send failed (the client's loss path may have already
+            # routed this attempt through _on_response — the token
+            # guard makes this call a no-op in that case)
+            self._attempt_failed(freq, rep.rid, token, exc)
+            return
+        with self._lock:
+            r = self._replicas.get(rep.rid)
+            if (not freq.done and freq.attempts == token
+                    and freq.rid == rep.rid
+                    and r is not None and r.client is client):
+                freq.wire_id = wire_id
+                r.inflight[wire_id] = (freq, token)
+
+    def _park_locked(self, freq, eligible_t: float) -> None:
+        self._pending_seq += 1
+        heapq.heappush(self._pending,
+                       (eligible_t, self._pending_seq, freq))
+        self._cv.notify_all()
+
+    # -- responses / failures -------------------------------------------
+
+    def _stale(self, freq, rid, token) -> bool:
+        # caller holds _lock: a report about attempt `token` on `rid`
+        # is stale once the request completed, moved on to another
+        # attempt, or was already failed-over off this replica
+        return freq.done or freq.attempts != token or freq.rid != rid
+
+    def _on_response(self, freq, rid, token, ok, payload) -> None:
+        with self._lock:
+            if self._stale(freq, rid, token):
+                return
+            rep = self._replicas.get(rid)
+            if rep is not None and freq.wire_id is not None:
+                rep.inflight.pop(freq.wire_id, None)
+            if ok:
+                freq.done = True
+                self._completed += 1
+                if rep is not None:
+                    rep.breaker.record_success()
+                lat_ms = (time.monotonic() - freq.submit_t) * 1e3
+        if ok:
+            self._latency_h.observe(lat_ms)
+            profiling.counter_inc('fleet.completed')
+            freq.handle._fulfill(payload)
+            return
+        if is_terminal_error(payload):
+            with self._lock:
+                self._fail_locked(freq, payload)
+            return
+        self._attempt_failed(freq, rid, token, payload)
+
+    def _fail_locked(self, freq, exc) -> None:
+        if freq.done:
+            return
+        freq.done = True
+        self._failed += 1
+        profiling.counter_inc('fleet.failed')
+        freq.handle._fail(exc)
+
+    def _attempt_failed(self, freq, rid, token, exc) -> None:
+        """One infrastructure-class attempt failure: breaker
+        bookkeeping on the replica, then retry-or-exhaust under the
+        fleet RetryPolicy."""
+        with self._lock:
+            if self._stale(freq, rid, token):
+                return
+            if freq.first_error is None:
+                freq.first_error = exc
+            freq.excluded.add(rid)
+            freq.rid = None
+            freq.wire_id = None
+            exhausted = freq.attempts >= self._retry_policy.max_attempts
+            if exhausted:
+                self._retry_exhausted += 1
+                # exhaustion surfaces the ORIGINAL error, same rule as
+                # the in-process retry path
+                self._fail_locked(freq, freq.first_error)
+            else:
+                self._retries += 1
+                self._park_locked(
+                    freq, time.monotonic()
+                    + self._retry_policy.delay_s(freq.attempts - 1))
+        self._record_replica_failure(rid, exc)
+        if exhausted:
+            profiling.counter_inc('fleet.retry_exhausted')
+        else:
+            profiling.counter_inc('fleet.retries')
+            self.flight_recorder.record(
+                'fleet_retry', rid=rid, error=type(exc).__name__,
+                attempt=token)
+
+    def _record_replica_failure(self, rid, exc) -> None:
+        trip = False
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return
+            if rep.breaker.record_failure() and not rep.quarantined:
+                rep.quarantined = True
+                rep.breaker.trip(time.monotonic())
+                self._breaker_trips += 1
+                trip = True
+        if trip:
+            profiling.counter_inc('fleet.breaker_trips')
+            self.flight_recorder.record(
+                'fleet_breaker_trip', rid=rid,
+                error=type(exc).__name__)
+
+    def _replica_lost(self, rid, exc, via=None) -> None:
+        """Connection death or gossip staleness: declare the replica
+        down, recover every in-flight request it held, and retry each
+        on a surviving replica.  ``via`` (a ReplicaClient) scopes the
+        report to one specific connection — a replaced client's death
+        must not take down its successor."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None or not rep.alive \
+                    or (via is not None and rep.client is not via):
+                return
+            rep.alive = False
+            self._replica_down += 1
+            recovered = list(rep.inflight.items())
+            rep.inflight.clear()
+            # re-home this replica's buckets on next placement
+            for key in [k for k, r in self._home.items() if r == rid]:
+                del self._home[key]
+            self._failovers += len(recovered)
+            client = rep.client
+        profiling.counter_inc('fleet.replica_down')
+        self.flight_recorder.record(
+            'replica_down', rid=rid, reason=type(exc).__name__,
+            recovered=len(recovered))
+        for wire_id, (freq, token) in recovered:
+            # a straggler response for this wire id must not complete
+            # the handle after the retry lands elsewhere
+            if client is not None:
+                client.forget(wire_id)
+            profiling.counter_inc('fleet.failover')
+            self._attempt_failed(freq, rid, token, exc)
+
+    # -- gossip ----------------------------------------------------------
+
+    def _gossip_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._closing:
+                    return
+                reps = list(self._replicas.values())
+            for rep in reps:
+                client = rep.client
+                if client is None or not client.alive \
+                        or rep.gossip_pending:
+                    continue
+                rep.gossip_pending = True
+                try:
+                    client.call_async(
+                        'stats', {},
+                        lambda ok, resp, rep=rep: self._on_gossip(
+                            rep.rid, ok, resp))
+                except ReplicaLostError:
+                    rep.gossip_pending = False
+            self._check_staleness(time.monotonic())
+            with self._cv:
+                if self._closing:
+                    return
+                self._cv.wait(self._gossip_interval_s)
+
+    def _on_gossip(self, rid, ok, resp) -> None:
+        recovered = readmitted = False
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return
+            rep.gossip_pending = False
+            if not ok:
+                return
+            rep.last_beat = time.monotonic()
+            rep.digest = {
+                'queue_depth': resp.get('queue_depth'),
+                'est_wait_ms': resp.get('est_wait_ms'),
+                'health': resp.get('health'),
+                'completed': resp.get('completed'),
+            }
+            if not rep.alive:
+                # a wedged replica resumed (SIGCONT): its connection
+                # never died, its heartbeat just went stale; its
+                # recovered requests completed elsewhere and their
+                # wire callbacks were forgotten, so routing to it
+                # again is safe
+                rep.alive = True
+                self._replica_up += 1
+                recovered = True
+            if rep.quarantined and rep.breaker.ready_to_probe(
+                    time.monotonic()):
+                rep.quarantined = False
+                rep.breaker.readmit()
+                self._readmissions += 1
+                readmitted = True
+        if recovered:
+            profiling.counter_inc('fleet.replica_up')
+            self.flight_recorder.record('replica_up', rid=rid,
+                                        reason='heartbeat-recovered')
+        if readmitted:
+            profiling.counter_inc('fleet.readmissions')
+            self.flight_recorder.record('fleet_readmit', rid=rid)
+
+    def _check_staleness(self, now: float) -> None:
+        stale = []
+        with self._lock:
+            for rep in self._replicas.values():
+                if rep.alive and rep.client is not None \
+                        and rep.client.alive \
+                        and now - rep.last_beat \
+                        > self._liveness_window_s:
+                    stale.append(rep.rid)
+        for rid in stale:
+            self._gossip_stale += 1
+            profiling.counter_inc('fleet.gossip_stale')
+            self.flight_recorder.record('gossip_stale', rid=rid)
+            self._replica_lost(rid, ReplicaLostError(
+                f'{rid} heartbeat stale (> '
+                f'{self._liveness_window_s * 1e3:.0f} ms)'))
+
+    # -- retry pump ------------------------------------------------------
+
+    def _retry_loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._closing:
+                    return
+                now = time.monotonic()
+                if not self._pending:
+                    self._cv.wait(0.1)
+                    continue
+                eligible_t, _seq, freq = self._pending[0]
+                if eligible_t > now:
+                    self._cv.wait(min(eligible_t - now, 0.1))
+                    continue
+                heapq.heappop(self._pending)
+            self._dispatch(freq)
+
+    # -- introspection / shutdown ---------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            replicas = {
+                rid: {
+                    'alive': rep.alive,
+                    'quarantined': rep.quarantined,
+                    'routable': rep.routable(),
+                    'heartbeat_age_ms': (now - rep.last_beat) * 1e3,
+                    'inflight': len(rep.inflight),
+                    'breaker': rep.breaker.snapshot(),
+                    'digest': dict(rep.digest),
+                } for rid, rep in sorted(self._replicas.items())}
+            snap = {
+                'replicas': replicas,
+                'n_replicas': len(self._replicas),
+                'n_routable': sum(1 for r in self._replicas.values()
+                                  if r.routable()),
+                'submitted': self._submitted,
+                'completed': self._completed,
+                'failed': self._failed,
+                'parked': len(self._pending),
+                'retries': self._retries,
+                'retry_exhausted': self._retry_exhausted,
+                'failovers': self._failovers,
+                'replica_down': self._replica_down,
+                'replica_up': self._replica_up,
+                'gossip_stale': self._gossip_stale,
+                'breaker_trips': self._breaker_trips,
+                'readmissions': self._readmissions,
+                'home_buckets': len(self._home),
+            }
+        lat = np.asarray(self._latency_h.values(), np.float64)
+        if lat.size:
+            snap['latency_p50_ms'] = float(np.percentile(lat, 50))
+            snap['latency_p99_ms'] = float(np.percentile(lat, 99))
+        else:
+            snap['latency_p50_ms'] = snap['latency_p99_ms'] = 0.0
+        snap['latency_samples'] = int(lat.size)
+        reg = profiling.registry()
+        reg.set_gauge(f'fleet.{self.name}.n_routable',
+                      snap['n_routable'])
+        reg.set_gauge(f'fleet.{self.name}.parked', snap['parked'])
+        return snap
+
+    def shutdown(self) -> None:
+        """Stop routing: fail every parked and in-flight request with
+        :class:`ShutdownError`, close every client, join the gossip and
+        retry threads.  Idempotent."""
+        with self._cv:
+            already = self._closing
+            self._closing = True
+            self._cv.notify_all()
+        self._join_threads()
+        if already:
+            return
+        with self._lock:
+            doomed = [f for _, _, f in self._pending]
+            self._pending.clear()
+            for rep in self._replicas.values():
+                doomed.extend(f for f, _tok in rep.inflight.values())
+                rep.inflight.clear()
+            clients = [rep.client for rep in self._replicas.values()
+                       if rep.client is not None]
+        err = ShutdownError(f'fleet router {self.name!r} shut down')
+        with self._lock:
+            for freq in doomed:
+                self._fail_locked(freq, err)
+        for client in clients:
+            client.close()
+
+    def _join_threads(self) -> None:
+        for t in (self._gossip_thread, self._retry_thread):
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.shutdown()

@@ -1,0 +1,354 @@
+"""Fleet wire protocol: framed-pickle RPC between router and replicas.
+
+The fleet tier (docs/FLEET.md) runs N :class:`~.service.ExecutionService`
+replicas as separate OS processes; this module is the only thing that
+crosses the process boundary.  The protocol is deliberately minimal —
+length-prefixed pickle frames over a localhost TCP socket (the same
+wire works across hosts), request-id multiplexed so ONE connection
+carries many in-flight submissions:
+
+    client -> server   (req_id, op, payload)
+    server -> client   (req_id, ok: bool, payload)
+
+``op`` is one of ``submit`` / ``submit_source`` / ``stats`` / ``ping``
+/ ``shutdown``.  A ``submit`` gets exactly one response — sent when the
+request RESOLVES, so admission errors (``QueueFullError``,
+``OverloadError``), typed program failures (``FaultError``, validation)
+and results all ride the same frame, preserving the
+:func:`~..sim.interpreter.is_infrastructure_error` taxonomy across the
+wire: both sides share this codebase, so exceptions pickle as their
+real types and the router can re-apply the retry rules the in-process
+supervision layer uses.
+
+Server side, submissions are enqueued into the service from the
+connection's reader thread (``ExecutionService.submit`` never blocks on
+execution) and a small waiter pool sends each response when its handle
+resolves — a slow batch never stalls the connection.  Client side, a
+reader thread demultiplexes responses to per-request callbacks; a dead
+connection fails every pending callback with :class:`ReplicaLostError`
+(a plain RuntimeError: infrastructure-class, hence retryable at the
+fleet level) and fires ``on_lost`` exactly once.
+
+All threads carry the ``dproc-serve`` name prefix, so the conftest
+thread-leak probe holds this tier to the same no-leak contract as the
+service's dispatchers.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+WIRE_THREAD_PREFIX = 'dproc-serve-wire'
+
+_LEN = struct.Struct('>I')
+_MAX_FRAME = 1 << 29          # 512 MiB: desync/corruption guard
+
+OPS = ('submit', 'submit_source', 'stats', 'ping', 'shutdown')
+
+
+class ReplicaLostError(RuntimeError):
+    """The connection to a replica died (process SIGKILLed, socket
+    closed, unreadable frame) with requests still in flight.
+    Deliberately a plain RuntimeError so
+    :func:`~..sim.interpreter.is_infrastructure_error` classifies it
+    retryable — replica loss is the fleet-level analog of an executor
+    crash."""
+
+
+def send_frame(sock: socket.socket, obj, lock: threading.Lock) -> None:
+    """Pickle ``obj`` and write one length-prefixed frame.  ``lock``
+    serializes concurrent writers (responses from the waiter pool
+    interleave with reader-thread error replies)."""
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    with lock:
+        sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_frame(sock: socket.socket):
+    """Read one frame; raises ConnectionError on EOF/desync."""
+    head = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(head)
+    if n > _MAX_FRAME:
+        raise ConnectionError(f'frame of {n} bytes exceeds wire bound')
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b''
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError('connection closed mid-frame')
+        buf += chunk
+    return buf
+
+
+def _picklable_error(exc: BaseException) -> BaseException:
+    """The error as it will cross the wire: the exception itself when
+    it pickle-round-trips, else a RuntimeError carrying its type name
+    (still infrastructure-class — an unpicklable error is by
+    construction not one of the typed program-class failures, which
+    all round-trip; tests pin FaultError/ProgramValidationError)."""
+    try:
+        pickle.loads(pickle.dumps(exc))
+        return exc
+    except Exception:
+        return RuntimeError(f'{type(exc).__name__}: {exc}')
+
+
+class ReplicaServer:
+    """Serves one :class:`ExecutionService` over the fleet wire.
+
+    ``on_shutdown`` (optional) runs when a ``shutdown`` op arrives —
+    the replica main loop uses it to exit.  ``close()`` stops
+    accepting, closes every connection and joins every wire thread; it
+    does NOT shut the service down (the owner does).
+    """
+
+    def __init__(self, svc, host: str = '127.0.0.1', port: int = 0,
+                 max_waiters: int = 32, on_shutdown=None):
+        self._svc = svc
+        self._on_shutdown = on_shutdown
+        self._closing = False
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_waiters,
+            thread_name_prefix=f'{WIRE_THREAD_PREFIX}-wait')
+        self._sock = socket.create_server((host, port))
+        self.address = self._sock.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop,
+            name=f'{WIRE_THREAD_PREFIX}-accept', daemon=True)
+        self._accept_thread.start()
+
+    # -- accept / per-connection ----------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return                     # closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                if self._closing:
+                    conn.close()
+                    return
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name=f'{WIRE_THREAD_PREFIX}-conn', daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+        try:
+            while True:
+                req_id, op, payload = recv_frame(conn)
+                self._dispatch(conn, wlock, req_id, op, payload)
+        except (ConnectionError, OSError, EOFError,
+                pickle.UnpicklingError):
+            pass                           # router went away
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, wlock, req_id, op, payload) -> None:
+        try:
+            if op == 'submit':
+                handle = self._svc.submit(**payload)
+                self._pool.submit(self._send_on_resolve, conn, wlock,
+                                  req_id, handle)
+                return
+            if op == 'submit_source':
+                handle = self._svc.submit_source(**payload)
+                self._pool.submit(self._send_on_resolve, conn, wlock,
+                                  req_id, handle)
+                return
+            if op == 'stats':
+                self._reply(conn, wlock, req_id, True,
+                            self._svc.stats())
+                return
+            if op == 'ping':
+                self._reply(conn, wlock, req_id, True, {'pong': True})
+                return
+            if op == 'shutdown':
+                self._reply(conn, wlock, req_id, True, {'bye': True})
+                if self._on_shutdown is not None:
+                    self._on_shutdown()
+                return
+            raise ValueError(f'unknown wire op {op!r}')
+        except BaseException as exc:       # noqa: BLE001 - typed reply
+            self._reply(conn, wlock, req_id, False,
+                        _picklable_error(exc))
+
+    def _send_on_resolve(self, conn, wlock, req_id, handle) -> None:
+        # blocks until the service resolves the handle: shutdown
+        # force-fails every unresolved handle, so this always returns
+        try:
+            exc = handle.exception(timeout=None)
+        except BaseException as exc2:      # noqa: BLE001
+            exc = exc2
+        try:
+            if exc is None:
+                self._reply(conn, wlock, req_id, True, handle.result())
+            else:
+                self._reply(conn, wlock, req_id, False,
+                            _picklable_error(exc))
+        except (ConnectionError, OSError):
+            pass                           # router gone: drop response
+
+    @staticmethod
+    def _reply(conn, wlock, req_id, ok, payload) -> None:
+        send_frame(conn, (req_id, ok, payload), wlock)
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._pool.shutdown(wait=True)
+        self._accept_thread.join(timeout=5.0)
+
+
+class ReplicaClient:
+    """Router-side end of one replica connection.
+
+    ``call_async(op, payload, on_done)`` sends a frame and returns its
+    request id; ``on_done(ok, payload)`` fires from the reader thread
+    when the response lands.  ``forget(req_id)`` drops a pending
+    callback — the router's failover path uses it so a straggler
+    response from a replica that was declared dead (and whose request
+    was retried elsewhere) is discarded, the wire-level mirror of the
+    handle's stale-attempt-token rule.  When the connection dies, every
+    pending callback fails with :class:`ReplicaLostError` and
+    ``on_lost(exc)`` fires exactly once.
+    """
+
+    def __init__(self, address, *, connect_timeout_s: float = 10.0,
+                 on_lost=None):
+        self.address = tuple(address)
+        self._on_lost = on_lost
+        self._sock = socket.create_connection(
+            self.address, timeout=connect_timeout_s)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: dict = {}           # req_id -> on_done
+        self._ids = itertools.count(1)
+        self._lost = None                  # the ReplicaLostError, once
+        self._reader = threading.Thread(
+            target=self._read_loop,
+            name=f'{WIRE_THREAD_PREFIX}-client', daemon=True)
+        self._reader.start()
+
+    @property
+    def alive(self) -> bool:
+        return self._lost is None
+
+    def call_async(self, op: str, payload, on_done) -> int:
+        with self._plock:
+            if self._lost is not None:
+                raise ReplicaLostError(
+                    f'replica {self.address} lost: {self._lost}')
+            req_id = next(self._ids)
+            self._pending[req_id] = on_done
+        try:
+            send_frame(self._sock, (req_id, op, payload), self._wlock)
+        except (OSError, ConnectionError) as exc:
+            self._fail_all(exc)
+            raise ReplicaLostError(
+                f'replica {self.address} lost: {exc}') from exc
+        return req_id
+
+    def call(self, op: str, payload=None, timeout_s: float = 30.0):
+        """Synchronous round trip; raises the remote error, or
+        :class:`ReplicaLostError`/:class:`TimeoutError`."""
+        ev = threading.Event()
+        box = {}
+
+        def done(ok, resp):
+            box['ok'], box['resp'] = ok, resp
+            ev.set()
+
+        req_id = self.call_async(op, payload or {}, done)
+        if not ev.wait(timeout_s):
+            self.forget(req_id)
+            raise TimeoutError(
+                f'{op} to replica {self.address} timed out '
+                f'({timeout_s}s)')
+        if not box['ok']:
+            raise box['resp']
+        return box['resp']
+
+    def forget(self, req_id: int) -> bool:
+        """Drop the pending callback; True when it was still pending
+        (a response arriving later is silently discarded)."""
+        with self._plock:
+            return self._pending.pop(req_id, None) is not None
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                req_id, ok, payload = recv_frame(self._sock)
+                with self._plock:
+                    on_done = self._pending.pop(req_id, None)
+                if on_done is not None:
+                    on_done(ok, payload)
+        except (ConnectionError, OSError, EOFError,
+                pickle.UnpicklingError) as exc:
+            self._fail_all(exc)
+
+    def _fail_all(self, cause) -> None:
+        with self._plock:
+            if self._lost is not None:
+                return
+            self._lost = cause
+            pending = list(self._pending.items())
+            self._pending.clear()
+        err = ReplicaLostError(
+            f'replica {self.address} lost: {cause}')
+        for _req_id, on_done in pending:
+            try:
+                on_done(False, err)
+            except Exception:              # noqa: BLE001
+                pass                       # callbacks must not kill IO
+        if self._on_lost is not None:
+            cb, self._on_lost = self._on_lost, None
+            try:
+                cb(err)
+            except Exception:              # noqa: BLE001
+                pass
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._reader.join(timeout=5.0)

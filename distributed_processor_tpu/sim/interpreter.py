@@ -40,8 +40,10 @@ bits from demodulated waveforms when physics-in-the-loop is wanted.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import functools
+import os
 import threading
 import time
 from dataclasses import dataclass, replace
@@ -130,6 +132,12 @@ class FaultError(RuntimeError):
         parts = [f'{name}={int(n)}'
                  for (name, _), n in zip(FAULT_CODES, self.counts) if n]
         super().__init__('faulted shots: ' + (', '.join(parts) or 'none'))
+
+    def __reduce__(self):
+        # default exception pickling replays __init__ with the MESSAGE
+        # as counts; rebuild from the counts array instead so the error
+        # crosses the fleet wire (serve/transport.py) intact
+        return (FaultError, (self.counts,))
 
 
 def is_infrastructure_error(exc: BaseException) -> bool:
@@ -2707,7 +2715,33 @@ def _run_multi_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
 # fault word included).
 
 _AOT_LOCK = threading.Lock()
-_AOT_CACHE: dict = {}     # _aot_cache_key(...) -> jax.stages.Compiled
+# _aot_cache_key(...) -> jax.stages.Compiled, least-recently-USED
+# first.  Bounded: a long-lived replica serving diverse traffic would
+# otherwise pin every executable it ever compiled (each holds device
+# buffers for its constants) — evictions cost a recompile on the next
+# dispatch of that bucket, never correctness.  The named counter
+# 'aot_evictions' counts them (aot_eviction_count()).
+_AOT_CACHE: collections.OrderedDict = collections.OrderedDict()
+_AOT_CACHE_CAP = int(os.environ.get('DPROC_AOT_CACHE_CAP', '256'))
+
+
+def set_aot_cache_cap(cap: int) -> int:
+    """Set the AOT executable cache bound (``DPROC_AOT_CACHE_CAP``
+    gives the process default); returns the previous cap.  Lowering the
+    cap evicts immediately, oldest-used first."""
+    global _AOT_CACHE_CAP
+    if cap < 1:
+        raise ValueError('aot cache cap must be >= 1')
+    with _AOT_LOCK:
+        old, _AOT_CACHE_CAP = _AOT_CACHE_CAP, cap
+        _evict_aot_locked()
+    return old
+
+
+def _evict_aot_locked() -> None:
+    while len(_AOT_CACHE) > _AOT_CACHE_CAP:
+        _AOT_CACHE.popitem(last=False)
+        counter_inc('aot_evictions')
 
 
 def _aot_cache_key(P, B, C, N, E, max_meas, cfg, traits, device):
@@ -2751,6 +2785,7 @@ def aot_compile_batch(spec, jax_device=None) -> float:
                          jax_device)
     with _AOT_LOCK:
         if key in _AOT_CACHE:
+            _AOT_CACHE.move_to_end(key)
             return 0.0
     sds = jax.ShapeDtypeStruct
     soa = sds((P, C, N, len(_FIELDS)), jnp.int32)
@@ -2770,14 +2805,20 @@ def aot_compile_batch(spec, jax_device=None) -> float:
     with _AOT_LOCK:
         # keep the first on a race — callers treat dt as "work done"
         _AOT_CACHE.setdefault(key, compiled)
+        _AOT_CACHE.move_to_end(key)
+        _evict_aot_locked()
     counter_inc('aot_compile')
     return dt
 
 
 def _aot_lookup(P, B, C, N, E, max_meas, cfg, traits, device):
     with _AOT_LOCK:
-        return _AOT_CACHE.get(
-            _aot_cache_key(P, B, C, N, E, max_meas, cfg, traits, device))
+        key = _aot_cache_key(P, B, C, N, E, max_meas, cfg, traits,
+                             device)
+        compiled = _AOT_CACHE.get(key)
+        if compiled is not None:
+            _AOT_CACHE.move_to_end(key)
+        return compiled
 
 
 def aot_batch_cached(spec, jax_device=None) -> bool:
@@ -2823,6 +2864,12 @@ def aot_compile_count() -> int:
     counter ``'aot_compile'``); ``'aot_hit'`` counts dispatches served
     by one."""
     return counter_get('aot_compile')
+
+
+def aot_eviction_count() -> int:
+    """How many executables the LRU bound has evicted in this process
+    (named counter ``'aot_evictions'``)."""
+    return counter_get('aot_evictions')
 
 
 def span_trace_count() -> int:

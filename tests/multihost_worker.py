@@ -29,8 +29,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 from distributed_processor_tpu.parallel.multihost import (
     initialize_multihost, make_global_mesh, host_local_batch,
-    global_shot_array)
-from distributed_processor_tpu.parallel import sweep_stats
+    host_local_mesh, dp_row_offset, cross_host_sum)
+from distributed_processor_tpu.parallel import (
+    sweep_stat_sums, sharded_physics_stat_sums)
 from distributed_processor_tpu.pipeline import compile_to_machine
 from distributed_processor_tpu.models import active_reset, make_default_qchip
 from distributed_processor_tpu.sim.interpreter import InterpreterConfig
@@ -49,20 +50,35 @@ def main():
     rng = np.random.default_rng(7)            # same stream on every host
     bits = rng.integers(0, 2, size=(shots, mp.n_cores, cfg.max_meas))
 
+    # the GLOBAL mesh carries topology (which dp rows are ours); the
+    # COMPUTE runs on a host-local mesh — the CPU backend refuses
+    # multiprocess jit computations, and a TPU pod would simply use
+    # sweep_stats on the global mesh instead.  Exact integer partial
+    # sums cross DCN through the coordination-service KV store in
+    # deterministic process order, so both controllers (and the
+    # single-process reference) agree bit-for-bit.
     mesh = make_global_mesh()
     local_shots, offset = host_local_batch(mesh, shots)
-    gbits = global_shot_array(mesh, bits[offset:offset + local_shots],
-                              bits.shape)
-    stats = sweep_stats(mp, gbits, mesh, cfg=cfg)
+    lmesh = host_local_mesh()
+    sums = cross_host_sum('sweep', sweep_stat_sums(
+        mp, bits[offset:offset + local_shots], lmesh, cfg=cfg))
+    stats = dict(mean_pulses=sums['pulse_sum'] / shots,
+                 err_rate=sums['err_shots'] / shots,
+                 mean_qclk=sums['qclk_sum'] / shots)
 
     # physics-closed execution across both controllers: every dp shard
     # runs its own epoch loop (synthesis -> demod -> branch resolution)
-    # on local devices; statistics cross DCN only in the final psum
-    from distributed_processor_tpu.parallel import sharded_physics_stats
+    # on local devices; dp_offset places this host's shards on the
+    # global dp grid so per-shard noise keys match the single-process
+    # dp=8 run, and only the final integer sum crosses DCN
     from distributed_processor_tpu.sim.physics import ReadoutPhysics
-    pstats = sharded_physics_stats(
-        mp, ReadoutPhysics(sigma=0.01, p1_init=1.0), 3, shots, mesh,
-        max_steps=mp.n_instr * 4 + 64, max_pulses=8, max_meas=2)
+    psums = cross_host_sum('physics', sharded_physics_stat_sums(
+        mp, ReadoutPhysics(sigma=0.01, p1_init=1.0), 3, local_shots,
+        lmesh, dp_offset=dp_row_offset(mesh),
+        max_steps=mp.n_instr * 4 + 64, max_pulses=8, max_meas=2))
+    pstats = dict(mean_pulses=psums['pulse_sum'] / shots,
+                  err_rate=psums['err_shots'] / shots,
+                  meas1_rate=psums['meas1_sum'] / shots)
 
     print(json.dumps({
         'pid': PID,

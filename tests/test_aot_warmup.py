@@ -45,7 +45,8 @@ from distributed_processor_tpu.serve import service as service_mod
 from distributed_processor_tpu.serve.service import _normalize_cfg
 from distributed_processor_tpu.sim.interpreter import (
     InterpreterConfig, aot_cache_size, aot_compile_batch,
-    clear_aot_cache, program_traits, simulate_batch)
+    aot_eviction_count, clear_aot_cache, program_traits,
+    set_aot_cache_cap, simulate_batch)
 from distributed_processor_tpu.utils import profiling
 
 pytestmark = pytest.mark.serve
@@ -392,3 +393,94 @@ def test_warmup_stats_cold_warm_split():
             and per['warm_ms_mean'] > 0.0
     finally:
         svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# AOT executable cache: LRU bound
+# ---------------------------------------------------------------------------
+
+def test_aot_cache_lru_bound():
+    """The executable cache is bounded, least-recently-USED first: a
+    long-lived replica serving diverse traffic must not pin every
+    executable it ever compiled (each holds device buffers).  Eviction
+    costs a recompile on the next dispatch of that bucket — never
+    correctness — and is counted ('aot_evictions')."""
+    clear_aot_cache()
+    mps = _ensemble(2, 2, 1, seed=17)
+    cfg = _cfg_for(mps)
+    ncfg, _ = _normalize_cfg(cfg, isa.shape_bucket(mps[0].n_instr))
+    tmpl = bucket_key(mps[0], ncfg)
+    specs = [tmpl.bind(n_programs=p, n_shots=2) for p in (1, 2, 4)]
+    old = set_aot_cache_cap(2)
+    try:
+        ev0 = aot_eviction_count()
+        assert aot_compile_batch(specs[0]) > 0
+        assert aot_compile_batch(specs[1]) > 0
+        assert aot_cache_size() == 2
+        # touch spec 0 so spec 1 becomes the LRU victim
+        assert aot_compile_batch(specs[0]) == 0.0
+        assert aot_compile_batch(specs[2]) > 0
+        assert aot_cache_size() == 2
+        assert aot_eviction_count() == ev0 + 1
+        # the recently-used executable survived; the victim recompiles
+        assert aot_compile_batch(specs[0]) == 0.0
+        assert aot_compile_batch(specs[1]) > 0
+        assert aot_eviction_count() == ev0 + 2
+        # lowering the cap evicts immediately, oldest-used first
+        set_aot_cache_cap(1)
+        assert aot_cache_size() == 1
+        assert aot_eviction_count() == ev0 + 3
+        assert aot_compile_batch(specs[1]) == 0.0   # newest survived
+        with pytest.raises(ValueError):
+            set_aot_cache_cap(0)
+    finally:
+        set_aot_cache_cap(old)
+        clear_aot_cache()
+
+
+# ---------------------------------------------------------------------------
+# catalog: concurrent writers merge, never clobber
+# ---------------------------------------------------------------------------
+
+def test_catalog_concurrent_writers_merge_not_clobber(tmp_path):
+    """Fleet replicas share ONE catalog file (the shared warm tier): a
+    write through one handle must MERGE with specs other handles wrote
+    since it last read (advisory flock + merge-on-load), never clobber
+    them — a respawn racing a peer's record would otherwise forget
+    buckets and cold-start them forever."""
+    mps = _ensemble(2, 2, 1, seed=21)
+    cfg = _cfg_for(mps)
+    ncfg, _ = _normalize_cfg(cfg, isa.shape_bucket(mps[0].n_instr))
+    tmpl = bucket_key(mps[0], ncfg)
+    path = str(tmp_path / 'shared.json')
+
+    a, b = BucketCatalog(path), BucketCatalog(path)
+    a.begin_run()
+    b.begin_run()               # b's in-memory view predates a's write
+    assert a.record(tmpl.bind(n_programs=1, n_shots=4))
+    assert b.record(tmpl.bind(n_programs=2, n_shots=4))
+    idents = {s.identity() for s in BucketCatalog(path).load()}
+    assert tmpl.bind(n_programs=1, n_shots=4).identity() in idents
+    assert tmpl.bind(n_programs=2, n_shots=4).identity() in idents
+
+    # contention: interleaved writers through distinct handles (the
+    # flock serializes across open files, in- or cross-process); every
+    # recorded spec must survive to the final on-disk state
+    handles = [BucketCatalog(path) for _ in range(4)]
+    for h in handles:
+        h.begin_run()
+
+    def write(k):
+        for p in range(1, 9):
+            handles[k].record(tmpl.bind(n_programs=p, n_shots=4 + k))
+
+    threads = [threading.Thread(target=write, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    final = {s.identity() for s in BucketCatalog(path).load()}
+    want = {tmpl.bind(n_programs=p, n_shots=4 + k).identity()
+            for k in range(4) for p in range(1, 9)}
+    assert want <= final

@@ -1,0 +1,404 @@
+"""Fleet federation under replica loss (serve/{transport,router,fleet}.py).
+
+The fleet contract, pinned here (docs/FLEET.md):
+
+* **Bit-identity across the wire**: a result served by a replica
+  PROCESS over TCP equals the solo ``simulate_batch`` run per stat —
+  federation is an availability layer, never a semantic one.
+* **Typed errors cross the wire intact**: program-class failures
+  (FaultError with its per-code counts, ProgramValidationError)
+  pickle-round-trip and are NEVER retried; infrastructure errors are.
+* **Replica loss is survivable**: SIGKILL a replica mid-flight and
+  every recovered request completes bit-identically on a survivor;
+  a SIGSTOP-wedged replica (TCP open, zero progress) is caught by
+  gossip staleness, failed over, and re-admitted on SIGCONT.
+* **Shared warm tiers**: a respawned replica replays the shared
+  catalog and serves its first request with ZERO cold compiles.
+* **No hung handles**: router shutdown fails everything pending with
+  ShutdownError, same contract as the service.
+
+This module is listed in tools/check_junit.py NO_SKIP_MODULES: it
+spawns replica subprocesses on localhost TCP + the forced CPU backend
+and has no legitimate skip condition.
+"""
+
+import pickle
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.decoder import (ProgramValidationError,
+                                               machine_program_from_cmds)
+from distributed_processor_tpu.serve import (CancelledError,
+                                             DeadlineError,
+                                             ExecutorLostError,
+                                             FleetRouter, OverloadError,
+                                             ReplicaLostError,
+                                             RetryPolicy,
+                                             ServiceClosedError,
+                                             ShutdownError,
+                                             is_terminal_error)
+from distributed_processor_tpu.serve.benchmark import _workload
+from distributed_processor_tpu.serve.fleet import Fleet
+from distributed_processor_tpu.serve.transport import _picklable_error
+from distributed_processor_tpu.sim.interpreter import (FaultError,
+                                                       InterpreterConfig,
+                                                       simulate_batch)
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+
+@pytest.fixture(autouse=True)
+def _serve_thread_leak_probe():
+    """Override the per-test conftest probe: the module-scoped Fleet
+    below keeps router/wire threads alive across tests BY DESIGN.  The
+    leak boundary moves to module teardown (the autouse module fixture
+    next), after the fleet has shut down."""
+    yield
+
+
+@pytest.fixture(autouse=True, scope='module')
+def _fleet_thread_boundary():
+    """After the module-scoped fleet shuts down, every dproc-serve*
+    thread (router gossip/retry, fleet monitor, wire readers/waiters)
+    must be joined — prints the junit-gated marker otherwise."""
+    import threading
+    yield
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        leaked = sorted(t.name for t in threading.enumerate()
+                        if t.name.startswith('dproc-serve')
+                        and t.is_alive())
+        if not leaked:
+            return
+        time.sleep(0.05)
+    print(f'SERVICE THREAD LEAK: {leaked}')
+
+
+def _assert_same(got, want, label=''):
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(
+            np.asarray(got[k]), np.asarray(want[k]),
+            err_msg=f'{label}: stat {k!r} diverged')
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy and the wire
+# ---------------------------------------------------------------------------
+
+def test_terminal_error_taxonomy():
+    """Program-class errors and explicit client outcomes are terminal
+    at the router (never retried on another replica); infrastructure
+    errors are retryable — retrying a deterministic program failure
+    elsewhere would just fail again N times."""
+    for exc in (FaultError([3, 0, 0, 0, 0, 0]),
+                ProgramValidationError([('jump_oob', 0, 3,
+                                         'target 9 outside [0, 5)')]),
+                ValueError('bad shots'),
+                DeadlineError('deadline passed'),
+                CancelledError('cancelled'),
+                ShutdownError('shutting down')):
+        assert is_terminal_error(exc), exc
+    for exc in (RuntimeError('executor crashed'),
+                ExecutorLostError('dispatcher died'),
+                ReplicaLostError('connection lost'),
+                OverloadError('queue projected past deadline')):
+        assert not is_terminal_error(exc), exc
+
+
+def test_typed_errors_pickle_roundtrip():
+    """The wire is pickle: the two program-class error types must
+    round-trip with their payloads intact (FaultError's per-code
+    counts feed the caller's fault table), and an unpicklable error
+    must degrade to a typed RuntimeError naming the original, never
+    kill the connection."""
+    fe = pickle.loads(pickle.dumps(FaultError([2, 0, 1, 0, 0, 0])))
+    assert isinstance(fe, FaultError)
+    np.testing.assert_array_equal(fe.counts, [2, 0, 1, 0, 0, 0])
+    pe = pickle.loads(pickle.dumps(ProgramValidationError(
+        [('sync_mismatch', None, None, 'sync sets differ')])))
+    assert isinstance(pe, ProgramValidationError)
+    assert pe.errors == [('sync_mismatch', None, None,
+                          'sync sets differ')]
+    assert pe.codes == {'sync_mismatch'}
+
+    assert _picklable_error(fe) is fe
+
+    class Local(Exception):      # locally-defined: unpicklable
+        pass
+
+    wired = _picklable_error(Local('boom'))
+    assert isinstance(wired, RuntimeError)
+    assert 'Local' in str(wired) and 'boom' in str(wired)
+    assert not is_terminal_error(wired)
+
+
+# ---------------------------------------------------------------------------
+# router unit tests (no replica processes)
+# ---------------------------------------------------------------------------
+
+def _tiny_mp():
+    core = [isa.pulse_cmd(amp_word=1000, cfg_word=0, env_word=3,
+                          cmd_time=10), isa.done_cmd()]
+    return machine_program_from_cmds([core])
+
+
+def test_router_validates_liveness_window():
+    with pytest.raises(ValueError):
+        FleetRouter(gossip_interval_ms=50.0, liveness_window_ms=50.0)
+
+
+def test_gossip_staleness_marks_silent_replica_down():
+    """A replica whose TCP connection stays open but that never
+    answers gossip (the SIGSTOP failure mode) is marked down within
+    the liveness window — connection loss alone cannot catch a wedge."""
+    lis = socket.socket()
+    lis.bind(('127.0.0.1', 0))
+    lis.listen(4)                # connects land in the backlog; no one
+    try:                         # ever reads or answers
+        with FleetRouter(gossip_interval_ms=20.0,
+                         liveness_window_ms=100.0) as router:
+            router.add_replica('mute', lis.getsockname())
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                s = router.stats()
+                if s['gossip_stale'] >= 1 \
+                        and not s['replicas']['mute']['alive']:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError(
+                    f'silent replica never marked stale: {s}')
+            kinds = [e['kind'] for e in
+                     router.flight_recorder.events()]
+            assert 'gossip_stale' in kinds and 'replica_down' in kinds
+    finally:
+        lis.close()
+
+
+def test_router_shutdown_fails_parked_with_typed_error():
+    """With zero routable replicas a request parks instead of failing
+    fast (a respawn may be seconds away); shutdown must then fail it
+    with ShutdownError — parked is never silently dropped."""
+    router = FleetRouter(retry_policy=RetryPolicy(max_attempts=2,
+                                                  backoff_s=0.005))
+    h = router.submit(_tiny_mp(), np.zeros((2, 1, 2), np.int32),
+                      cfg=InterpreterConfig(max_steps=32, max_meas=2))
+    assert not h.done()
+    router.shutdown()
+    assert isinstance(h.exception(timeout=5), ShutdownError)
+    with pytest.raises(ServiceClosedError):
+        router.submit(_tiny_mp(), np.zeros((2, 1, 2), np.int32))
+
+
+# ---------------------------------------------------------------------------
+# live fleet: replica processes on localhost TCP
+# ---------------------------------------------------------------------------
+
+N_REQS = 4
+
+
+@pytest.fixture(scope='module')
+def workload():
+    return _workload(N_REQS, 2, 2, 4, seed=3)
+
+
+@pytest.fixture(scope='module')
+def fleet(workload):
+    mps, bits, cfg = workload
+    with Fleet(2,
+               service={'max_batch_programs': 4, 'max_wait_ms': 5.0,
+                        'max_queue': 256},
+               env={'XLA_FLAGS':
+                    '--xla_force_host_platform_device_count=1'},
+               # deep enough to park across a kill+wedge overlap (a
+               # total outage until the respawn boots) in the soak
+               router_kwargs={'retry_policy':
+                              RetryPolicy(max_attempts=10,
+                                          backoff_s=0.05,
+                                          max_backoff_s=1.0)}) as f:
+        # warm EVERY replica on the serving bucket so the tests below
+        # measure federation behaviour, not first-compile latency
+        # (bucket affinity would home all fleet.submit warmup on one)
+        for rid in f.replica_ids():
+            f.router.call_replica(
+                rid, 'submit',
+                dict(mp=mps[0], meas_bits=bits[0], cfg=cfg),
+                timeout_s=600.0)
+        yield f
+
+
+@pytest.fixture(scope='module')
+def refs(workload):
+    mps, bits, cfg = workload
+    return [jax.tree.map(np.asarray,
+                         simulate_batch(mps[i], bits[i], cfg=cfg))
+            for i in range(N_REQS)]
+
+
+def _wait_routable(fleet, n, timeout_s=120.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        s = fleet.router.stats()
+        if s['n_routable'] >= n:
+            return s
+        time.sleep(0.05)
+    raise AssertionError(f'{n} replicas never routable: '
+                         f'{fleet.router.stats()}')
+
+
+def test_fleet_round_trip_bit_identity(fleet, workload, refs):
+    mps, bits, cfg = workload
+    handles = [fleet.submit(mps[i], bits[i], cfg=cfg)
+               for i in range(N_REQS)]
+    for i, h in enumerate(handles):
+        _assert_same(h.result(timeout=300), refs[i], f'req {i}')
+    s = fleet.stats()
+    assert s['n_routable'] == 2 and s['completed'] >= N_REQS
+    # per-replica stats reach through the wire
+    rep = fleet.replica_stats(0)
+    assert 'compile' in rep and 'warmup' in rep
+
+
+def test_strict_fault_error_crosses_wire_untouched(fleet):
+    """A strict-mode FaultError is a program-class outcome: it crosses
+    the wire with its per-code counts byte-identical to the solo run
+    and is NEVER retried — the retry layer must not burn its budget
+    re-executing a deterministic trap on every replica."""
+    core = [isa.alu_cmd('reg_alu', 'i', 1000, 'id0', write_reg_addr=0),
+            isa.pulse_cmd(amp_word=1000, cfg_word=0, env_word=3,
+                          cmd_time=10),
+            isa.alu_cmd('reg_alu', 'i', -1, 'add', 0, write_reg_addr=0),
+            isa.alu_cmd('jump_cond', 'i', 0, 'le', 0, jump_cmd_ptr=1),
+            isa.done_cmd()]
+    mp = machine_program_from_cmds([core])
+    mb = np.zeros((4, 1, 2), np.int32)
+    cfg = InterpreterConfig(max_steps=6, max_meas=2,
+                            fault_mode='strict')
+    with pytest.raises(FaultError) as solo:
+        simulate_batch(mp, mb, cfg=cfg)
+
+    before = fleet.stats()
+    exc = fleet.submit(mp, mb, cfg=cfg).exception(timeout=300)
+    after = fleet.stats()
+    assert isinstance(exc, FaultError)
+    np.testing.assert_array_equal(exc.counts, solo.value.counts)
+    assert after['retries'] == before['retries']
+    assert after['failed'] == before['failed'] + 1
+
+
+def test_kill_failover_bit_identity_and_warm_respawn(fleet, workload,
+                                                     refs):
+    """SIGKILL the loaded replica with requests in flight: every
+    request completes bit-identically on the survivor, and the monitor
+    respawns the victim from the shared warm tiers — its first served
+    request after warmup costs ZERO cold compiles."""
+    mps, bits, cfg = workload
+    _wait_routable(fleet, 2)
+    before = fleet.router.stats()
+
+    victim_rid = fleet.router.primary_replica()
+    victim_idx = fleet.replica_ids().index(victim_rid)
+    respawns0 = fleet.stats()['processes'][victim_rid]['respawns']
+
+    handles = [fleet.submit(mps[i % N_REQS], bits[i % N_REQS], cfg=cfg)
+               for i in range(2 * N_REQS)]
+    fleet.kill(victim_idx)
+    for i, h in enumerate(handles):
+        _assert_same(h.result(timeout=300), refs[i % N_REQS],
+                     f'req {i} after kill')
+
+    after = fleet.router.stats()
+    assert after['replica_down'] >= before['replica_down'] + 1
+
+    # the monitor respawns the victim; the router re-admits it
+    deadline = time.monotonic() + 240.0
+    while time.monotonic() < deadline:
+        st = fleet.stats()
+        if st['processes'][victim_rid]['respawns'] > respawns0 \
+                and st['replicas'].get(victim_rid, {}).get('routable'):
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(f'victim never respawned+re-admitted: '
+                             f'{fleet.stats()}')
+
+    # shared warm tiers: wait for catalog replay to finish, then the
+    # first request served by the respawn must classify WARM (the
+    # replay itself compiles — snapshot cold AFTER it settles)
+    deadline = time.monotonic() + 240.0
+    while time.monotonic() < deadline:
+        rep = fleet.replica_stats(victim_rid)
+        if rep['warmup']['in_progress'] == 0:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError('respawned replica warmup never settled')
+    cold0 = rep['compile']['cold']
+    got = fleet.router.call_replica(
+        victim_rid, 'submit',
+        dict(mp=mps[0], meas_bits=bits[0], cfg=cfg), timeout_s=300.0)
+    _assert_same(got, refs[0], 'respawned replica')
+    assert fleet.replica_stats(victim_rid)['compile']['cold'] == cold0
+
+
+def test_wedge_gossip_failover_then_readmit(fleet, workload, refs):
+    """SIGSTOP the loaded replica: its connection stays open so only
+    gossip staleness can catch it; in-flight work fails over
+    bit-identically, and SIGCONT re-admits it on the next heartbeat."""
+    mps, bits, cfg = workload
+    _wait_routable(fleet, 2)
+    before = fleet.router.stats()
+
+    victim_rid = fleet.router.primary_replica()
+    victim_idx = fleet.replica_ids().index(victim_rid)
+    handles = [fleet.submit(mps[i], bits[i], cfg=cfg)
+               for i in range(N_REQS)]
+    fleet.wedge(victim_idx)
+    try:
+        for i, h in enumerate(handles):
+            _assert_same(h.result(timeout=300), refs[i],
+                         f'req {i} under wedge')
+        mid = fleet.router.stats()
+        assert mid['gossip_stale'] >= before['gossip_stale'] + 1
+        assert not mid['replicas'][victim_rid]['alive']
+    finally:
+        fleet.unwedge(victim_idx)
+
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        s = fleet.router.stats()
+        if s['replicas'][victim_rid]['routable']:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(f'unwedged replica never re-admitted: '
+                             f'{fleet.router.stats()}')
+    assert s['replica_up'] >= before['replica_up'] + 1
+
+
+@pytest.mark.slow
+def test_fleet_soak_scripted_chaos(fleet, workload):
+    """Small in-test mirror of tools/servechaos.py --fleet: scripted
+    kill + wedge/unwedge under a paced stream; zero hangs, zero bit
+    mismatches, goodput positive inside the kill window."""
+    from distributed_processor_tpu.serve.chaos import fleet_soak
+    mps, bits, cfg = workload
+    _wait_routable(fleet, 2)
+    n = 30
+    report = fleet_soak(
+        fleet, mps, cfg, n_requests=n, shots=4, seed=5, rate_hz=30.0,
+        actions=[(n // 3, 'kill', -1), (n // 2, 'wedge', -1),
+                 ((3 * n) // 4, 'unwedge', -1)],
+        result_timeout_s=300.0)
+    assert report.hung == 0
+    assert report.bit_mismatches == 0
+    assert report.terminated() == report.submitted
+    kill_t = next(t for t, m, _ in report.actions if m == 'kill')
+    assert report.ok_in_window(kill_t, kill_t + 2.0) > 0

@@ -44,6 +44,12 @@ NO_SKIP_MODULES = {
         'with no hardware dependency — a skip means the cold-start '
         'contract (docs/SERVING.md "Cold start & warmup") stopped '
         'being exercised',
+    'test_fleet':
+        'fleet federation tests spawn replica subprocesses on plain '
+        'localhost TCP + the forced CPU backend, with no hardware '
+        'dependency — a skip means the replica-loss contract '
+        '(docs/FLEET.md: failover bit-identity, gossip staleness, '
+        'warm respawn) stopped being exercised',
 }
 
 # the multi-device serve suite may skip ONLY on a genuinely

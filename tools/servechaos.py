@@ -20,6 +20,15 @@ same discipline one tier up:
 
     python tools/servechaos.py --quick         # ~30 s, 60 requests
     python tools/servechaos.py                 # full: 200 requests
+
+``--fleet N`` moves the soak one tier up (docs/FLEET.md): N replica
+PROCESSES behind a FleetRouter, with scripted process-level chaos —
+SIGKILL one replica mid-stream (the monitor respawns it from the
+shared warm tiers), SIGSTOP-wedge another past the gossip liveness
+window, SIGCONT it back into re-admission.  Same contract, plus:
+goodput must stay positive inside the kill window.
+
+    python tools/servechaos.py --fleet 2 --quick
 """
 
 import argparse
@@ -72,7 +81,16 @@ def main(argv=None) -> int:
     ap.add_argument('--trace-out', default=None, metavar='PATH',
                     help='trace every request (sample=1.0) and export '
                          'the Chrome-trace JSON to PATH')
+    ap.add_argument('--fleet', type=int, default=0, metavar='N',
+                    help='soak a fleet of N replica processes '
+                         '(SIGKILL/SIGSTOP chaos) instead of the '
+                         'in-process service')
+    ap.add_argument('--rate-hz', type=float, default=30.0,
+                    help='fleet-mode submission pacing (default 30)')
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        return _fleet_mode(args)
 
     from distributed_processor_tpu.serve import (ChaosMonkey, ChaosPlan,
                                                  ExecutionService,
@@ -146,6 +164,103 @@ def main(argv=None) -> int:
     if report.terminated() != report.submitted:
         failures.append(f'{report.submitted - report.terminated()} '
                         f'handle(s) neither completed nor typed-failed')
+    out['ok'] = not failures
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        for k, v in out.items():
+            print(f'{k:>18}: {v}')
+    for msg in failures:
+        print(f'SERVECHAOS FAIL: {msg}', file=sys.stderr)
+    return 1 if failures else 0
+
+
+def _fleet_mode(args) -> int:
+    """Fleet soak: N replica processes, scripted process-level chaos."""
+    from distributed_processor_tpu.serve.benchmark import _workload
+    from distributed_processor_tpu.serve.chaos import fleet_soak
+    from distributed_processor_tpu.serve.fleet import Fleet
+    from distributed_processor_tpu.serve.supervise import RetryPolicy
+
+    n = args.n if args.n is not None else (60 if args.quick else 150)
+    n_rep = max(2, args.fleet)
+    mps, _bits, cfg = _workload(min(n, 12), args.qubits, args.depth,
+                                args.shots, args.seed)
+    # SIGKILL the loaded replica (-1 resolves at fire time) a third of
+    # the way in — the monitor respawns it from the shared warm tiers;
+    # wedge + unwedge the then-loaded one so the gossip-staleness and
+    # re-admission paths both fire
+    actions = [(n // 3, 'kill', -1),
+               (n // 2, 'wedge', -1), ((3 * n) // 4, 'unwedge', -1)]
+    t0 = time.monotonic()
+    with Fleet(
+            n_rep,
+            interp_cfg=None,
+            service={'max_batch_programs': 4, 'max_wait_ms': 5.0,
+                     'max_queue': 4 * n,
+                     'max_est_wait_ms': 10000.0},
+            env={'XLA_FLAGS': '--xla_force_host_platform_device_count=1'},
+            # the scripted kill+wedge can overlap into a total outage
+            # until the respawn boots; a deep, slow budget parks the
+            # recovered requests across it instead of exhausting
+            router_kwargs={'retry_policy': RetryPolicy(
+                max_attempts=10, backoff_s=0.05, backoff_mult=2.0,
+                max_backoff_s=1.0)},
+    ) as fleet:
+        # warm EVERY replica on the workload bucket directly: bucket
+        # affinity would home all of fleet.submit's warmup on one
+        # replica, leaving the failover survivor to first-compile
+        # under the post-kill herd
+        for rid in fleet.replica_ids():
+            fleet.router.call_replica(
+                rid, 'submit',
+                dict(mp=mps[0], meas_bits=_bits[0], cfg=cfg),
+                timeout_s=600.0)
+        report = fleet_soak(fleet, mps, cfg, n_requests=n,
+                            shots=args.shots, seed=args.seed,
+                            rate_hz=args.rate_hz, actions=actions,
+                            result_timeout_s=180.0)
+        stats = fleet.stats()
+        if args.flight_out:
+            fleet.router.flight_recorder.dump(args.flight_out)
+    wall_s = time.monotonic() - t0
+
+    kill_t = next(t for t, m, _ in report.actions if m == 'kill')
+    ok_in_kill = report.ok_in_window(kill_t, kill_t + 2.0)
+    out = {
+        'mode': 'fleet',
+        'replicas': n_rep,
+        'requests': n,
+        'seed': args.seed,
+        'actions': report.actions,
+        'submitted': report.submitted,
+        'rejected': report.rejected,
+        'completed': report.completed,
+        'hung': report.hung,
+        'bit_mismatches': report.bit_mismatches,
+        'failed_typed': dict(report.errors),
+        'ok_in_kill_window': ok_in_kill,
+        'goodput_rps': round(report.goodput(), 2),
+        'router': {k: stats[k] for k in (
+            'retries', 'retry_exhausted', 'failovers', 'replica_down',
+            'replica_up', 'gossip_stale', 'breaker_trips',
+            'readmissions', 'n_routable')},
+        'respawns': {r: p['respawns']
+                     for r, p in stats['processes'].items()},
+        'wall_s': round(wall_s, 3),
+    }
+    failures = []
+    if report.hung:
+        failures.append(f'{report.hung} handle(s) HUNG past the '
+                        f'result timeout')
+    if report.bit_mismatches:
+        failures.append(f'{report.bit_mismatches} completion(s) not '
+                        f'bit-identical to the solo run')
+    if report.terminated() != report.submitted:
+        failures.append(f'{report.submitted - report.terminated()} '
+                        f'handle(s) neither completed nor typed-failed')
+    if ok_in_kill == 0:
+        failures.append('goodput hit ZERO inside the kill window')
     out['ok'] = not failures
     if args.json:
         print(json.dumps(out, indent=2))
