@@ -50,7 +50,11 @@ tenants x distinct programs of that RB depth, shots per submit_source
 request, stampede width; defaults 4/4/4/8/8),
 BENCH_OBS_REQS/BENCH_OBS_SHOTS/BENCH_OBS_SAMPLE (the observability
 overhead row: workload shape and the intermediate trace-sampling
-fraction, defaults 32/32/0.25; BENCH_OBS=0 skips the row).
+fraction, defaults 32/32/0.25; BENCH_OBS=0 skips the row),
+BENCH_OBS_FLEET_REPLICAS/BENCH_OBS_FLEET_REQS/BENCH_OBS_FLEET_SHOTS
+(the fleet observability-overhead row: replica processes and workload
+for the off/sampled/full rounds through one fleet, defaults 2/24/8;
+also gated by BENCH_OBS=0).
 
 Besides the final stdout line, every completed row is written
 incrementally and atomically to BENCH_ARTIFACT (default
@@ -132,7 +136,8 @@ from distributed_processor_tpu.models import (
 from distributed_processor_tpu.serve.benchmark import (
     availability_under_chaos, compile_front_door,
     continuous_batching_comparison, fleet_failover,
-    multi_device_scaling, open_loop_latency)
+    fleet_observability_overhead, multi_device_scaling,
+    open_loop_latency)
 from distributed_processor_tpu.sim.interpreter import InterpreterConfig
 from distributed_processor_tpu.sim.physics import (
     ReadoutPhysics, run_physics_batch, prepare_physics_tables)
@@ -900,6 +905,8 @@ def _degraded_rerun(attempts):
                  ('BENCH_COMPILE_DEPTH', '2'),
                  ('BENCH_COMPILE_SHOTS', '8'),
                  ('BENCH_OBS_REQS', '8'), ('BENCH_OBS_SHOTS', '8'),
+                 ('BENCH_OBS_FLEET_REQS', '12'),
+                 ('BENCH_OBS_FLEET_SHOTS', '8'),
                  # exec_profile row under the kernel interpreter: tiny
                  # batches, one rep — the (a, b) fit is still real
                  ('PROFILE_BATCHES', '64,128,256'),
@@ -1056,6 +1063,23 @@ def _observability_overhead_row():
                 row['service_warm_s'] / base_svc_s - 1.0, 4)
         out[label] = entry
     return out
+
+
+def _fleet_observability_overhead_row():
+    """What fleet-wide observability costs: the same closed-loop
+    workload through one fleet of replica processes at trace_sample
+    off / BENCH_OBS_SAMPLE / full, the router sampler retuned live
+    between rounds.  The deltas isolate the cross-process tracing tax
+    (wire trace ids, replica span capture, span piggyback, router
+    stitching + clock alignment); bit-identity asserted per round and
+    the full round must retain stitched spans before any overhead is
+    reported (serve/benchmark.py fleet_observability_overhead)."""
+    return fleet_observability_overhead(
+        n_replicas=int(os.environ.get('BENCH_OBS_FLEET_REPLICAS', 2)),
+        n_reqs=int(os.environ.get('BENCH_OBS_FLEET_REQS', 24)),
+        shots=int(os.environ.get('BENCH_OBS_FLEET_SHOTS', 8)),
+        seed=int(os.environ.get('BENCH_OBS_FLEET_SEED', 0)),
+        sampled=float(os.environ.get('BENCH_OBS_SAMPLE', 0.25)))
 
 
 def _compile_front_door_row():
@@ -1585,6 +1609,21 @@ def main():
         obs_row = None
     artifact.row('observability_overhead', obs_row)
 
+    # fleet observability-overhead row: the same off/sampled/full
+    # sweep one tier up — trace ids on the wire, replica span capture,
+    # piggybacked spans, router-side stitching + clock alignment
+    if secondaries and os.environ.get('BENCH_OBS', '1') != '0':
+        try:
+            fleet_obs_row = _timed_row(
+                _fleet_observability_overhead_row)
+        except _RowTimeout as e:
+            fleet_obs_row = {'error': 'timeout', 'detail': str(e)}
+        except Exception as e:  # pragma: no cover - defensive
+            fleet_obs_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+    else:
+        fleet_obs_row = None
+    artifact.row('fleet_observability_overhead', fleet_obs_row)
+
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
     result = {
@@ -1637,6 +1676,7 @@ def main():
             'fleet_failover': fleet_row,
             'compile_front_door': front_door,
             'observability_overhead': obs_row,
+            'fleet_observability_overhead': fleet_obs_row,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

@@ -460,11 +460,89 @@ def cmd_trace_view(args):
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         'tools'))
     from traceview import format_table, summarize
-    summary = summarize(args.trace)
+    try:
+        summary = summarize(args.trace)
+    except (OSError, ValueError) as e:
+        # empty/invalid trace files exit nonzero with the reason, not
+        # a traceback (tools/traceview.py raises ValueError for both)
+        raise SystemExit(f'trace-view: {e}')
     if args.json:
         print(json.dumps(summary, indent=2))
     else:
         print(format_table(summary))
+
+
+def cmd_fleet_status(args):
+    """Live fleet flight deck: poll each replica DIRECTLY over the
+    fleet wire (the same ``gossip`` / ``fleet-metrics`` ops the router
+    uses) and print one status row per replica — no router process
+    required, so this works against any fleet you can reach.  With
+    ``--prometheus``, re-expose every replica's metrics with a
+    ``replica`` label plus fleet rollups (docs/OBSERVABILITY.md
+    "Fleet observability")."""
+    from .serve.transport import ReplicaClient
+    rows, snaps, errors = [], {}, []
+    for addr in args.replica:
+        host, _, port = addr.rpartition(':')
+        host = host or '127.0.0.1'
+        try:
+            client = ReplicaClient((host, int(port)))
+        except (OSError, ValueError) as e:
+            errors.append((addr, f'{type(e).__name__}: {e}'))
+            rows.append({'replica': addr, 'error': str(e)})
+            continue
+        try:
+            g = client.call('gossip', {}, timeout_s=args.timeout)
+            if args.prometheus:
+                m = client.call('fleet-metrics', {},
+                                timeout_s=args.timeout)
+                snaps[addr] = m['metrics']
+        except Exception as e:          # noqa: BLE001 - keep polling
+            errors.append((addr, f'{type(e).__name__}: {e}'))
+            rows.append({'replica': addr, 'error': str(e)})
+            continue
+        finally:
+            client.close()
+        st = g.get('stats', {})
+        fl = g.get('flight', {})
+        rows.append({
+            'replica': addr,
+            'health': st.get('health'),
+            'queue_depth': st.get('queue_depth'),
+            'est_wait_ms': st.get('est_wait_ms'),
+            'completed': st.get('completed'),
+            'flight_recorded': fl.get('recorded'),
+            'flight_dropped': fl.get('dropped'),
+            'flight_counts': fl.get('counts'),
+        })
+    if not any('error' not in r for r in rows):
+        for addr, err in errors:
+            print(f'fleet-status: {addr}: {err}', file=sys.stderr)
+        raise SystemExit('fleet-status: no replica reachable')
+    if args.prometheus:
+        from .obs import merged_prometheus_text
+        lines = merged_prometheus_text(snaps, label='replica')
+        print('\n'.join(lines))
+        return
+    if args.json:
+        print(json.dumps(rows, indent=2))
+        return
+    cols = ('replica', 'health', 'queue_depth', 'est_wait_ms',
+            'completed', 'flight_recorded', 'flight_dropped')
+    widths = {c: max(len(c), *(len(str(r.get(c, ''))) for r in rows))
+              for c in cols}
+    print('  '.join(c.ljust(widths[c]) for c in cols))
+    for r in rows:
+        if 'error' in r:
+            print(f"{r['replica'].ljust(widths['replica'])}  "
+                  f"UNREACHABLE: {r['error']}")
+            continue
+        print('  '.join(str(r.get(c, '')).ljust(widths[c])
+                        for c in cols))
+        if r.get('flight_counts'):
+            counts = ' '.join(f'{k}={v}' for k, v in
+                              sorted(r['flight_counts'].items()))
+            print(f'  flight: {counts}')
 
 
 def cmd_warmup(args):
@@ -768,6 +846,24 @@ def main(argv=None):
     p.add_argument('--json', action='store_true',
                    help='emit the summary as JSON instead of a table')
     p.set_defaults(fn=cmd_trace_view)
+
+    p = sub.add_parser('fleet-status',
+                       help='poll live replicas over the fleet wire '
+                            '(gossip + fleet-metrics ops): one status '
+                            'row per replica, or --prometheus for the '
+                            'replica-labeled merged exposition')
+    p.add_argument('replica', nargs='+', metavar='HOST:PORT',
+                   help='replica wire addresses (ReplicaServer); bare '
+                        'ports default the host to 127.0.0.1')
+    p.add_argument('--prometheus', action='store_true',
+                   help='print the merged Prometheus exposition '
+                        '(every metric with a replica label + fleet '
+                        'rollups) instead of the table')
+    p.add_argument('--json', action='store_true',
+                   help='emit the status rows as JSON')
+    p.add_argument('--timeout', type=float, default=5.0,
+                   help='per-replica wire timeout in seconds')
+    p.set_defaults(fn=cmd_fleet_status)
 
     p = sub.add_parser('warmup',
                        help='AOT-compile a learned bucket catalog '

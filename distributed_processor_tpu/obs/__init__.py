@@ -1,6 +1,6 @@
 """Flight-deck observability for the serving stack (docs/OBSERVABILITY.md).
 
-Three stdlib-only, jax-free pieces the serve / compilecache / sim
+Four stdlib-only, jax-free pieces the serve / compilecache / sim
 layers emit into:
 
 :mod:`.trace`     per-request lifecycle spans + Chrome Trace export
@@ -9,19 +9,29 @@ layers emit into:
                   ``utils.profiling``'s counter namespace
 :mod:`.recorder`  flight recorder — lock-cheap ring buffer of
                   supervision / chaos events
+:mod:`.clock`     cross-process monotonic-clock offset estimation —
+                  aligns replica-side spans and flight events into the
+                  fleet router's timeline (docs/FLEET.md)
 """
 
+from .clock import ClockOffsetEstimator
 from .metrics import (DEFAULT_BUCKETS, Histogram, MetricsRegistry,
-                      default_registry)
+                      default_registry, escape_label_value,
+                      merged_prometheus_text,
+                      prometheus_snapshot_lines)
 from .recorder import FlightRecorder
 from .trace import (STAGE_ORDER, TraceContext, Tracer,
                     chrome_trace_events, write_chrome_trace)
 
 __all__ = [
+    'ClockOffsetEstimator',
     'DEFAULT_BUCKETS',
     'Histogram',
     'MetricsRegistry',
     'default_registry',
+    'escape_label_value',
+    'merged_prometheus_text',
+    'prometheus_snapshot_lines',
     'FlightRecorder',
     'STAGE_ORDER',
     'TraceContext',

@@ -46,6 +46,121 @@ def _prom_name(name: str) -> str:
     return out
 
 
+def escape_label_value(value) -> str:
+    """Escape a label value per the Prometheus text-format spec:
+    backslash, double-quote, and newline must be backslash-escaped.
+    Replica ids and bucket-spec labels flow through here on the fleet
+    exposition path."""
+    return (str(value).replace('\\', '\\\\').replace('"', '\\"')
+            .replace('\n', '\\n'))
+
+
+def _format_labels(labels: dict) -> str:
+    """``{k="v",...}`` with keys sorted, values escaped; '' if empty."""
+    if not labels:
+        return ''
+    body = ','.join(f'{k}="{escape_label_value(v)}"'
+                    for k, v in sorted(labels.items()))
+    return '{' + body + '}'
+
+
+def prometheus_snapshot_lines(snap: dict, labels: dict = None,
+                              type_lines: bool = True) -> list:
+    """Render a :meth:`MetricsRegistry.snapshot` dict as Prometheus
+    text-format lines, optionally stamping constant ``labels`` onto
+    every series — the fleet router re-exposes each replica's snapshot
+    with a ``replica`` label this way (docs/FLEET.md)."""
+    labels = dict(labels or {})
+    lab = _format_labels(labels)
+    lines = []
+    for name, val in sorted(snap.get('counters', {}).items()):
+        pn = _prom_name(name)
+        if type_lines:
+            lines.append(f'# TYPE {pn} counter')
+        lines.append(f'{pn}{lab} {val}')
+    for name, val in sorted(snap.get('gauges', {}).items()):
+        pn = _prom_name(name)
+        if type_lines:
+            lines.append(f'# TYPE {pn} gauge')
+        lines.append(f'{pn}{lab} {val}')
+    for name, st in sorted(snap.get('histograms', {}).items()):
+        pn = _prom_name(name)
+        if type_lines:
+            lines.append(f'# TYPE {pn} histogram')
+        lines.extend(_histogram_lines(pn, st, labels))
+    return lines
+
+
+def _histogram_lines(pn: str, st: dict, labels: dict) -> list:
+    lines = []
+    cum = 0
+    for edge, c in zip(st['buckets'], st['counts']):
+        cum += c
+        lines.append(
+            f'{pn}_bucket{_format_labels({**labels, "le": edge})} '
+            f'{cum}')
+    cum += st['counts'][-1]
+    lines.append(
+        f'{pn}_bucket{_format_labels({**labels, "le": "+Inf"})} {cum}')
+    lab = _format_labels(labels)
+    lines.append(f'{pn}_sum{lab} {st["sum"]}')
+    lines.append(f'{pn}_count{lab} {st["n"]}')
+    return lines
+
+
+def merged_prometheus_text(snapshots: dict, label: str = 'replica'
+                           ) -> list:
+    """Merge per-process registry snapshots into one labeled
+    exposition: for every metric name, one ``# TYPE`` line, a
+    fleet-level ROLLUP series (counters: sum; histograms: summed
+    buckets when the ladders agree), then one ``{label="<id>"}``
+    series per process.  ``snapshots`` maps process id (replica id) →
+    :meth:`MetricsRegistry.snapshot` dict; returns text lines."""
+    lines = []
+    names = sorted({n for s in snapshots.values()
+                    for n in s.get('counters', {})})
+    for name in names:
+        pn = _prom_name(name)
+        lines.append(f'# TYPE {pn} counter')
+        lines.append(f'{pn} ' + str(sum(
+            s.get('counters', {}).get(name, 0)
+            for s in snapshots.values())))
+        for rid in sorted(snapshots):
+            val = snapshots[rid].get('counters', {}).get(name)
+            if val is not None:
+                lines.append(f'{pn}{_format_labels({label: rid})} '
+                             f'{val}')
+    names = sorted({n for s in snapshots.values()
+                    for n in s.get('gauges', {})})
+    for name in names:
+        pn = _prom_name(name)
+        lines.append(f'# TYPE {pn} gauge')
+        for rid in sorted(snapshots):
+            val = snapshots[rid].get('gauges', {}).get(name)
+            if val is not None:
+                lines.append(f'{pn}{_format_labels({label: rid})} '
+                             f'{val}')
+    names = sorted({n for s in snapshots.values()
+                    for n in s.get('histograms', {})})
+    for name in names:
+        pn = _prom_name(name)
+        lines.append(f'# TYPE {pn} histogram')
+        sts = {rid: snapshots[rid]['histograms'][name]
+               for rid in sorted(snapshots)
+               if name in snapshots[rid].get('histograms', {})}
+        ladders = {tuple(st['buckets']) for st in sts.values()}
+        if len(ladders) == 1:
+            roll = {'buckets': next(iter(ladders)),
+                    'counts': [sum(c) for c in zip(
+                        *(st['counts'] for st in sts.values()))],
+                    'sum': sum(st['sum'] for st in sts.values()),
+                    'n': sum(st['n'] for st in sts.values())}
+            lines.extend(_histogram_lines(pn, roll, {}))
+        for rid, st in sts.items():
+            lines.extend(_histogram_lines(pn, st, {label: rid}))
+    return lines
+
+
 class Histogram:
     """Fixed-bucket histogram with a bounded exact-sample window.
 
@@ -211,29 +326,11 @@ class MetricsRegistry:
 
         Dotted names are sanitized (``serve.compile.cold`` →
         ``serve_compile_cold``); histogram buckets are cumulative with
-        the conventional ``le`` label and trailing ``+Inf``.
+        the conventional ``le`` label and trailing ``+Inf``; label
+        values are escaped per the text-format spec
+        (:func:`escape_label_value`).
         """
-        lines = []
-        for name, val in sorted(self.counters().items()):
-            pn = _prom_name(name)
-            lines.append(f'# TYPE {pn} counter')
-            lines.append(f'{pn} {val}')
-        for name, val in sorted(self.gauges().items()):
-            pn = _prom_name(name)
-            lines.append(f'# TYPE {pn} gauge')
-            lines.append(f'{pn} {val}')
-        for name, h in sorted(self.histograms().items()):
-            pn = _prom_name(name)
-            st = h.state()
-            lines.append(f'# TYPE {pn} histogram')
-            cum = 0
-            for edge, c in zip(st['buckets'], st['counts']):
-                cum += c
-                lines.append(f'{pn}_bucket{{le="{edge}"}} {cum}')
-            cum += st['counts'][-1]
-            lines.append(f'{pn}_bucket{{le="+Inf"}} {cum}')
-            lines.append(f'{pn}_sum {st["sum"]}')
-            lines.append(f'{pn}_count {st["n"]}')
+        lines = prometheus_snapshot_lines(self.snapshot())
         return '\n'.join(lines) + ('\n' if lines else '')
 
 

@@ -64,6 +64,13 @@ class FlightRecorder:
         # next value to be drawn
         return self._seq.__reduce__()[1][0]
 
+    @property
+    def dropped(self) -> int:
+        """Events overwritten by ring wrap: a nonzero value means the
+        dump is a TRUNCATED incident timeline, not a quiet one — the
+        federated fleet report surfaces it per replica."""
+        return max(0, self.recorded - len(self._ring))
+
     def events(self, kind: str = None) -> list:
         """Snapshot of retained events, oldest first; optionally
         filtered by ``kind``."""
@@ -78,7 +85,8 @@ class FlightRecorder:
 
     def to_json(self) -> dict:
         return {'capacity': self.capacity, 'recorded': self.recorded,
-                'counts': self.counts(), 'events': self.events()}
+                'dropped': self.dropped, 'counts': self.counts(),
+                'events': self.events()}
 
     def dump(self, path: str) -> int:
         """Atomically write the ring to ``path``; returns the retained

@@ -42,9 +42,22 @@ import os
 import time
 from collections import deque
 
-# canonical stage order for waterfall-style summaries (tools/traceview)
-STAGE_ORDER = ('submit', 'submit_source', 'compile', 'queued',
-               'coalesce.ripen', 'dispatch', 'execute', 'demux')
+# canonical stage order for waterfall-style summaries (tools/traceview).
+# The fleet stages interleave with the service stages when a request
+# crosses the wire (docs/OBSERVABILITY.md "Fleet observability"):
+# `route` and `wire.send` are router-side, the replica stages (queued..
+# demux) land inside the `wire.await` window after clock alignment.
+STAGE_ORDER = ('submit', 'submit_source', 'route', 'wire.send',
+               'compile', 'queued', 'coalesce.ripen', 'dispatch',
+               'execute', 'demux', 'wire.await')
+
+
+def _period_of(sample: float) -> int:
+    if sample <= 0.0:
+        return 0
+    if sample >= 1.0:
+        return 1
+    return max(1, int(round(1.0 / sample)))
 
 
 class TraceContext:
@@ -88,12 +101,7 @@ class Tracer:
 
     def __init__(self, sample: float = 0.0, keep: int = 1024):
         self.sample = float(sample)
-        if self.sample <= 0.0:
-            self._period = 0
-        elif self.sample >= 1.0:
-            self._period = 1
-        else:
-            self._period = max(1, int(round(1.0 / self.sample)))
+        self._period = _period_of(self.sample)
         self._seq = itertools.count()
         self._kept = deque(maxlen=keep)
 
@@ -101,15 +109,35 @@ class Tracer:
     def enabled(self) -> bool:
         return self._period > 0
 
+    def set_sample(self, sample: float) -> None:
+        """Retune the sampling rate in place, keeping the id sequence
+        and retained contexts (bench sweeps use this to compare trace
+        cost without rebuilding retention)."""
+        self.sample = float(sample)
+        self._period = _period_of(self.sample)
+
+    def sampled(self, trace_id: int) -> bool:
+        """The sampling decision as a pure function of the trace id —
+        deterministic, so two processes holding the same rate agree on
+        the same ids (the fleet router and its replicas)."""
+        return self._period > 0 and trace_id % self._period == 0
+
     def maybe_start(self) -> TraceContext | None:
         """Sampling decision for one submission: a fresh context when
         sampled (retained for later export), else ``None``."""
         if not self._period:
             return None
         n = next(self._seq)
-        if n % self._period:
+        if not self.sampled(n):
             return None
-        ctx = TraceContext(n)
+        return self.start(n)
+
+    def start(self, trace_id: int) -> TraceContext:
+        """Open a context for an externally-made sampling decision —
+        the fleet wire carries the ROUTER's decision to the replica,
+        which must trace exactly those requests regardless of its own
+        sampling rate.  Retained like locally sampled contexts."""
+        ctx = TraceContext(int(trace_id))
         self._kept.append(ctx)
         return ctx
 

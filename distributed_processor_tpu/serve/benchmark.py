@@ -719,6 +719,90 @@ def fleet_failover(n_replicas: int = 2, n_reqs: int = 60,
     }
 
 
+def fleet_observability_overhead(n_replicas: int = 2,
+                                 n_reqs: int = 24,
+                                 n_qubits: int = 2, depth: int = 2,
+                                 shots: int = 8, seed: int = 0,
+                                 sampled: float = 0.25) -> dict:
+    """What FLEET observability costs: the same closed-loop workload
+    through one fleet at trace_sample off / ``sampled`` / full
+    (docs/OBSERVABILITY.md "Fleet observability").
+
+    One fleet serves all three rounds (``set_trace_sample`` retunes the
+    router's sampler live; the sampling decision rides the wire, so the
+    replicas' piggyback cost follows the router's rate with no replica
+    restart).  Every replica is warmed on the workload bucket before
+    the off round, so round-to-round deltas isolate the tracing tax:
+    wire-frame trace ids, replica-side span capture, piggybacked span
+    return, and router-side stitching + clock alignment.  The full
+    round must actually retain stitched traces — a zero-span "full"
+    round would report an overhead it never paid."""
+    from .fleet import Fleet
+    mps, bits, cfg = _workload(n_reqs, n_qubits, depth, shots, seed)
+    refs = _solo_refs(mps, bits, cfg)
+    rounds = (('off', 0.0), ('sampled', float(sampled)),
+              ('full', 1.0))
+    out = {'n_replicas': n_replicas, 'n_reqs': n_reqs,
+           'shots_per_req': shots}
+    with Fleet(
+            n_replicas,
+            service={'max_batch_programs': 4, 'max_wait_ms': 5.0,
+                     'max_queue': 4 * n_reqs,
+                     'max_est_wait_ms': 5000.0},
+            env={'XLA_FLAGS':
+                 '--xla_force_host_platform_device_count=1'},
+    ) as fleet:
+        for rid in fleet.replica_ids():
+            fleet.router.call_replica(
+                rid, 'submit',
+                dict(mp=mps[0], meas_bits=bits[0], cfg=cfg),
+                timeout_s=600.0)
+        # untimed round: residual cold compiles at occupancy > 1 + the
+        # bit-identity gate, so the off round is a true warm baseline
+        handles = [fleet.submit(mp, b, cfg=cfg)
+                   for mp, b in zip(mps, bits)]
+        res = [h.result(timeout=600) for h in handles]
+        _assert_bit_identical(res, refs, 'fleet-obs pre-timing')
+        base_s = None
+        for label, sample in rounds:
+            fleet.set_trace_sample(sample)
+            spans0 = sum(len(c.spans)
+                         for c in fleet.router.trace_contexts())
+            t0 = time.perf_counter()
+            handles = [fleet.submit(mp, b, cfg=cfg)
+                       for mp, b in zip(mps, bits)]
+            res = [h.result(timeout=600) for h in handles]
+            dt = time.perf_counter() - t0
+            _assert_bit_identical(res, refs, f'fleet-obs {label}')
+            spans = sum(len(c.spans)
+                        for c in fleet.router.trace_contexts()) \
+                - spans0
+            entry = {'trace_sample': sample,
+                     'wall_s': round(dt, 4),
+                     'reqs_per_sec': round(n_reqs / dt, 2),
+                     'stitched_spans': spans}
+            if base_s is None:
+                base_s = dt
+            elif base_s > 0:
+                entry['overhead_vs_off'] = round(dt / base_s - 1.0, 4)
+            out[label] = entry
+        if out['full']['stitched_spans'] <= 0:
+            raise AssertionError(
+                'full round retained no stitched spans — the fleet '
+                'trace path is not actually on, the reported overhead '
+                'is fiction')
+        if out['off']['stitched_spans'] != 0:
+            raise AssertionError(
+                f"off round stitched {out['off']['stitched_spans']} "
+                f'spans — sampling off must cost (and record) nothing')
+    out['bit_identical'] = True
+    out['note'] = ('one fleet, three closed-loop rounds with the '
+                   'router sampler retuned live; replicas warmed '
+                   'before the off round; every completion bit-checked '
+                   'vs solo dispatch')
+    return out
+
+
 def compile_front_door(n_tenants: int = 4, n_programs: int = 4,
                        n_qubits: int = 2, depth: int = 4,
                        shots: int = 8, seed: int = 0,

@@ -78,7 +78,8 @@ class Fleet:
                  respawn_backoff_s: float = 0.25,
                  monitor_interval_s: float = 0.05,
                  ready_timeout_s: float = 300.0,
-                 name: str = None, router_kwargs: dict = None):
+                 name: str = None, router_kwargs: dict = None,
+                 trace_sample: float = 0.0, slo_budgets: dict = None):
         if n_replicas < 1:
             raise ValueError('n_replicas must be >= 1')
         self.name = name or 'fleet'
@@ -96,8 +97,17 @@ class Fleet:
         self._respawn_backoff_s = respawn_backoff_s
         self._monitor_interval_s = monitor_interval_s
         self._ready_timeout_s = ready_timeout_s
-        self.router = FleetRouter(name=self.name,
-                                  **(router_kwargs or {}))
+        # fleet observability: the ROUTER samples (its decision rides
+        # the wire, so replicas trace exactly the sampled set without
+        # their own sampling rate); trace_sample also reaches replicas
+        # so locally-originated diagnostics share the same knob
+        router_kwargs = dict(router_kwargs or {})
+        if trace_sample:
+            router_kwargs.setdefault('trace_sample', trace_sample)
+            self._service.setdefault('trace_sample', trace_sample)
+        if slo_budgets:
+            router_kwargs.setdefault('slo_budgets', dict(slo_budgets))
+        self.router = FleetRouter(name=self.name, **router_kwargs)
         self._lock = threading.Lock()
         self._closing = False
         self._replicas = [_ReplicaProc(f'r{i}')
@@ -271,6 +281,24 @@ class Fleet:
         rid = idx_or_rid if isinstance(idx_or_rid, str) \
             else self._replicas[idx_or_rid].rid
         return self.router.call_replica(rid, 'stats')
+
+    # -- fleet observability (docs/OBSERVABILITY.md) ---------------------
+
+    def set_trace_sample(self, sample: float) -> None:
+        self.router.set_trace_sample(sample)
+
+    def prometheus_text(self) -> str:
+        """Merged fleet exposition: every replica's metrics with a
+        ``replica`` label + rollups + the router's own fleet metrics."""
+        return self.router.prometheus_text()
+
+    def merged_flight(self, pull: bool = True) -> dict:
+        """Federated flight-recorder timeline (router + replicas)."""
+        return self.router.merged_flight(pull=pull)
+
+    def dump_trace(self, path: str) -> int:
+        """Write the stitched fleet Chrome Trace; returns event count."""
+        return self.router.dump_trace(path)
 
     def stats(self) -> dict:
         snap = self.router.stats()

@@ -26,6 +26,12 @@ three shared warm tiers: pointing every replica of a fleet at the same
 directories means a freshly respawned replica replays its warmup from
 what its PEERS compiled and persisted — the zero-cold-restart property
 the fleet tests assert.
+
+Observability env knobs (config keys win when both are set):
+``DPROC_TRACE_SAMPLE`` sets the service's local ``trace_sample`` and
+``DPROC_FLIGHT_DIR`` its ``flight_dump_dir`` — note the fleet router's
+sampling decision arrives ON THE WIRE per request regardless of the
+local rate (docs/OBSERVABILITY.md "Fleet observability").
 """
 
 from __future__ import annotations
@@ -59,8 +65,16 @@ def main(argv=None) -> int:
     icfg = None
     if cfg.get('interp_cfg'):
         icfg = InterpreterConfig(**cfg['interp_cfg'])
-    svc = ExecutionService(icfg, name=cfg.get('rid'),
-                           **(cfg.get('service') or {}))
+    skw = dict(cfg.get('service') or {})
+    # observability env knobs (config wins; env covers replicas booted
+    # outside Fleet, e.g. by hand against a remote router)
+    if os.environ.get('DPROC_TRACE_SAMPLE'):
+        skw.setdefault('trace_sample',
+                       float(os.environ['DPROC_TRACE_SAMPLE']))
+    if os.environ.get('DPROC_FLIGHT_DIR'):
+        skw.setdefault('flight_dump_dir',
+                       os.environ['DPROC_FLIGHT_DIR'])
+    svc = ExecutionService(icfg, name=cfg.get('rid'), **skw)
 
     stop = threading.Event()
     server = ReplicaServer(svc, host=cfg.get('host', '127.0.0.1'),

@@ -55,7 +55,9 @@ import numpy as np
 
 from ..sim.interpreter import is_infrastructure_error
 from ..utils import profiling
-from ..obs import FlightRecorder, Histogram
+from ..obs import (ClockOffsetEstimator, FlightRecorder, Histogram,
+                   Tracer, merged_prometheus_text,
+                   prometheus_snapshot_lines, write_chrome_trace)
 from .. import isa
 from .batcher import bucket_key
 from .request import (CancelledError, DeadlineError, RequestHandle,
@@ -86,7 +88,7 @@ class _FleetRequest:
 
     __slots__ = ('handle', 'op', 'payload', 'key', 'attempts',
                  'first_error', 'excluded', 'submit_t', 'rid',
-                 'wire_id', 'done')
+                 'wire_id', 'done', 'trace', 'sent_t')
 
     def __init__(self, op, payload, key):
         self.handle = RequestHandle()
@@ -100,6 +102,8 @@ class _FleetRequest:
         self.rid = None             # replica of the CURRENT attempt
         self.wire_id = None
         self.done = False
+        self.trace = None           # router-side TraceContext or None
+        self.sent_t = None          # wire send time of CURRENT attempt
 
 
 class _Replica:
@@ -145,7 +149,10 @@ class FleetRouter:
                  liveness_window_ms: float = 250.0,
                  breaker_threshold: int = 3,
                  breaker_cooldown_ms: float = 500.0,
-                 name: str = None, flight_events: int = 512):
+                 name: str = None, flight_events: int = 512,
+                 trace_sample: float = 0.0, trace_keep: int = 1024,
+                 slo_budgets: dict = None,
+                 slo_min_samples: int = 16):
         if liveness_window_ms <= gossip_interval_ms:
             raise ValueError('liveness window must exceed the gossip '
                              'interval (one missed beat is not death)')
@@ -158,6 +165,21 @@ class FleetRouter:
         self._breaker_cooldown_s = breaker_cooldown_ms / 1e3
         self.flight_recorder = FlightRecorder(flight_events)
         self._latency_h = Histogram('fleet.latency_ms')
+        # fleet observability (docs/OBSERVABILITY.md "Fleet
+        # observability"): the router makes the sampling decision,
+        # ships it on the wire, and stitches the replica's spans back
+        # into the same context; per-replica clock offsets come from
+        # the gossip heartbeat RTT; per-stage histograms feed the SLO
+        # watch evaluated on the gossip cadence
+        self._tracer = Tracer(trace_sample, keep=trace_keep)
+        self._clock: dict = {}          # rid -> ClockOffsetEstimator
+        self._stage_h: dict = {}        # stage name -> Histogram
+        self._flight_cache: dict = {}   # rid -> last ring digest/pull
+        self._slo_budgets = dict(slo_budgets or {})
+        self._slo_min_samples = int(slo_min_samples)
+        self._slo_state: dict = {}      # stage -> currently-breached
+        self._slo_last: dict = {}       # stage -> last evaluation
+        self._slo_breaches = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._replicas: dict = {}       # rid -> _Replica
@@ -306,6 +328,16 @@ class FleetRouter:
 
     def _enqueue(self, op, payload, key) -> RequestHandle:
         freq = _FleetRequest(op, payload, key)
+        ctx = self._tracer.maybe_start()
+        if ctx is not None:
+            # the id + decision ride the wire so the replica opens a
+            # context for exactly this request; the stitched result
+            # lands back on this same context at response time
+            freq.trace = ctx
+            freq.handle._trace = ctx
+            payload['_trace'] = ctx.trace_id
+            ctx.instant('submit', t=freq.submit_t, op=op,
+                        router=self.name)
         with self._lock:
             if self._closing:
                 raise ServiceClosedError(
@@ -336,6 +368,7 @@ class FleetRouter:
     def _dispatch(self, freq) -> None:
         """Place and send one request; parks it (the retry pump re-tries
         placement) when no replica is routable right now."""
+        t_place = time.monotonic()
         with self._lock:
             if freq.done:
                 return
@@ -345,6 +378,9 @@ class FleetRouter:
                 return
             rep = self._place_locked(freq)
             if rep is None:
+                if freq.trace is not None:
+                    freq.trace.instant('park',
+                                       reason='no-routable-replica')
                 self._park_locked(freq, time.monotonic() + 0.02)
                 return
             freq.attempts += 1
@@ -352,11 +388,22 @@ class FleetRouter:
             freq.rid = rep.rid
             freq.wire_id = None
             client = rep.client
+        ctx = freq.trace
+        if ctx is not None:
+            ctx.span('route', t_place, time.monotonic(), rid=rep.rid,
+                     attempt=token)
+        # stamp the send time BEFORE the send: the response callback
+        # (another thread) reads it for the wire.await span, and may
+        # fire before call_async even returns
+        freq.sent_t = t_send = time.monotonic()
         try:
             wire_id = client.call_async(
                 freq.op, freq.payload,
                 lambda ok, resp: self._on_response(
                     freq, rep.rid, token, ok, resp))
+            if ctx is not None:
+                ctx.span('wire.send', t_send, time.monotonic(),
+                         rid=rep.rid, attempt=token)
         except ReplicaLostError as exc:
             # the send failed (the client's loss path may have already
             # routed this attempt through _on_response — the token
@@ -386,6 +433,15 @@ class FleetRouter:
         return freq.done or freq.attempts != token or freq.rid != rid
 
     def _on_response(self, freq, rid, token, ok, payload) -> None:
+        t_resp = time.monotonic()
+        piggyback = None
+        if ok and isinstance(payload, dict) and '__trace__' in payload:
+            # replica-side spans piggybacked on the resolve reply
+            # (transport docstring).  Unwrap unconditionally: the
+            # replica may have sampled this request on its own even
+            # when the router did not
+            piggyback = payload['__trace__']
+            payload = payload['result']
         with self._lock:
             if self._stale(freq, rid, token):
                 return
@@ -399,15 +455,88 @@ class FleetRouter:
                     rep.breaker.record_success()
                 lat_ms = (time.monotonic() - freq.submit_t) * 1e3
         if ok:
+            if freq.trace is not None:
+                self._stitch(freq, rid, piggyback, t_resp)
             self._latency_h.observe(lat_ms)
+            self._observe_stage('total', lat_ms)
             profiling.counter_inc('fleet.completed')
             freq.handle._fulfill(payload)
             return
         if is_terminal_error(payload):
+            if freq.trace is not None and freq.sent_t is not None:
+                freq.trace.span('wire.await', freq.sent_t, t_resp,
+                                rid=rid, attempt=token,
+                                error=type(payload).__name__)
             with self._lock:
                 self._fail_locked(freq, payload)
             return
         self._attempt_failed(freq, rid, token, payload)
+
+    def _observe_stage(self, stage: str, dur_ms: float) -> None:
+        with self._lock:
+            h = self._stage_h.get(stage)
+            if h is None:
+                h = self._stage_h[stage] = Histogram(
+                    f'fleet.stage.{stage}_ms')
+        h.observe(dur_ms)
+
+    def _stitch(self, freq, rid, piggyback, t_resp: float) -> None:
+        """Merge a completed attempt's replica-side spans into the
+        router-side context, clock-aligned so cross-process stage
+        ordering is monotone.
+
+        The alignment: shift replica-clock times by the gossip-RTT
+        clock offset (:class:`ClockOffsetEstimator`), falling back to
+        centering the server-side window ``[mono_recv, mono_send]``
+        inside the wire window when the estimate has no samples or
+        lands the spans outside it; then clamp into the wire window —
+        a uniform shift plus clamping preserves replica-side order and
+        pins every replica span between ``wire.send`` and the response
+        arrival, so the stitched waterfall is monotone by
+        construction.  The ``wire.await`` span carries ``wire_ms``:
+        the round trip minus the replica-observed window — pure
+        wire + queueing cost of the hop."""
+        ctx = freq.trace
+        ws = freq.sent_t if freq.sent_t is not None else t_resp
+        args = {'rid': rid, 'attempt': freq.attempts}
+        spans = list(piggyback['spans'] or []) if piggyback else []
+        if piggyback and piggyback.get('mono_recv') is not None:
+            remote_win = max(
+                0.0, piggyback['mono_send'] - piggyback['mono_recv'])
+            args['wire_ms'] = round(
+                max(0.0, (t_resp - ws) - remote_win) * 1e3, 3)
+        ctx.span('wire.await', ws, t_resp, **args)
+        self._observe_stage('wire.await', (t_resp - ws) * 1e3)
+        if not spans:
+            return
+        with self._lock:
+            est = self._clock.get(rid)
+        delta = -est.offset if est is not None and est.n else None
+        lo = min(s['t0'] for s in spans)
+        hi = max(s['t1'] if s['t1'] is not None else s['t0']
+                 for s in spans)
+        if delta is None or not (ws <= lo + delta
+                                 and hi + delta <= t_resp):
+            mid_remote = None
+            if piggyback.get('mono_recv') is not None:
+                mid_remote = 0.5 * (piggyback['mono_recv']
+                                    + piggyback['mono_send'])
+            delta = 0.5 * (ws + t_resp) - (
+                mid_remote if mid_remote is not None
+                else 0.5 * (lo + hi))
+        for s in spans:
+            t0 = min(max(s['t0'] + delta, ws), t_resp)
+            t1 = None if s['t1'] is None \
+                else min(max(s['t1'] + delta, ws), t_resp)
+            sargs = dict(s['args'])
+            sargs['replica'] = rid
+            ctx.spans.append({'name': s['name'], 't0': t0, 't1': t1,
+                              'args': sargs})
+            if s['t1'] is not None:
+                # stage duration from the REPLICA's clock: offset
+                # estimation error cancels inside one clock domain
+                self._observe_stage(s['name'],
+                                    (s['t1'] - s['t0']) * 1e3)
 
     def _fail_locked(self, freq, exc) -> None:
         if freq.done:
@@ -421,9 +550,15 @@ class FleetRouter:
         """One infrastructure-class attempt failure: breaker
         bookkeeping on the replica, then retry-or-exhaust under the
         fleet RetryPolicy."""
+        t_fail = time.monotonic()
         with self._lock:
             if self._stale(freq, rid, token):
                 return
+            if freq.trace is not None and freq.sent_t is not None:
+                freq.trace.span('wire.await', freq.sent_t, t_fail,
+                                rid=rid, attempt=token,
+                                error=type(exc).__name__)
+                freq.sent_t = None
             if freq.first_error is None:
                 freq.first_error = exc
             freq.excluded.add(rid)
@@ -437,6 +572,12 @@ class FleetRouter:
                 self._fail_locked(freq, freq.first_error)
             else:
                 self._retries += 1
+                if freq.trace is not None:
+                    # the failover hop: this attempt died on `rid`,
+                    # the retry pump will re-place it elsewhere
+                    freq.trace.instant('failover', rid=rid,
+                                       error=type(exc).__name__,
+                                       attempt=token)
                 self._park_locked(
                     freq, time.monotonic()
                     + self._retry_policy.delay_s(freq.attempts - 1))
@@ -490,6 +631,18 @@ class FleetRouter:
         self.flight_recorder.record(
             'replica_down', rid=rid, reason=type(exc).__name__,
             recovered=len(recovered))
+        # federated post-mortem: try to pull the victim's flight ring.
+        # A SIGKILLed replica can't answer (the last gossiped digest
+        # stands in); a WEDGED one answers after SIGCONT — async, so a
+        # frozen socket never stalls the loss path
+        if client is not None and client.alive:
+            try:
+                client.call_async(
+                    'flight', {},
+                    lambda ok, resp: self._on_flight_pull(
+                        rid, ok, resp))
+            except Exception:           # noqa: BLE001 - best effort
+                pass
         for wire_id, (freq, token) in recovered:
             # a straggler response for this wire id must not complete
             # the handle after the retry lands elsewhere
@@ -512,20 +665,23 @@ class FleetRouter:
                         or rep.gossip_pending:
                     continue
                 rep.gossip_pending = True
+                t_send = time.monotonic()
                 try:
                     client.call_async(
-                        'stats', {},
-                        lambda ok, resp, rep=rep: self._on_gossip(
-                            rep.rid, ok, resp))
+                        'gossip', {},
+                        lambda ok, resp, rep=rep, t_send=t_send:
+                        self._on_gossip(rep.rid, ok, resp, t_send))
                 except ReplicaLostError:
                     rep.gossip_pending = False
             self._check_staleness(time.monotonic())
+            self._check_slo()
             with self._cv:
                 if self._closing:
                     return
                 self._cv.wait(self._gossip_interval_s)
 
-    def _on_gossip(self, rid, ok, resp) -> None:
+    def _on_gossip(self, rid, ok, resp, t_send: float = None) -> None:
+        t_recv = time.monotonic()
         recovered = readmitted = False
         with self._lock:
             rep = self._replicas.get(rid)
@@ -535,12 +691,31 @@ class FleetRouter:
             if not ok:
                 return
             rep.last_beat = time.monotonic()
+            stats = resp.get('stats', resp)
             rep.digest = {
-                'queue_depth': resp.get('queue_depth'),
-                'est_wait_ms': resp.get('est_wait_ms'),
-                'health': resp.get('health'),
-                'completed': resp.get('completed'),
+                'queue_depth': stats.get('queue_depth'),
+                'est_wait_ms': stats.get('est_wait_ms'),
+                'health': stats.get('health'),
+                'completed': stats.get('completed'),
             }
+            # clock probe: the heartbeat carried the replica's mono
+            # clock; (t_send, mono, t_recv) is one NTP-style sample
+            if t_send is not None and resp.get('mono') is not None:
+                est = self._clock.get(rid)
+                if est is None:
+                    est = self._clock[rid] = ClockOffsetEstimator()
+                est.add_sample(t_send, resp['mono'], t_recv)
+            # flight digest: the newest ring tail this replica ever
+            # gossiped — the post-mortem fallback when the process is
+            # SIGKILLed and the ring can no longer be pulled
+            fl = resp.get('flight')
+            if fl is not None:
+                self._flight_cache[rid] = {
+                    'source': 'gossip', 'recorded': fl['recorded'],
+                    'dropped': fl.get('dropped', 0),
+                    'counts': fl['counts'], 'events': fl['tail'],
+                    'mono': resp.get('mono'), 'cached_t': t_recv,
+                }
             if not rep.alive:
                 # a wedged replica resumed (SIGCONT): its connection
                 # never died, its heartbeat just went stale; its
@@ -580,6 +755,210 @@ class FleetRouter:
             self._replica_lost(rid, ReplicaLostError(
                 f'{rid} heartbeat stale (> '
                 f'{self._liveness_window_s * 1e3:.0f} ms)'))
+
+    def _on_flight_pull(self, rid, ok, resp) -> None:
+        if not ok or not isinstance(resp, dict):
+            return
+        with self._lock:
+            self._flight_cache[rid] = {
+                'source': 'pull', 'recorded': resp.get('recorded', 0),
+                'dropped': resp.get('dropped', 0),
+                'counts': resp.get('counts', {}),
+                'events': resp.get('events', []),
+                'mono': resp.get('mono'),
+                'cached_t': time.monotonic(),
+            }
+
+    # -- SLO watch -------------------------------------------------------
+
+    def _check_slo(self) -> None:
+        """Evaluate live per-stage p50/p99 against the configured
+        budgets (``slo_budgets={'execute': {'p99_ms': 50.0}, ...}``;
+        stage ``'total'`` is submit→fulfil latency).  Breaches are
+        edge-triggered: one ``slo_breach`` flight event + counter per
+        excursion, not one per gossip tick."""
+        if not self._slo_budgets:
+            return
+        breaches = []
+        for stage, budget in self._slo_budgets.items():
+            with self._lock:
+                h = self._latency_h if stage == 'total' \
+                    else self._stage_h.get(stage)
+            if h is None or h.count < self._slo_min_samples:
+                continue
+            p50, p99 = h.percentile(50), h.percentile(99)
+            bad = any(
+                budget.get(k) is not None and p > budget[k]
+                for k, p in (('p50_ms', p50), ('p99_ms', p99)))
+            with self._lock:
+                prev = self._slo_state.get(stage, False)
+                self._slo_state[stage] = bad
+                self._slo_last[stage] = {
+                    'p50_ms': round(p50, 3), 'p99_ms': round(p99, 3),
+                    'breached': bad, 'samples': h.count}
+                if bad and not prev:
+                    self._slo_breaches += 1
+                    breaches.append((stage, p50, p99, budget))
+        for stage, p50, p99, budget in breaches:
+            profiling.counter_inc('fleet.slo_breach')
+            self.flight_recorder.record(
+                'slo_breach', stage=stage, p50_ms=round(p50, 3),
+                p99_ms=round(p99, 3), budget=dict(budget))
+
+    # -- fleet observability (docs/OBSERVABILITY.md) ---------------------
+
+    def set_trace_sample(self, sample: float) -> None:
+        """Retune request-trace sampling live (bench sweeps and chaos
+        tooling); retained contexts survive the change."""
+        self._tracer.set_sample(sample)
+
+    def trace_contexts(self) -> list:
+        """Retained stitched trace contexts, oldest first."""
+        return self._tracer.contexts()
+
+    def dump_trace(self, path: str) -> int:
+        """Export the stitched fleet trace (router spans + clock-
+        aligned replica spans, one ``tid`` row per sampled request) as
+        Chrome Trace JSON; returns the event count."""
+        return write_chrome_trace(path, self._tracer.contexts(),
+                                  pid=f'fleet-{self.name}')
+
+    def clock_offsets(self) -> dict:
+        """Per-replica estimated clock offset (``replica - router``
+        seconds) and its worst-case error bound."""
+        with self._lock:
+            ests = dict(self._clock)
+        return {rid: {'offset_s': est.offset,
+                      'uncertainty_s': est.uncertainty_s,
+                      'samples': est.n}
+                for rid, est in sorted(ests.items()) if est.n}
+
+    def fleet_metrics(self, timeout_s: float = 10.0) -> dict:
+        """Pull every reachable replica's metrics-registry snapshot
+        (the ``fleet-metrics`` wire op); unreachable replicas are
+        silently absent — this is an observability read, never a
+        liveness judgement."""
+        out = {}
+        for rid in self.replica_ids():
+            try:
+                resp = self.call_replica(rid, 'fleet-metrics',
+                                         timeout_s=timeout_s)
+                out[rid] = resp['metrics']
+            except Exception:           # noqa: BLE001 - best effort
+                continue
+        return out
+
+    def prometheus_text(self, timeout_s: float = 10.0) -> str:
+        """One pane of glass: every replica's ``serve.*`` /
+        ``compile_cache.*`` metric re-exposed with a ``replica`` label
+        plus fleet-level rollups (summed counters, merged histograms),
+        followed by the router's own first-class fleet metrics —
+        routable count, per-replica gossip staleness and clock offset,
+        failover/park/SLO counters, per-stage latency histograms."""
+        lines = merged_prometheus_text(self.fleet_metrics(timeout_s),
+                                       label='replica')
+        lines.extend(self._fleet_prom_lines())
+        return '\n'.join(lines) + ('\n' if lines else '')
+
+    def _fleet_prom_lines(self) -> list:
+        from ..obs.metrics import _format_labels
+        with self._lock:
+            now = time.monotonic()
+            counters = {
+                'fleet.submitted': self._submitted,
+                'fleet.completed': self._completed,
+                'fleet.failed': self._failed,
+                'fleet.retries': self._retries,
+                'fleet.retry_exhausted': self._retry_exhausted,
+                'fleet.failovers': self._failovers,
+                'fleet.replica_down': self._replica_down,
+                'fleet.replica_up': self._replica_up,
+                'fleet.gossip_stale': self._gossip_stale,
+                'fleet.breaker_trips': self._breaker_trips,
+                'fleet.readmissions': self._readmissions,
+                'fleet.slo_breaches': self._slo_breaches,
+            }
+            gauges = {
+                'fleet.n_replicas': float(len(self._replicas)),
+                'fleet.n_routable': float(sum(
+                    1 for r in self._replicas.values()
+                    if r.routable())),
+                'fleet.parked': float(len(self._pending)),
+            }
+            beats = {rid: (now - rep.last_beat) * 1e3
+                     for rid, rep in sorted(self._replicas.items())}
+            offsets = {rid: est.offset * 1e3
+                       for rid, est in sorted(self._clock.items())
+                       if est.n}
+            hists = {h.name: h.state()
+                     for h in self._stage_h.values()}
+            hists[self._latency_h.name] = self._latency_h.state()
+        lines = prometheus_snapshot_lines(
+            {'counters': counters, 'gauges': gauges,
+             'histograms': hists})
+        lines.append('# TYPE fleet_heartbeat_age_ms gauge')
+        for rid, age in beats.items():
+            lines.append(
+                'fleet_heartbeat_age_ms'
+                f'{_format_labels({"replica": rid})} {round(age, 3)}')
+        if offsets:
+            lines.append('# TYPE fleet_clock_offset_ms gauge')
+            for rid, off in offsets.items():
+                lines.append(
+                    'fleet_clock_offset_ms'
+                    f'{_format_labels({"replica": rid})} '
+                    f'{round(off, 3)}')
+        return lines
+
+    def merged_flight(self, pull: bool = True,
+                      timeout_s: float = 2.0) -> dict:
+        """The federated incident timeline: the router's own ring plus
+        every replica's (live-pulled when reachable, else the last
+        gossiped digest), each event time-aligned onto the router's
+        clock via the gossip-RTT offset and merged into one ordered
+        stream.  Events carry ``origin`` (``router`` or the replica
+        id) and ``t_router`` (aligned monotonic seconds)."""
+        if pull:
+            for rid in self.replica_ids():
+                try:
+                    resp = self.call_replica(rid, 'flight',
+                                             timeout_s=timeout_s)
+                    self._on_flight_pull(rid, True, resp)
+                except Exception:       # noqa: BLE001 - cache stands
+                    continue
+        with self._lock:
+            cache = {rid: dict(c)
+                     for rid, c in self._flight_cache.items()}
+            offsets = {rid: est.offset
+                       for rid, est in self._clock.items() if est.n}
+        merged = []
+        for ev in self.flight_recorder.events():
+            e = dict(ev)
+            e['origin'] = 'router'
+            e['t_router'] = ev.get('mono')
+            merged.append(e)
+        for rid, c in sorted(cache.items()):
+            off = offsets.get(rid)
+            for ev in c['events']:
+                e = dict(ev)
+                e['origin'] = rid
+                m = ev.get('mono')
+                e['t_router'] = None if m is None \
+                    else (m - off if off is not None else m)
+                merged.append(e)
+        merged.sort(key=lambda e: (e['t_router'] is None,
+                                   e['t_router'] or 0.0))
+        return {
+            'router': {'recorded': self.flight_recorder.recorded,
+                       'dropped': self.flight_recorder.dropped,
+                       'counts': self.flight_recorder.counts()},
+            'replicas': {rid: {k: c.get(k) for k in
+                               ('source', 'recorded', 'dropped',
+                                'counts')}
+                         for rid, c in sorted(cache.items())},
+            'clock_offsets': self.clock_offsets(),
+            'events': merged,
+        }
 
     # -- retry pump ------------------------------------------------------
 
@@ -632,6 +1011,9 @@ class FleetRouter:
                 'breaker_trips': self._breaker_trips,
                 'readmissions': self._readmissions,
                 'home_buckets': len(self._home),
+                'slo_breaches': self._slo_breaches,
+                'slo': {stage: dict(ev)
+                        for stage, ev in sorted(self._slo_last.items())},
             }
         lat = np.asarray(self._latency_h.values(), np.float64)
         if lat.size:

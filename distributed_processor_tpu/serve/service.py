@@ -520,6 +520,18 @@ class ExecutionService:
 
     # -- submission ------------------------------------------------------
 
+    def traced_handle(self, trace_id: int) -> RequestHandle:
+        """A fresh handle pre-bound to a forced :class:`TraceContext`:
+        the fleet wire carries the ROUTER's sampling decision
+        (deterministic on the trace id — docs/OBSERVABILITY.md "Fleet
+        observability"), so the replica must trace exactly those
+        requests regardless of its own sampling rate.  Pass it via
+        ``_handle=`` so the submit path appends onto the same context
+        the router will stitch."""
+        h = RequestHandle()
+        h._trace = self._tracer.start(trace_id)
+        return h
+
     def submit(self, mp, meas_bits=None, *, shots: int = None,
                init_regs=None, cfg: InterpreterConfig = None,
                priority: int = 0, deadline_ms: float = None,
@@ -655,7 +667,8 @@ class ExecutionService:
                       deadline_ms: float = None, fault_mode: str = None,
                       n_qubits: int = 8, pad_to: int = None,
                       channel_configs=None, fpga_config=None,
-                      compiler_flags=None):
+                      compiler_flags=None,
+                      _handle: RequestHandle = None):
         """Submit PROGRAM SOURCE — a dict-instruction list or OpenQASM 3
         text — instead of a pre-built MachineProgram; returns a
         :class:`RequestHandle` immediately.
@@ -674,11 +687,15 @@ class ExecutionService:
         ``deadline_ms`` arms at dispatch (compile time is not charged
         against it).
         """
-        handle = RequestHandle()
-        # the sampling decision for a source submission happens here,
-        # at the tenant-visible boundary, so the compile span lands on
-        # the same context the dispatch spans will
-        ctx = self._tracer.maybe_start()
+        # _handle: the fleet wire hands over a pre-made handle (and
+        # possibly a forced trace context carrying the router's
+        # sampling decision); everything else gets a fresh handle and
+        # draws the sampling decision here, at the tenant-visible
+        # boundary, so the compile span lands on the same context the
+        # dispatch spans will
+        handle = _handle if _handle is not None else RequestHandle()
+        ctx = handle._trace if _handle is not None \
+            else self._tracer.maybe_start()
         if ctx is not None:
             handle._trace = ctx
             ctx.instant('submit_source')

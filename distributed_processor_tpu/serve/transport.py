@@ -11,14 +11,29 @@ carries many in-flight submissions:
     server -> client   (req_id, ok: bool, payload)
 
 ``op`` is one of ``submit`` / ``submit_source`` / ``stats`` / ``ping``
-/ ``shutdown``.  A ``submit`` gets exactly one response — sent when the
-request RESOLVES, so admission errors (``QueueFullError``,
-``OverloadError``), typed program failures (``FaultError``, validation)
-and results all ride the same frame, preserving the
+/ ``gossip`` / ``fleet-metrics`` / ``flight`` / ``shutdown``.  A
+``submit`` gets exactly one response — sent when the request RESOLVES,
+so admission errors (``QueueFullError``, ``OverloadError``), typed
+program failures (``FaultError``, validation) and results all ride the
+same frame, preserving the
 :func:`~..sim.interpreter.is_infrastructure_error` taxonomy across the
 wire: both sides share this codebase, so exceptions pickle as their
 real types and the router can re-apply the retry rules the in-process
 supervision layer uses.
+
+Fleet observability rides the same frames (docs/OBSERVABILITY.md
+"Fleet observability"): a submit payload may carry ``_trace``, the
+router's trace id for a SAMPLED request — the server opens a forced
+replica-side :class:`TraceContext` for it and piggybacks the recorded
+spans back on the resolve reply as ``{'__trace__': {'spans': [...],
+'mono_recv': ..., 'mono_send': ...}, 'result': <stats>}`` (the two
+``mono`` stamps are replica-clock bounds of the server-side window, so
+the router can split wire time from replica time).  ``gossip`` returns
+the stats digest plus the replica's monotonic clock (the router's
+clock-offset probe) and a flight-ring digest; ``fleet-metrics``
+returns the replica's whole metrics-registry snapshot for labeled
+re-exposition; ``flight`` returns the full flight ring for the
+federated post-mortem pull.
 
 Server side, submissions are enqueued into the service from the
 connection's reader thread (``ExecutionService.submit`` never blocks on
@@ -41,6 +56,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 WIRE_THREAD_PREFIX = 'dproc-serve-wire'
@@ -48,7 +64,8 @@ WIRE_THREAD_PREFIX = 'dproc-serve-wire'
 _LEN = struct.Struct('>I')
 _MAX_FRAME = 1 << 29          # 512 MiB: desync/corruption guard
 
-OPS = ('submit', 'submit_source', 'stats', 'ping', 'shutdown')
+OPS = ('submit', 'submit_source', 'stats', 'ping', 'gossip',
+       'fleet-metrics', 'flight', 'shutdown')
 
 
 class ReplicaLostError(RuntimeError):
@@ -111,9 +128,11 @@ class ReplicaServer:
     """
 
     def __init__(self, svc, host: str = '127.0.0.1', port: int = 0,
-                 max_waiters: int = 32, on_shutdown=None):
+                 max_waiters: int = 32, on_shutdown=None,
+                 flight_tail: int = 32):
         self._svc = svc
         self._on_shutdown = on_shutdown
+        self._flight_tail = int(flight_tail)
         self._closing = False
         self._conns = set()
         self._conns_lock = threading.Lock()
@@ -164,22 +183,54 @@ class ReplicaServer:
 
     def _dispatch(self, conn, wlock, req_id, op, payload) -> None:
         try:
-            if op == 'submit':
-                handle = self._svc.submit(**payload)
+            if op in ('submit', 'submit_source'):
+                t_recv = time.monotonic()
+                # `_trace` = the router's sampling decision for this
+                # request: open a forced replica-side context so the
+                # spans recorded here ship back on the resolve reply
+                trace_id = payload.pop('_trace', None)
+                kw = dict(payload)
+                if trace_id is not None:
+                    kw['_handle'] = self._svc.traced_handle(
+                        int(trace_id))
+                handle = self._svc.submit(**kw) if op == 'submit' \
+                    else self._svc.submit_source(**kw)
                 self._pool.submit(self._send_on_resolve, conn, wlock,
-                                  req_id, handle)
-                return
-            if op == 'submit_source':
-                handle = self._svc.submit_source(**payload)
-                self._pool.submit(self._send_on_resolve, conn, wlock,
-                                  req_id, handle)
+                                  req_id, handle, t_recv)
                 return
             if op == 'stats':
                 self._reply(conn, wlock, req_id, True,
                             self._svc.stats())
                 return
             if op == 'ping':
-                self._reply(conn, wlock, req_id, True, {'pong': True})
+                self._reply(conn, wlock, req_id, True,
+                            {'pong': True, 'mono': time.monotonic()})
+                return
+            if op == 'gossip':
+                # one frame = heartbeat + clock probe + flight digest:
+                # the router re-arms liveness, feeds its offset
+                # estimator, and caches the event tail for the
+                # federated post-mortem (docs/OBSERVABILITY.md)
+                fl = self._svc.flight_recorder
+                self._reply(conn, wlock, req_id, True, {
+                    'stats': self._svc.stats(),
+                    'mono': time.monotonic(),
+                    'flight': {'recorded': fl.recorded,
+                               'dropped': fl.dropped,
+                               'counts': fl.counts(),
+                               'tail': fl.events()[-self._flight_tail:]},
+                })
+                return
+            if op == 'fleet-metrics':
+                from ..utils import profiling
+                self._reply(conn, wlock, req_id, True, {
+                    'mono': time.monotonic(),
+                    'metrics': profiling.registry().snapshot()})
+                return
+            if op == 'flight':
+                doc = self._svc.flight_recorder.to_json()
+                doc['mono'] = time.monotonic()
+                self._reply(conn, wlock, req_id, True, doc)
                 return
             if op == 'shutdown':
                 self._reply(conn, wlock, req_id, True, {'bye': True})
@@ -191,7 +242,8 @@ class ReplicaServer:
             self._reply(conn, wlock, req_id, False,
                         _picklable_error(exc))
 
-    def _send_on_resolve(self, conn, wlock, req_id, handle) -> None:
+    def _send_on_resolve(self, conn, wlock, req_id, handle,
+                         t_recv: float = None) -> None:
         # blocks until the service resolves the handle: shutdown
         # force-fails every unresolved handle, so this always returns
         try:
@@ -200,7 +252,17 @@ class ReplicaServer:
             exc = exc2
         try:
             if exc is None:
-                self._reply(conn, wlock, req_id, True, handle.result())
+                result = handle.result()
+                if handle._trace is not None:
+                    # piggyback the replica-side spans (replica-clock
+                    # times; the two mono stamps bound the server-side
+                    # window so the router can price the wire hop)
+                    result = {'__trace__': {
+                        'spans': handle.trace(),
+                        'mono_recv': t_recv,
+                        'mono_send': time.monotonic()},
+                        'result': result}
+                self._reply(conn, wlock, req_id, True, result)
             else:
                 self._reply(conn, wlock, req_id, False,
                             _picklable_error(exc))

@@ -1,0 +1,526 @@
+"""Fleet-wide observability (docs/OBSERVABILITY.md "Fleet observability").
+
+The cross-process observability contract, pinned here:
+
+* **Clock alignment is bounded**: the NTP-style gossip estimator's
+  offset is wrong by at most half the RTT of its best sample, and the
+  min-RTT sample wins — synthetic probes with a known true offset pin
+  the bound exactly.
+* **The wire carries the router's sampling decision**: replicas trace
+  exactly the requests the router sampled even with their own local
+  sampling OFF, and the replica-side spans come back piggybacked and
+  stitched into the router's context, monotone inside the wire window.
+* **Merged metrics are exact**: ``FleetRouter.prometheus_text()``
+  re-exposes every replica's ``serve.*`` series under a ``replica``
+  label, and the unlabeled rollup equals the sum of the labeled
+  series pulled directly over the wire.
+* **The federated flight ring survives**: ``merged_flight`` produces
+  one time-aligned stream with ``origin`` and ``t_router`` on every
+  event, plus per-ring truncation (``dropped``) accounting.
+* **Telemetry names are frozen**: fleet-level metric and span names
+  are pinned by literal manifests — renaming one breaks dashboards,
+  so it must break this test first.
+
+This module is listed in tools/check_junit.py NO_SKIP_MODULES: it runs
+on localhost TCP + the forced CPU backend with no hardware dependency.
+"""
+
+import importlib.util
+import json
+import pathlib
+import re
+import time
+
+import numpy as np
+import pytest
+
+from distributed_processor_tpu.obs import (ClockOffsetEstimator,
+                                           FlightRecorder,
+                                           MetricsRegistry,
+                                           STAGE_ORDER, Tracer,
+                                           escape_label_value,
+                                           merged_prometheus_text,
+                                           prometheus_snapshot_lines)
+from distributed_processor_tpu.serve import RetryPolicy
+from distributed_processor_tpu.serve.benchmark import _workload
+from distributed_processor_tpu.serve.fleet import Fleet
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+_TOOLS = pathlib.Path(__file__).resolve().parents[1] / 'tools'
+
+
+def _load_traceview():
+    spec = importlib.util.spec_from_file_location(
+        'traceview', _TOOLS / 'traceview.py')
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+traceview = _load_traceview()
+
+
+@pytest.fixture(autouse=True)
+def _serve_thread_leak_probe():
+    """Override the per-test conftest probe: the module-scoped Fleet
+    below keeps router/wire threads alive across tests BY DESIGN.  The
+    leak boundary moves to module teardown (the autouse module fixture
+    next), after the fleet has shut down."""
+    yield
+
+
+@pytest.fixture(autouse=True, scope='module')
+def _fleet_thread_boundary():
+    """After the module-scoped fleet shuts down, every dproc-serve*
+    thread must be joined — prints the junit-gated marker otherwise."""
+    import threading
+    yield
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        leaked = sorted(t.name for t in threading.enumerate()
+                        if t.name.startswith('dproc-serve')
+                        and t.is_alive())
+        if not leaked:
+            return
+        time.sleep(0.05)
+    print(f'SERVICE THREAD LEAK: {leaked}')
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimator (obs/clock.py)
+# ---------------------------------------------------------------------------
+
+def test_clock_offset_estimator_bounded_skew():
+    """Synthetic probes against a remote clock running exactly D ahead:
+    however asymmetric each round trip, the estimate is within rtt/2 of
+    the truth, and the min-RTT sample's (tightest) bound wins."""
+    D = 0.25                  # true remote - local offset, seconds
+    est = ClockOffsetEstimator()
+    # (rtt, where inside the rtt the remote stamped its clock)
+    probes = [(0.020, 0.9), (0.008, 0.1), (0.002, 0.8), (0.050, 0.5)]
+    t = 100.0
+    for rtt, frac in probes:
+        est.add_sample(t, t + frac * rtt + D, t + rtt)
+        t += 1.0
+    min_rtt = min(rtt for rtt, _ in probes)
+    assert est.n == len(probes)
+    # the reported bound is half the best sample's RTT...
+    assert est.uncertainty_s == pytest.approx(0.5 * min_rtt)
+    # ...and the estimate honours it against the known truth
+    assert abs(est.offset - D) <= est.uncertainty_s + 1e-12
+    # mapping round-trips exactly
+    assert est.to_local(est.to_remote(42.0)) == pytest.approx(42.0)
+
+
+def test_clock_offset_estimator_empty_and_min_rtt():
+    est = ClockOffsetEstimator()
+    assert est.n == 0
+    assert est.offset == 0.0
+    assert est.uncertainty_s == float('inf')
+    # a later, tighter probe displaces a sloppier earlier one
+    est.add_sample(0.0, 0.55, 1.0)          # rtt 1.0, offset 0.05
+    est.add_sample(10.0, 10.1005, 10.001)   # rtt 1ms, offset ~0.1
+    assert est.uncertainty_s == pytest.approx(0.0005)
+    assert est.offset == pytest.approx(0.1, abs=0.001)
+
+
+# ---------------------------------------------------------------------------
+# deterministic wire sampling (obs/trace.py)
+# ---------------------------------------------------------------------------
+
+def test_tracer_wire_sampling_is_deterministic():
+    """Two processes holding the same rate must agree on the same
+    trace ids — the router's decision rides the wire and the replica
+    re-derives nothing, but the pure function still has to match."""
+    a, b = Tracer(sample=0.25), Tracer(sample=0.25)
+    for tid in range(32):
+        assert a.sampled(tid) == b.sampled(tid) == (tid % 4 == 0)
+    off = Tracer(sample=0.0)
+    assert not any(off.sampled(t) for t in range(32))
+    assert off.maybe_start() is None
+    full = Tracer(sample=1.0)
+    assert all(full.sampled(t) for t in range(32))
+
+
+def test_tracer_set_sample_keeps_retention_and_forced_start():
+    tr = Tracer(sample=0.0, keep=8)
+    # forced start (the wire-carried decision): retained regardless of
+    # the local rate
+    ctx = tr.start(7)
+    assert ctx.trace_id == 7 and tr.contexts() == [ctx]
+    tr.set_sample(1.0)
+    assert tr.contexts() == [ctx]       # retention survives the retune
+    assert tr.maybe_start() is not None
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder truncation accounting (obs/recorder.py)
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_dropped_counter():
+    """A wrapped ring is a TRUNCATED incident timeline — the dump must
+    say so, not read as a quiet one."""
+    fr = FlightRecorder(capacity=4)
+    assert fr.dropped == 0
+    for i in range(10):
+        fr.record('ev', i=i)
+    assert fr.recorded == 10
+    assert fr.dropped == 6
+    assert len(fr.events()) == 4
+    assert [e['i'] for e in fr.events()] == [6, 7, 8, 9]
+    doc = fr.to_json()
+    assert doc['recorded'] == 10 and doc['dropped'] == 6
+    assert json.loads(json.dumps(doc)) == doc      # JSON-clean
+
+
+# ---------------------------------------------------------------------------
+# Prometheus escaping + merged exposition (obs/metrics.py)
+# ---------------------------------------------------------------------------
+
+def test_escape_label_value():
+    assert escape_label_value('plain') == 'plain'
+    assert escape_label_value('a\\b') == 'a\\\\b'
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value('a\nb') == 'a\\nb'
+    assert escape_label_value('\\"\n') == '\\\\\\"\\n'
+    # and through the label-rendering path end to end
+    lines = prometheus_snapshot_lines(
+        {'counters': {'serve.submitted': 1}},
+        labels={'replica': 'r"0\\x\n'})
+    assert 'serve_submitted{replica="r\\"0\\\\x\\n"} 1' in lines
+
+
+def test_merged_prometheus_text_rollup_and_labels():
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    ra.inc('serve.submitted', 2)
+    rb.inc('serve.submitted', 3)
+    rb.inc('serve.only_b', 1)
+    ra.set_gauge('serve.queue_depth', 4.0)
+    ra.observe('serve.latency_ms', 1.0)
+    rb.observe('serve.latency_ms', 2.0)
+    lines = merged_prometheus_text(
+        {'r0': ra.snapshot(), 'r1': rb.snapshot()}, label='replica')
+    # counters: one TYPE line, an unlabeled rollup = the sum, then one
+    # labeled series per replica (absent replicas omitted, not zeroed)
+    assert lines.count('# TYPE serve_submitted counter') == 1
+    assert 'serve_submitted 5' in lines
+    assert 'serve_submitted{replica="r0"} 2' in lines
+    assert 'serve_submitted{replica="r1"} 3' in lines
+    assert 'serve_only_b{replica="r1"} 1' in lines
+    assert not any(ln.startswith('serve_only_b{replica="r0"}')
+                   for ln in lines)
+    # gauges never roll up (summing queue depths across processes is a
+    # lie); labeled series only
+    assert 'serve_queue_depth{replica="r0"} 4.0' in lines
+    assert not any(re.fullmatch(r'serve_queue_depth [\d.]+', ln)
+                   for ln in lines)
+    # histograms: ladders agree here, so the rollup sums buckets/n/sum
+    assert 'serve_latency_ms_count 2' in lines
+    assert 'serve_latency_ms_sum 3.0' in lines
+    assert 'serve_latency_ms_count{replica="r0"} 1' in lines
+    assert 'serve_latency_ms_count{replica="r1"} 1' in lines
+
+
+def test_merged_histogram_rollup_skipped_on_ladder_mismatch():
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    ra.observe('serve.latency_ms', 1.0)
+    rb.observe('serve.latency_ms', 2.0, buckets=(1.0, 10.0))
+    lines = merged_prometheus_text(
+        {'r0': ra.snapshot(), 'r1': rb.snapshot()})
+    # per-replica series survive; no unlabeled (summed) rollup exists
+    assert 'serve_latency_ms_count{replica="r0"} 1' in lines
+    assert 'serve_latency_ms_count{replica="r1"} 1' in lines
+    assert not any(re.fullmatch(r'serve_latency_ms_count \d+', ln)
+                   for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# traceview rejects empty/invalid traces (tools/traceview.py)
+# ---------------------------------------------------------------------------
+
+def test_traceview_stage_order_matches_obs():
+    """tools/traceview.py carries a copy of the canonical stage order
+    (it must stay importable without the package); keep them in sync."""
+    assert tuple(traceview.STAGE_ORDER) == tuple(STAGE_ORDER)
+
+
+@pytest.mark.parametrize('content,msg', [
+    ('{not json', 'not valid JSON'),
+    ('[1, 2]', 'expected a Chrome Trace object'),
+    ('{"other": 1}', 'no "traceEvents" array'),
+    ('{"traceEvents": []}', 'zero events'),
+])
+def test_traceview_summarize_rejects(tmp_path, content, msg):
+    p = tmp_path / 'bad.json'
+    p.write_text(content)
+    with pytest.raises(ValueError, match=re.escape(msg)):
+        traceview.summarize(str(p))
+
+
+def test_traceview_main_exits_nonzero_on_empty(tmp_path, capsys):
+    p = tmp_path / 'empty.json'
+    p.write_text('{"traceEvents": []}')
+    assert traceview.main([str(p)]) == 1
+    assert 'traceview: cannot read' in capsys.readouterr().err
+    assert traceview.main([str(tmp_path / 'absent.json')]) == 1
+
+
+# ---------------------------------------------------------------------------
+# frozen fleet telemetry manifests
+# ---------------------------------------------------------------------------
+
+# every router-exposed fleet_* metric, frozen (Prometheus names):
+# renaming one breaks dashboards, so it must break this test first
+_FLEET_COUNTERS = {
+    'fleet_submitted', 'fleet_completed', 'fleet_failed',
+    'fleet_retries', 'fleet_retry_exhausted', 'fleet_failovers',
+    'fleet_replica_down', 'fleet_replica_up', 'fleet_gossip_stale',
+    'fleet_breaker_trips', 'fleet_readmissions', 'fleet_slo_breaches',
+}
+_FLEET_GAUGES = {
+    'fleet_n_replicas', 'fleet_n_routable', 'fleet_parked',
+    'fleet_heartbeat_age_ms',
+}
+# every span name a stitched fleet trace may contain, frozen: the
+# router-side stages/hops plus the replica-side service taxonomy
+_FLEET_SPAN_NAMES = set(STAGE_ORDER) | {
+    'failover', 'park', 'unpark', 'steal', 'migrate', 'retry',
+    'retry_exhausted', 'requeue', 'chaos', 'shed', 'batch_error',
+    'done',
+}
+_ROUTER_CORE_SPANS = {'submit', 'route', 'wire.send', 'wire.await'}
+
+
+def _prom_series(text: str, name: str) -> dict:
+    """{replica_label_or_None: value} for one exact metric name."""
+    out = {}
+    pat = re.compile(
+        rf'^{re.escape(name)}(?:{{replica="([^"]*)"}})? (\S+)$')
+    for ln in text.splitlines():
+        m = pat.match(ln)
+        if m:
+            out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def test_fleet_metric_manifest_is_byte_compatible():
+    """An empty router (no replicas, no traffic) must already expose
+    every frozen fleet_* series — dashboards key on the names existing
+    from boot, not appearing after the first failover."""
+    from distributed_processor_tpu.serve import FleetRouter
+    with FleetRouter(name='manifest') as router:
+        text = router.prometheus_text(timeout_s=1.0)
+    for pn in sorted(_FLEET_COUNTERS):
+        assert f'# TYPE {pn} counter' in text, pn
+        assert _prom_series(text, pn), pn
+    for pn in sorted(_FLEET_GAUGES - {'fleet_heartbeat_age_ms'}):
+        assert f'# TYPE {pn} gauge' in text, pn
+        assert _prom_series(text, pn), pn
+    # per-replica gauges: TYPE line always present, series per replica
+    assert '# TYPE fleet_heartbeat_age_ms gauge' in text
+
+
+# ---------------------------------------------------------------------------
+# live fleet: replica processes on localhost TCP
+# ---------------------------------------------------------------------------
+
+N_REQS = 6
+
+
+@pytest.fixture(scope='module')
+def workload():
+    return _workload(N_REQS, 2, 2, 4, seed=7)
+
+
+@pytest.fixture(scope='module')
+def fleet(workload):
+    mps, bits, cfg = workload
+    # trace_sample goes to the ROUTER ONLY: the replicas' local
+    # samplers stay off, so every replica-side span in the tests below
+    # exists because the router's decision rode the wire (the tentpole
+    # contract), not because the replica sampled on its own
+    with Fleet(2,
+               service={'max_batch_programs': 4, 'max_wait_ms': 5.0,
+                        'max_queue': 256},
+               env={'XLA_FLAGS':
+                    '--xla_force_host_platform_device_count=1'},
+               router_kwargs={
+                   'retry_policy': RetryPolicy(max_attempts=10,
+                                               backoff_s=0.05,
+                                               max_backoff_s=1.0),
+                   'trace_sample': 1.0,
+                   'trace_keep': 64,
+                   # impossible budget + tiny warm-up window: the SLO
+                   # watch must breach as soon as traffic flows
+                   'slo_budgets': {'total': {'p99_ms': 1e-4}},
+                   'slo_min_samples': 4,
+               }) as f:
+        for rid in f.replica_ids():
+            f.router.call_replica(
+                rid, 'submit',
+                dict(mp=mps[0], meas_bits=bits[0], cfg=cfg),
+                timeout_s=600.0)
+        yield f
+
+
+def _run_workload(fleet, workload):
+    mps, bits, cfg = workload
+    handles = [fleet.submit(mps[i], bits[i], cfg=cfg)
+               for i in range(N_REQS)]
+    for h in handles:
+        h.result(timeout=300)
+
+
+def _stitched_contexts(fleet):
+    """Retained router contexts that completed a full wire round."""
+    return [c for c in fleet.router.trace_contexts()
+            if any(s['name'] == 'wire.await' for s in c.spans)]
+
+
+def test_wire_trace_stitching_monotone(fleet, workload, tmp_path):
+    """The acceptance shape: a sampled request's context holds the
+    router-side spans AND the replica-side spans (tagged with the
+    serving replica), clock-aligned inside the wire window so the
+    waterfall is monotone, and the export drives traceview."""
+    _run_workload(fleet, workload)
+    ctxs = _stitched_contexts(fleet)
+    assert ctxs, 'no stitched contexts at trace_sample=1.0'
+    rids = set(fleet.replica_ids())
+    saw_replica_side = False
+    for ctx in ctxs:
+        names = [s['name'] for s in ctx.spans]
+        assert set(names) <= _FLEET_SPAN_NAMES, set(names) - \
+            _FLEET_SPAN_NAMES
+        assert _ROUTER_CORE_SPANS <= set(names)
+        wire = [s for s in ctx.spans if s['name'] == 'wire.await']
+        ws = min(s['t0'] for s in wire)
+        we = max(s['t1'] for s in wire)
+        for s in ctx.spans:
+            rid = s['args'].get('replica')
+            if rid is None:
+                continue
+            saw_replica_side = True
+            assert rid in rids
+            assert s['name'] in _FLEET_SPAN_NAMES
+            # clamped into the wire window => monotone ordering
+            # against the router-side spans is guaranteed
+            assert ws - 1e-9 <= s['t0'] <= we + 1e-9
+            if s['t1'] is not None:
+                assert s['t0'] <= s['t1'] <= we + 1e-9
+    assert saw_replica_side, \
+        'no replica-side spans piggybacked back over the wire'
+    # the dump round-trips through the waterfall tool: the fleet pid
+    # row exists and wire.await carries its wire_ms column
+    out = tmp_path / 'fleet_trace.json'
+    n = fleet.dump_trace(str(out))
+    assert n > 0
+    summary = traceview.summarize(str(out))
+    assert summary['events'] == n
+    assert summary['processes'] >= 1
+    stages = {s['stage']: s for s in summary['stages']}
+    assert 'wire.await' in stages
+    assert 'wire_p50_ms' in stages['wire.await']
+
+
+def test_router_stage_histograms_feed_stats(fleet, workload):
+    _run_workload(fleet, workload)
+    s = fleet.stats()
+    assert s['completed'] >= N_REQS
+    # stitched per-stage histograms observed replica-side stages too
+    text = fleet.prometheus_text()
+    assert '# TYPE fleet_stage_wire_await_ms histogram' in text
+    assert _prom_series(text, 'fleet_stage_wire_await_ms_count')
+
+
+def test_prometheus_per_replica_sums_match_direct(fleet, workload):
+    """The acceptance criterion: the labeled serve.* series equal the
+    snapshots pulled directly from each replica, and the unlabeled
+    rollup is exactly their sum."""
+    _run_workload(fleet, workload)
+    text = fleet.prometheus_text()
+    direct = {rid: fleet.router.call_replica(rid, 'fleet-metrics',
+                                             timeout_s=30.0)['metrics']
+              for rid in fleet.replica_ids()}
+    series = _prom_series(text, 'serve_submitted')
+    assert set(series) == set(direct) | {None}
+    for rid, snap in direct.items():
+        want = snap['counters'].get('serve.submitted', 0)
+        # the direct pull ran after the exposition pull; monotone
+        # counters can only have grown in between
+        assert series[rid] <= want
+        assert want - series[rid] <= N_REQS
+    assert series[None] == sum(v for rid, v in series.items()
+                               if rid is not None)
+    # the two replicas between them served everything this module sent
+    assert series[None] > 0
+
+
+def test_gossip_op_carries_flight_digest_and_clock(fleet):
+    """The gossip reply is the observability piggyback: stats + the
+    replica's monotonic stamp (clock probe) + a flight-ring digest."""
+    for rid in fleet.replica_ids():
+        resp = fleet.router.call_replica(rid, 'gossip', timeout_s=30.0)
+        assert {'stats', 'mono', 'flight'} <= set(resp)
+        assert isinstance(resp['mono'], float)
+        fl = resp['flight']
+        assert {'recorded', 'dropped', 'counts', 'tail'} <= set(fl)
+        assert fl['dropped'] >= 0
+    # the router-side estimators converge off the same heartbeats:
+    # same-host clocks share an epoch, so offsets are RTT-scale tiny
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        offs = fleet.router.clock_offsets()
+        if set(offs) == set(fleet.replica_ids()):
+            break
+        time.sleep(0.05)
+    assert set(offs) == set(fleet.replica_ids()), offs
+    for rid, o in offs.items():
+        assert o['samples'] > 0
+        assert o['uncertainty_s'] < float('inf')
+        assert abs(o['offset_s']) <= max(1.0, 10 * o['uncertainty_s'])
+
+
+def test_merged_flight_is_time_aligned(fleet, workload):
+    _run_workload(fleet, workload)
+    mf = fleet.merged_flight(pull=True)
+    assert {'router', 'replicas', 'clock_offsets', 'events'} <= set(mf)
+    assert mf['router']['recorded'] >= 0
+    assert mf['router']['dropped'] >= 0
+    assert set(mf['replicas']) == set(fleet.replica_ids())
+    for rid, ring in mf['replicas'].items():
+        assert ring['source'] in ('pull', 'gossip')
+        assert ring['recorded'] >= 0 and ring['dropped'] >= 0
+    origins = {e['origin'] for e in mf['events']}
+    assert 'router' in origins, mf['router']
+    for e in mf['events']:
+        assert 'origin' in e and 't_router' in e and 'kind' in e
+    aligned = [e['t_router'] for e in mf['events']
+               if e['t_router'] is not None]
+    assert aligned == sorted(aligned)
+    # the merged doc is what servechaos --flight-out dumps: JSON-clean
+    json.dumps(mf)
+
+
+def test_slo_watch_breaches_on_impossible_budget(fleet, workload):
+    """The module budget (p99 <= 0.1 µs on 'total') cannot be met by
+    any real round trip: after enough samples and a gossip tick the
+    watch must have fired — counter, stats detail, and flight event."""
+    _run_workload(fleet, workload)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        s = fleet.stats()
+        if s.get('slo_breaches', 0) >= 1:
+            break
+        time.sleep(0.05)
+    assert s.get('slo_breaches', 0) >= 1, s
+    slo = s['slo']
+    assert 'total' in slo and slo['total']['breached']
+    assert slo['total']['p99_ms'] > 0
+    assert slo['total']['samples'] >= 4
+    kinds = [e['kind'] for e in fleet.router.flight_recorder.events()]
+    assert 'slo_breach' in kinds
+    # and the breach is visible on the exposition
+    series = _prom_series(fleet.prometheus_text(),
+                          'fleet_slo_breaches')
+    assert series[None] >= 1

@@ -50,6 +50,13 @@ NO_SKIP_MODULES = {
         'dependency — a skip means the replica-loss contract '
         '(docs/FLEET.md: failover bit-identity, gossip staleness, '
         'warm respawn) stopped being exercised',
+    'test_fleet_obs':
+        'fleet observability tests (trace stitching, clock-offset '
+        'alignment, merged metrics, federated flight recorder) run on '
+        'the same localhost-TCP + forced-CPU stack as test_fleet, '
+        'with no hardware dependency — a skip means the cross-process '
+        'observability contract (docs/OBSERVABILITY.md "Fleet '
+        'observability") stopped being exercised',
 }
 
 # the multi-device serve suite may skip ONLY on a genuinely

@@ -77,10 +77,16 @@ def main(argv=None) -> int:
     ap.add_argument('--flight-out', default=None, metavar='PATH',
                     help='dump the full flight-recorder ring to PATH '
                          '(the exit report always carries counts + the '
-                         'event tail)')
+                         'event tail); in --fleet mode this is the '
+                         'FEDERATED ring — router + every replica, '
+                         'live-pulled or last-gossiped, time-aligned '
+                         'onto the router clock')
     ap.add_argument('--trace-out', default=None, metavar='PATH',
                     help='trace every request (sample=1.0) and export '
-                         'the Chrome-trace JSON to PATH')
+                         'the Chrome-trace JSON to PATH; in --fleet '
+                         'mode the trace is the STITCHED cross-process '
+                         'waterfall (router + clock-aligned replica '
+                         'spans on one tid per request)')
     ap.add_argument('--fleet', type=int, default=0, metavar='N',
                     help='soak a fleet of N replica processes '
                          '(SIGKILL/SIGSTOP chaos) instead of the '
@@ -200,12 +206,17 @@ def _fleet_mode(args) -> int:
                      'max_queue': 4 * n,
                      'max_est_wait_ms': 10000.0},
             env={'XLA_FLAGS': '--xla_force_host_platform_device_count=1'},
+            # stitched cross-process traces when requested: the router
+            # samples, the decision rides the wire, replica spans come
+            # back piggybacked (docs/OBSERVABILITY.md)
+            trace_sample=1.0 if args.trace_out else 0.0,
             # the scripted kill+wedge can overlap into a total outage
             # until the respawn boots; a deep, slow budget parks the
             # recovered requests across it instead of exhausting
             router_kwargs={'retry_policy': RetryPolicy(
                 max_attempts=10, backoff_s=0.05, backoff_mult=2.0,
-                max_backoff_s=1.0)},
+                max_backoff_s=1.0),
+                'trace_keep': 4 * n},
     ) as fleet:
         # warm EVERY replica on the workload bucket directly: bucket
         # affinity would home all of fleet.submit's warmup on one
@@ -221,8 +232,17 @@ def _fleet_mode(args) -> int:
                             rate_hz=args.rate_hz, actions=actions,
                             result_timeout_s=180.0)
         stats = fleet.stats()
+        # federated post-mortem: the router's ring + every replica's
+        # (live-pulled where reachable, last gossiped digest where
+        # not), time-aligned onto the router's clock
+        merged = fleet.merged_flight(pull=True)
         if args.flight_out:
-            fleet.router.flight_recorder.dump(args.flight_out)
+            tmp = f'{args.flight_out}.tmp.{os.getpid()}'
+            with open(tmp, 'w') as f:
+                json.dump(merged, f, indent=1)
+            os.replace(tmp, args.flight_out)
+        trace_events = fleet.dump_trace(args.trace_out) \
+            if args.trace_out else 0
     wall_s = time.monotonic() - t0
 
     kill_t = next(t for t, m, _ in report.actions if m == 'kill')
@@ -247,7 +267,18 @@ def _fleet_mode(args) -> int:
             'readmissions', 'n_routable')},
         'respawns': {r: p['respawns']
                      for r, p in stats['processes'].items()},
+        'slo_breaches': stats.get('slo_breaches', 0),
         'wall_s': round(wall_s, 3),
+        'trace_events': trace_events,
+        # federated incident timeline summary (--flight-out carries
+        # the full time-aligned event stream)
+        'flight': {
+            'router': merged['router'],
+            'replicas': merged['replicas'],
+            'clock_offsets': merged['clock_offsets'],
+            'events_merged': len(merged['events']),
+            'tail': merged['events'][-12:],
+        },
     }
     failures = []
     if report.hung:

@@ -2,14 +2,21 @@
 """Per-stage latency waterfall from an exported Chrome trace.
 
 Reads the Chrome Trace Event JSON written by
-``ExecutionService.dump_trace`` / ``cli serve-bench --trace-out`` /
-``tools/servechaos.py --trace-out`` and summarizes the request
-lifecycle stage by stage: for every duration span name (queued,
-compile, coalesce.ripen, dispatch, execute, demux, ...) the count,
-p50/p99/max milliseconds, and the share of total traced time — the
-five-second answer to "where does my p99 live?" without opening
-Perfetto.  Instant events (retries, steals, migrations, chaos
+``ExecutionService.dump_trace`` / ``FleetRouter.dump_trace`` /
+``cli serve-bench --trace-out`` / ``tools/servechaos.py --trace-out``
+and summarizes the request lifecycle stage by stage: for every
+duration span name (route, wire.send, queued, compile, coalesce.ripen,
+dispatch, execute, demux, wire.await, ...) the count, p50/p99/max
+milliseconds, the share of total traced time, and — for fleet traces —
+the per-hop wire time (p50 of the ``wire_ms`` arg the router stamps on
+``wire.await`` spans: round trip minus the replica-observed window).
+The five-second answer to "where does my p99 live?" without opening
+Perfetto.  Instant events (retries, failovers, steals, chaos
 injections, ...) are tallied by name below the waterfall.
+
+Empty or invalid trace files (no JSON object, no ``traceEvents``) are
+an error: ``summarize`` raises ``ValueError`` and the CLI exits 1 with
+the reason — a silent empty waterfall reads as "zero latency".
 
 Also wired as ``python -m distributed_processor_tpu.cli trace-view``.
 
@@ -23,8 +30,9 @@ import sys
 
 # canonical lifecycle order (obs.trace.STAGE_ORDER); stages absent
 # from a trace are skipped, names outside it sort after, alphabetical
-STAGE_ORDER = ('submit', 'submit_source', 'compile', 'queued',
-               'coalesce.ripen', 'dispatch', 'execute', 'demux')
+STAGE_ORDER = ('submit', 'submit_source', 'route', 'wire.send',
+               'compile', 'queued', 'coalesce.ripen', 'dispatch',
+               'execute', 'demux', 'wire.await')
 
 
 def _pct(sorted_vals, p):
@@ -37,18 +45,41 @@ def _pct(sorted_vals, p):
 
 
 def summarize(path: str) -> dict:
-    """Stage waterfall + instant tallies for one Chrome-trace file."""
+    """Stage waterfall + instant tallies for one Chrome-trace file.
+
+    Raises ``ValueError`` when the file is not a Chrome Trace Event
+    document or contains no events — an empty waterfall must never
+    pass for a measured one."""
     with open(path, 'r', encoding='utf-8') as f:
-        doc = json.load(f)
-    events = doc.get('traceEvents', [])
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f'{path}: not valid JSON: {e}') from e
+    if not isinstance(doc, dict):
+        raise ValueError(
+            f'{path}: expected a Chrome Trace object with '
+            f'"traceEvents", got {type(doc).__name__}')
+    events = doc.get('traceEvents')
+    if not isinstance(events, list):
+        raise ValueError(f'{path}: no "traceEvents" array — not a '
+                         f'Chrome Trace Event file')
+    if not events:
+        raise ValueError(f'{path}: trace contains zero events '
+                         f'(was tracing enabled? --trace-sample > 0)')
     durs = {}       # name -> [dur_ms, ...]
+    wires = {}      # name -> [args.wire_ms, ...] (fleet wire.await)
     instants = {}   # name -> count
     requests = set()
+    processes = set()
     for e in events:
         requests.add(e.get('tid'))
+        processes.add(e.get('pid'))
         name = e.get('name', '?')
         if e.get('ph') == 'X':
             durs.setdefault(name, []).append(e.get('dur', 0) / 1e3)
+            w = (e.get('args') or {}).get('wire_ms')
+            if w is not None:
+                wires.setdefault(name, []).append(float(w))
         elif e.get('ph') == 'i':
             instants[name] = instants.get(name, 0) + 1
     total_ms = sum(sum(v) for v in durs.values())
@@ -57,7 +88,7 @@ def summarize(path: str) -> dict:
     for name in sorted(durs, key=lambda n: (rank.get(n, len(rank)), n)):
         vals = sorted(durs[name])
         stage_ms = sum(vals)
-        stages.append({
+        row = {
             'stage': name,
             'count': len(vals),
             'p50_ms': round(_pct(vals, 50), 3),
@@ -65,11 +96,17 @@ def summarize(path: str) -> dict:
             'max_ms': round(vals[-1], 3),
             'total_ms': round(stage_ms, 3),
             'share': round(stage_ms / total_ms, 4) if total_ms else 0.0,
-        })
+        }
+        if name in wires:
+            # pure wire + queueing cost of the hop, separated from the
+            # replica-side work the span's duration also covers
+            row['wire_p50_ms'] = round(_pct(sorted(wires[name]), 50), 3)
+        stages.append(row)
     return {
         'path': path,
         'events': len(events),
         'requests': len(requests),
+        'processes': len(processes),
         'stages': stages,
         'instants': dict(sorted(instants.items())),
     }
@@ -77,16 +114,24 @@ def summarize(path: str) -> dict:
 
 def format_table(summary: dict) -> str:
     lines = [f"{summary['path']}: {summary['events']} events, "
-             f"{summary['requests']} traced request(s)", '']
+             f"{summary['requests']} traced request(s), "
+             f"{summary.get('processes', 1)} process row(s)", '']
+    has_wire = any('wire_p50_ms' in s for s in summary['stages'])
     hdr = (f"{'stage':>16} {'count':>6} {'p50_ms':>9} {'p99_ms':>9} "
            f"{'max_ms':>9} {'total_ms':>10} {'share':>6}")
+    if has_wire:
+        hdr += f" {'wire_p50':>9}"
     lines.append(hdr)
     lines.append('-' * len(hdr))
     for s in summary['stages']:
-        lines.append(f"{s['stage']:>16} {s['count']:>6} "
-                     f"{s['p50_ms']:>9.3f} {s['p99_ms']:>9.3f} "
-                     f"{s['max_ms']:>9.3f} {s['total_ms']:>10.3f} "
-                     f"{s['share']:>6.1%}")
+        row = (f"{s['stage']:>16} {s['count']:>6} "
+               f"{s['p50_ms']:>9.3f} {s['p99_ms']:>9.3f} "
+               f"{s['max_ms']:>9.3f} {s['total_ms']:>10.3f} "
+               f"{s['share']:>6.1%}")
+        if has_wire:
+            row += (f" {s['wire_p50_ms']:>9.3f}"
+                    if 'wire_p50_ms' in s else f" {'':>9}")
+        lines.append(row)
     if summary['instants']:
         lines.append('')
         lines.append('events: ' + '  '.join(
