@@ -15,11 +15,20 @@ Three things used to be copy-pasted between ``resolve_pallas.py``,
 * the interpret-mode DEFAULT: kernels compile on TPU backends and fall
   back to the interpreter everywhere else (:func:`default_interpret`),
   so tier-1 CPU runs exercise the same kernel code paths.
+
+Plus the bit-packed carry layout (:class:`BitPackPlan`): a static
+first-fit assignment of small-width int32 fields into 32-bit carry
+words, with pack/unpack as pure shift/mask jnp ops so the SAME code
+runs on the XLA side of a kernel boundary and inside a Pallas kernel
+body.  ``exec_pallas.span_call`` uses it to shrink the megastep's
+HBM-crossing state stream (docs/PERF.md "fused epoch").
 """
 
 from __future__ import annotations
 
+import numpy as np
 import jax
+import jax.numpy as jnp
 
 try:
     from jax.experimental import pallas as pl
@@ -44,3 +53,81 @@ def normalize_interpret(interpret):
     if interpret is True and hasattr(pltpu, 'InterpretParams'):
         return pltpu.InterpretParams()
     return interpret
+
+
+class BitPackPlan:
+    """Static first-fit packing of small-width int32 fields into 32-bit
+    carry words.
+
+    The layout is decided entirely from static metadata — an ordered
+    list of ``(key, tail_shape, widths)`` leaves, where ``tail_shape``
+    is the per-shot shape (no batch axis) and ``widths`` gives each
+    flattened element's bit width (scalar = uniform).  Elements are
+    assigned greedily in order, never straddling a word boundary, so
+    every field is a single shift+mask on both sides.
+
+    ``pack``/``unpack`` are pure shift/mask jnp ops over ``[B, ...]``
+    arrays: the same code runs on the XLA side of a kernel boundary and
+    inside a Pallas kernel body (no gathers, no dynamic indexing).
+
+    Contract: packed values must lie in ``[0, 2**width)``.  ``pack``
+    masks (so out-of-range inputs are truncated, matching the ISA's
+    field-mask semantics) and ``unpack`` returns the non-negative
+    residue — callers pick widths so this is the identity on every
+    value the field can hold.
+    """
+
+    def __init__(self, leaves):
+        self.shapes = {}
+        self.slots = {}
+        word, used = 0, 0
+        for key, tail, widths in leaves:
+            n = 1
+            for d in tail:
+                n *= int(d)
+            ws = np.broadcast_to(np.asarray(widths, np.int64), (n,))
+            sl = []
+            for w in ws.tolist():
+                if not 1 <= w <= 32:
+                    raise ValueError(f'bit width {w} for {key!r} out of [1, 32]')
+                if used + w > 32:
+                    word, used = word + 1, 0
+                sl.append((word, used, w))
+                used += w
+            self.shapes[key] = tuple(tail)
+            self.slots[key] = sl
+        self.n_words = word + (1 if used else 0)
+
+    @staticmethod
+    def _mask(w):
+        return jnp.int32(-1) if w == 32 else jnp.int32((1 << w) - 1)
+
+    def pack(self, leaves):
+        """``{key: [B, *tail] int32} -> [B, n_words] int32``."""
+        acc = [None] * self.n_words
+        B = None
+        for key, sl in self.slots.items():
+            a = leaves[key].astype(jnp.int32)
+            B = a.shape[0]
+            flat = a.reshape(B, -1)
+            for j, (wd, sh, w) in enumerate(sl):
+                v = flat[:, j] & self._mask(w)
+                if sh:
+                    v = v << sh
+                acc[wd] = v if acc[wd] is None else acc[wd] | v
+        cols = [a if a is not None else jnp.zeros((B,), jnp.int32) for a in acc]
+        return jnp.stack(cols, axis=-1)
+
+    def unpack(self, words):
+        """``[B, n_words] int32 -> {key: [B, *tail] int32}``."""
+        out = {}
+        for key, sl in self.slots.items():
+            cols = []
+            for wd, sh, w in sl:
+                v = words[:, wd]
+                if sh:
+                    v = v >> sh
+                cols.append(v & self._mask(w))
+            flat = jnp.stack(cols, axis=-1)
+            out[key] = flat.reshape((words.shape[0],) + self.shapes[key])
+        return out

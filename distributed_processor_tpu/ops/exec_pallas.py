@@ -35,16 +35,34 @@ tier-1 CPU tests exercise this code path.
 
 from __future__ import annotations
 
+import collections
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ._pallas_common import HAS_PALLAS, pl, normalize_interpret
+from ._pallas_common import (HAS_PALLAS, pl, normalize_interpret,
+                             BitPackPlan)
 
 # VMEM budget for one resident state tile (input + output double-count
 # is absorbed by the factor-2 headroom in _pick_tile's doubling test);
 # v5e has 128 MB of VMEM per core, so 2 MB leaves the pipeliner room
 _TILE_VMEM_BYTES = 2 << 20
+
+# One carry leaf's static packing directive (see span_call's packspec):
+#   trim     — kept last-axis column indices (tuple) or None; trimmed
+#              columns must enter the kernel holding ``fill`` and never
+#              be written by the body, so dropping them round-trips
+#   fill     — the constant trimmed columns are rebuilt from in-kernel
+#   widths   — per-element bit widths after trim (scalar = uniform, or
+#              a flat array over the trimmed tail), None to trim only
+#   sentinel — optional out-of-band value (e.g. the INT32_MAX "slot
+#              never fired" marker) mapped to the width's all-ones
+#              code; requires a uniform width with every REAL value
+#              strictly below ``2**width - 1``
+PackLeaf = collections.namedtuple('PackLeaf',
+                                  ('trim', 'fill', 'widths', 'sentinel'))
+PackLeaf.__new__.__defaults__ = (None,)
 
 
 def _per_shot_bytes(shapes) -> int:
@@ -53,19 +71,176 @@ def _per_shot_bytes(shapes) -> int:
     return sum(4 * int(np.prod(s[1:], dtype=np.int64)) for s in shapes)
 
 
-def _pick_tile(B: int, per_shot: int) -> int:
-    """Largest power-of-two shot tile within the VMEM budget; the whole
-    batch rides one tile (grid of 1, no padding) when it fits."""
-    if B * per_shot <= _TILE_VMEM_BYTES:
+def _pick_tile(B: int, per_shot: int, reserve: int = 0) -> int:
+    """Largest power-of-two shot tile within the VMEM budget (less
+    ``reserve`` bytes of whole-tile shared inputs); the whole batch
+    rides one tile (grid of 1, no padding) when it fits."""
+    budget = max(_TILE_VMEM_BYTES - reserve, per_shot)
+    if B * per_shot <= budget:
         return B
     tb = 1
-    while 2 * tb * per_shot <= _TILE_VMEM_BYTES:
+    while 2 * tb * per_shot <= budget:
         tb *= 2
     return tb
 
 
+def _take_cols(a, cols):
+    """Static last-axis column select (stack of static slices — no
+    gather, so the same code lowers inside a Pallas kernel body)."""
+    if list(cols) == list(range(a.shape[-1])):
+        return a
+    return jnp.stack([a[..., c] for c in cols], axis=-1)
+
+
+def _untrim_cols(a, cols, n, fill):
+    """Inverse of :func:`_take_cols`: rebuild the full last axis,
+    dropped columns refilled with their invariant constant."""
+    pos = {c: j for j, c in enumerate(cols)}
+    full = [a[..., pos[c]] if c in pos
+            else jnp.full(a.shape[:-1], fill, jnp.int32)
+            for c in range(n)]
+    return jnp.stack(full, axis=-1)
+
+
+class _CarryCodec:
+    """Applies one side's packspec: trim invariant slots, then bit-pack
+    small-width fields into shared 32-bit words (``'_pk'``).
+
+    encode/decode are pure jnp shift/mask/stack ops, so the SAME codec
+    runs on the XLA side of the kernel boundary (shrinking the
+    HBM-crossing stream) and inside the kernel body (rebuilding the
+    full state in VMEM).  Decode(encode(x)) == x for every value the
+    spec's widths admit — the builder (`sim/interpreter.py`
+    ``_carry_packspec``) derives widths from the static program and ISA
+    field masks so that holds for every reachable state.
+    """
+
+    def __init__(self, specs, template, restore_bool):
+        self.specs = {k: sp for k, sp in (specs or {}).items()
+                      if k in template
+                      and (sp.trim is not None or sp.widths is not None)}
+        self.active = bool(self.specs)
+        self.bools = frozenset(
+            k for k in self.specs if template[k].dtype == jnp.bool_
+        ) if restore_bool else frozenset()
+        self.pass_keys = [k for k in template if k not in self.specs]
+        self.meta = {}
+        self.sent = {}
+        plan_leaves = []
+        self.packed = []
+        for k in sorted(self.specs):
+            sp = self.specs[k]
+            shape = tuple(template[k].shape)
+            tail = shape[1:]
+            if sp.trim is not None:
+                tail = tail[:-1] + (len(sp.trim),)
+            self.meta[k] = (tuple(sp.trim) if sp.trim is not None
+                            else None, int(sp.fill or 0),
+                            shape[-1] if len(shape) > 1 else 0, tail)
+            if sp.widths is not None:
+                plan_leaves.append((k, tail, sp.widths))
+                self.packed.append(k)
+                if sp.sentinel is not None:
+                    if not isinstance(sp.widths, int):
+                        raise ValueError(
+                            f'sentinel on {k!r} needs a uniform width')
+                    self.sent[k] = (jnp.int32(sp.sentinel),
+                                    jnp.int32((1 << sp.widths) - 1))
+        self.trim_only = [k for k in sorted(self.specs)
+                          if k not in set(self.packed)]
+        self.plan = BitPackPlan(plan_leaves) if plan_leaves else None
+
+    def encode(self, d):
+        out = {k: d[k] for k in self.pass_keys}
+        vals = {}
+        for k in self.specs:
+            a = d[k]
+            if a.dtype != jnp.int32:
+                a = a.astype(jnp.int32)
+            cols = self.meta[k][0]
+            if cols is not None:
+                a = _take_cols(a, cols)
+            if k in self.sent:
+                val, code = self.sent[k]
+                a = jnp.where(a == val, code, a)
+            vals[k] = a
+        for k in self.trim_only:
+            out[k] = vals[k]
+        if self.plan is not None:
+            out['_pk'] = self.plan.pack({k: vals[k] for k in self.packed})
+        return out
+
+    def decode(self, d):
+        out = {k: d[k] for k in self.pass_keys}
+        vals = self.plan.unpack(d['_pk']) if self.plan is not None else {}
+        for k in self.trim_only:
+            vals[k] = d[k]
+        for k in self.specs:
+            a = vals[k]
+            if k in self.sent:
+                val, code = self.sent[k]
+                a = jnp.where(a == code, val, a)
+            cols, fill, n, _ = self.meta[k]
+            if cols is not None:
+                a = _untrim_cols(a, cols, n, fill)
+            if k in self.bools:
+                a = a != 0
+            out[k] = a
+        return out
+
+    def stream_shot_bytes(self, template) -> int:
+        """Modeled bytes one shot lane contributes to the packed
+        stream (the packed analogue of :func:`_per_shot_bytes`)."""
+        total = sum(4 * int(np.prod(template[k].shape[1:],
+                                    dtype=np.int64))
+                    for k in self.pass_keys)
+        total += sum(4 * int(np.prod(self.meta[k][3], dtype=np.int64))
+                     for k in self.trim_only)
+        if self.plan is not None:
+            total += 4 * self.plan.n_words
+        return total
+
+
+def span_stream_bytes(state, consts, packspec=None):
+    """Per-shot bytes of the (state, consts) kernel streams under
+    ``packspec`` (None = unpacked).  Template dicts need only
+    ``.shape``/``.dtype`` leaves (``jax.ShapeDtypeStruct`` works), so
+    the perf model (`tools/exec_profile.py`, bench utilization rows)
+    prices the packed carry without tracing a kernel."""
+    spec = packspec or {}
+    sc = _CarryCodec(spec.get('state'), state, True)
+    cc = _CarryCodec(spec.get('consts'), consts, False)
+    return sc.stream_shot_bytes(state), cc.stream_shot_bytes(consts)
+
+
 def span_call(state: dict, consts: dict, shared: dict, body, *,
-              interpret):
+              interpret, packspec=None, shot_slack: int = 0):
+    """Run ``body(state, consts, shared) -> state`` as ONE pallas call
+    over shot tiles, optionally with the HBM-crossing state/const
+    streams bit-packed (``packspec``: ``{'state': {key: PackLeaf},
+    'consts': {...}}``).  The pack/unpack shims trace INTO the kernel
+    jaxpr, so the full-width state exists only in VMEM; XLA packs once
+    before the call and unpacks once after.  ``shot_slack`` reserves
+    extra per-shot VMEM for body scratch (the fused-measure window
+    accumulators) when picking the shot tile."""
+    spec = packspec or {}
+    sc = _CarryCodec(spec.get('state'), state, True)
+    cc = _CarryCodec(spec.get('consts'), consts, False)
+    if not (sc.active or cc.active):
+        return _span_call_raw(state, consts, shared, body,
+                              interpret=interpret, shot_slack=shot_slack)
+
+    def wrapped(stt, c, h):
+        return sc.encode(body(sc.decode(stt), cc.decode(c), h))
+
+    out = _span_call_raw(sc.encode(state), cc.encode(consts), shared,
+                         wrapped, interpret=interpret,
+                         shot_slack=shot_slack)
+    return sc.decode(out)
+
+
+def _span_call_raw(state: dict, consts: dict, shared: dict, body, *,
+                   interpret, shot_slack: int = 0):
     """Run ``body(state, consts, shared) -> state`` as ONE pallas call
     over shot tiles of the leading batch axis.
 
@@ -92,9 +267,11 @@ def span_call(state: dict, consts: dict, shared: dict, body, *,
     hkeys = sorted(shared)
     bools = frozenset(k for k in skeys if state[k].dtype == jnp.bool_)
     B = state[skeys[0]].shape[0]
-    tb = _pick_tile(B, _per_shot_bytes(
+    reserve = sum(4 * int(np.prod(np.shape(shared[k]), dtype=np.int64))
+                  for k in hkeys)
+    tb = _pick_tile(B, shot_slack + _per_shot_bytes(
         [state[k].shape for k in skeys]
-        + [consts[k].shape for k in ckeys]))
+        + [consts[k].shape for k in ckeys]), reserve)
     b_pad = -(-B // tb) * tb
     if b_pad != B:
         rep = jnp.arange(b_pad, dtype=jnp.int32) % B

@@ -291,6 +291,36 @@ def build_fused_tables(env_pads, basis, W: int, interps, ck: int,
     return t_dac, bas, w_pad
 
 
+def build_energy_tables(env_pads, addrs, W: int, interps, lane: int = 128):
+    """Per-address DAC-resolution envelope ENERGY rows for the fused
+    measure-in-megastep engine (``sim.interpreter`` engine ``'fused'``,
+    docs/PERF.md "fused epoch") — the same clamped hold-last Toeplitz
+    construction as :func:`build_fused_tables`, collapsed to |env|^2
+    over the statically-enumerated envelope start addresses
+    (``physics._static_meas_env_addrs``), since at sigma=0 the
+    matched-filter accumulation needs only window energy (the
+    carrier's unit magnitude drops out).
+
+    Returns ``[C, R, Wp]`` float32 with
+    ``E2[c, r, s] = |env[c, min(addrs[r] + s//interp_c, Lp-1)]|^2``,
+    ``Wp`` = W rounded up to the ``lane`` tile; the kernel masks
+    ``s < count`` and row-selects by address equality, so the whole
+    demodulation is gather-free inside the span kernel body.
+    """
+    env_i_pad, env_q_pad = env_pads                     # [C, Lp]
+    env2 = env_i_pad ** 2 + env_q_pad ** 2
+    C, Lp = env2.shape
+    w_pad = _round_up(W, lane)
+    s = np.arange(w_pad, dtype=np.int64)
+    rows = []
+    for c in range(C):
+        it = max(int(interps[c]), 1)
+        idx = np.minimum(np.asarray(addrs, np.int64)[:, None]
+                         + s[None, :] // it, Lp - 1)    # [R, Wp]
+        rows.append(env2[c][jnp.asarray(idx)])
+    return jnp.stack(rows, 0).astype(jnp.float32)
+
+
 def resolve_windows_fused(sc: dict, fused_tables, gs_i, gs_q,
                           sigma, inv_ring, key, W: int, Lp: int,
                           *, tb: int = 256, ck: int = 256,

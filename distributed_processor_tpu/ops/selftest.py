@@ -99,6 +99,31 @@ def check_exec_parity(interpret: bool):
             np.testing.assert_array_equal(np.asarray(got[k]),
                                           np.asarray(want[k]), err_msg=k)
 
+    # fused-measurement path: a span with mid-circuit measurements and a
+    # branch on the demodulated bit, the window resolved INSIDE the span
+    # kernel (engine='fused') vs the generic engine's epoch loop — exact
+    # per-stat equality, fault word included ('steps'/'epochs' are the
+    # loop-structure counters the fusion exists to change)
+    from ..models.experiments import active_reset
+    from ..sim.physics import ReadoutPhysics, run_physics_batch
+    from ..simulator import Simulator
+    mpf = Simulator(n_qubits=2).compile(active_reset(['Q0', 'Q1']))
+    init = rng.integers(0, 2, (4, mpf.n_cores)).astype(np.int32)
+    kwf = dict(init_states=init, max_steps=mpf.n_instr * 4 + 64,
+               max_pulses=16, max_meas=4)
+    want = run_physics_batch(mpf, ReadoutPhysics(sigma=0.0), 3, 4,
+                             engine='generic', **kwf)
+    got = run_physics_batch(mpf, ReadoutPhysics(sigma=0.0), 3, 4,
+                            engine='fused', pallas_interpret=interpret,
+                            **kwf)
+    for k in want:
+        if k in ('steps', 'epochs'):
+            continue
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(want[k]), err_msg=k)
+    assert int(np.asarray(got['epochs'])) == 1, \
+        'fused engine did not collapse the epoch while_loop'
+
 
 def pallas_parity_check(interpret: bool) -> None:
     """Run every kernel parity check; raises AssertionError on mismatch."""

@@ -36,20 +36,24 @@ else:                    # pragma: no cover - depends on jax version
 from .. import isa
 from ..sim.interpreter import (InterpreterConfig, _program_constants,
                                _run_batch, _run_batch_engine, _pad_meas,
-                               _soa_static, resolve_engine,
-                               fault_shot_counts)
+                               _soa_static, resolve_engine, carry_packspec,
+                               use_packed_carry, fault_shot_counts)
 
 
-def _mesh_engine(mp, cfg: InterpreterConfig):
-    """``(engine, prog)`` for the shard-local executor.  The sharded
-    paths predate the engine ladder and always ran the generic engine;
-    ``cfg.engine=None`` keeps that default (no auto-upgrade), while an
-    explicit engine resolves through the same ladder as simulate_batch
-    and runs inside every shard's local jit."""
+def _mesh_engine(mp, cfg: InterpreterConfig, trim_regs: bool = True):
+    """``(engine, prog, pack)`` for the shard-local executor.  The
+    sharded paths predate the engine ladder and always ran the generic
+    engine; ``cfg.engine=None`` keeps that default (no auto-upgrade),
+    while an explicit engine resolves through the same ladder as
+    simulate_batch and runs inside every shard's local jit — including
+    the pallas rung's bit-packed carry layout (``pack``, a host-static
+    :func:`~..sim.interpreter.carry_packspec` tuple)."""
     if cfg.engine is None:
-        return 'generic', None
+        return 'generic', None, None
     eng = resolve_engine(mp, cfg)
-    return eng, (_soa_static(mp) if eng != 'generic' else None)
+    pack = carry_packspec(mp, cfg, trim_regs=trim_regs) \
+        if eng == 'pallas' and use_packed_carry(cfg) else None
+    return eng, (_soa_static(mp) if eng != 'generic' else None), pack
 
 
 def _shotwise_init_regs(init_regs, n_shots, n_cores):
@@ -82,11 +86,12 @@ def sharded_simulate(mp, meas_bits, mesh, init_regs=None,
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)
-    eng, prog = _mesh_engine(mp, cfg)
+    eng, prog, pack = _mesh_engine(mp, cfg, trim_regs=init_regs is None)
 
     def local(mb, ir):
         out = _run_batch_engine(soa, spc, interp, sync_part, mb, cfg,
-                                mp.n_cores, ir, engine=eng, prog=prog)
+                                mp.n_cores, ir, engine=eng, prog=prog,
+                                pack=pack)
         # drop scalar diagnostics: every remaining leaf is shot-leading
         out.pop('steps')
         out.pop('incomplete')
@@ -127,12 +132,14 @@ def sweep_stat_sums(mp, meas_bits, mesh, init_regs=None,
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)
     n_shots = meas_bits.shape[0]
 
+    trim_regs = init_regs is None
     init_regs = _shotwise_init_regs(init_regs, n_shots, mp.n_cores)
-    eng, prog = _mesh_engine(mp, cfg)
+    eng, prog, pack = _mesh_engine(mp, cfg, trim_regs=trim_regs)
 
     def local(mb, ir):
         out = _run_batch_engine(soa, spc, interp, sync_part, mb, cfg,
-                                mp.n_cores, ir, engine=eng, prog=prog)
+                                mp.n_cores, ir, engine=eng, prog=prog,
+                                pack=pack)
         pulse_sum = jnp.sum(out['n_pulses'], axis=0)      # [n_cores]
         err_shots = jnp.sum(jnp.any(out['err'] != 0, axis=1))
         qclk_sum = jnp.sum(out['qclk'], axis=0)

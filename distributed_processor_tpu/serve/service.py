@@ -109,14 +109,14 @@ def _normalize_cfg(cfg: InterpreterConfig, n_instr_bucket: int):
         cfg = InterpreterConfig(max_steps=2 * n_instr_bucket + 64,
                                 max_pulses=n_instr_bucket + 2)
     if cfg.straightline or cfg.engine in ('straightline', 'block',
-                                          'pallas'):
+                                          'pallas', 'fused'):
         raise ValueError(
             'the execution service coalesces onto the multi-program '
             'generic engine; of the engine ladder (auto / generic / '
-            'block / straightline / pallas) the straightline, block '
-            'and pallas engines key on program content and cannot '
-            'serve a shared batch (use singleton_engine= for '
-            '1-program fallback dispatch)')
+            'block / straightline / pallas / fused) the straightline, '
+            'block, pallas and fused engines key on program content '
+            'and cannot serve a shared batch (use singleton_engine= '
+            'for 1-program fallback dispatch)')
     if cfg.opcode_histogram:
         raise ValueError(
             'opcode_histogram=True cannot be served: op_hist is summed '
@@ -258,6 +258,9 @@ class ExecutionService:
         'pallas' / 'generic') for batches that end up with a single
         program: those gain nothing from the multi path, so they may
         ride :func:`simulate_batch` and the full engine ladder instead.
+        ('fused' is rejected at construction: the service dispatches
+        injected-bits batches, and the fused measure-in-megastep engine
+        only runs physics-closed.)
         Default None keeps everything on the one shared multi-program
         cache (the right call for compile-bound fleets).
     devices:
@@ -374,6 +377,13 @@ class ExecutionService:
             raise ValueError(
                 f'singleton_engine must be one of {ENGINES} or None; '
                 f'got {singleton_engine!r}')
+        if singleton_engine == 'fused':
+            raise ValueError(
+                "singleton_engine='fused' (measure-in-megastep) cannot "
+                'serve: the service dispatches injected-bits '
+                'simulate_batch batches and the fused engine '
+                'demodulates readout windows in-kernel — it only runs '
+                'physics-closed via sim.physics.run_physics_batch')
         self._default_cfg = cfg
         self.max_batch_programs = max_batch_programs
         self.max_queue = max_queue

@@ -408,6 +408,57 @@ def check_mesh_consistency(seed: int = 0, n: int = 4,
     return bad
 
 
+def check_fused_consistency(seed: int = 0, n: int = 40,
+                            shots: int = 4) -> dict:
+    """Cross-check ``generic`` vs the fused measure-in-megastep engine
+    (``engine='fused'``, in-kernel demodulation) on the
+    timing-INDEPENDENT fault codes.
+
+    :func:`run_fuzz` cannot put the fused engine in its ladder: it
+    injects measurement bits, and the fused engine's whole point is
+    that there is no injection — so this cross-check closes the physics
+    loop instead (sigma=0: deterministic bits, identical on both
+    engines) and compares fault-name sets on the codes that do not
+    depend on engine step accounting.  Mutants the fused engine is
+    ineligible for (loops, overflow re-resolution, decode/validator
+    rejections) are skipped, not failed.  Returns ``{'checked',
+    'skipped', 'failures'}``; a nonempty ``failures`` list is a harness
+    failure.
+    """
+    from .physics import ReadoutPhysics, run_physics_batch
+    checked = skipped = 0
+    failures = []
+    for m in gen_mutants(seed, n):
+        try:
+            mp = machine_program_from_cmds(m.cmds)
+            validate_program(mp, m.cfg)
+        except (ValueError, OverflowError, ProgramValidationError):
+            skipped += 1
+            continue
+        # the model's readout element must match the mutant cfg's (the
+        # fproc base programs pin meas_elem=0)
+        model = ReadoutPhysics(sigma=0.0, meas_elem=m.cfg.meas_elem)
+        names = {}
+        try:
+            for eng in ('generic', 'fused'):
+                out = run_physics_batch(mp, model, seed, shots,
+                                        cfg=replace(m.cfg, engine=eng))
+                names[eng] = _fault_names(out['fault'])
+        except ValueError as e:
+            if 'ineligible' in str(e):
+                skipped += 1
+                continue
+            failures.append((m.name, f'raised: {e}'))
+            continue
+        checked += 1
+        a = names['generic'] & _TIMING_INDEPENDENT
+        b = names['fused'] & _TIMING_INDEPENDENT
+        if a != b:
+            failures.append((m.name, {'generic': sorted(a),
+                                      'fused': sorted(b)}))
+    return {'checked': checked, 'skipped': skipped, 'failures': failures}
+
+
 @dataclass
 class FuzzReport:
     n: int = 0

@@ -292,6 +292,21 @@ class InterpreterConfig:
     # parity on the bench host with interpret=False; tier-1 CPU tests
     # ride the default).
     pallas_interpret: bool = None
+    # bit-packed megastep carry (generalizes packed_ctrl's stacked
+    # carry to a true bitfield layout): the HBM-crossing kernel streams
+    # of the pallas/fused engines are packed into 32-bit words sized by
+    # static program analysis (_carry_packspec — ISA field masks, the
+    # statically-written register set, jump-target-bounded pc, clock
+    # bounds, flow-bounded measurement/reset slots), with pack/unpack
+    # shims traced INTO the kernel so the full-width state exists only
+    # in VMEM.  Tri-state: None (default) = AUTO — pack exactly when
+    # the kernel actually compiles (resolved pallas_interpret False,
+    # i.e. a real TPU backend — the HBM 2*carry*steps model the pack
+    # attacks only exists there; under the interpreter the shims are
+    # pure overhead); True forces packing (tests pin bit-identity under
+    # the interpreter); False disables.  Exact by construction: widths
+    # cover every reachable value, so decode(encode(x)) == x.
+    packed_carry: bool = None
     # per-opcode executed-instruction histogram: adds an
     # ``op_hist[N_KINDS]`` output counting retired instructions per
     # kind (summed over shots and cores).  Engine-invariant — the same
@@ -1596,7 +1611,7 @@ def _sl_ineligible_fields(kind, jump_addr, func_id,
 # sum — past it, the generic engine's shared step body wins back
 BLOCK_AUTO_MAX_UNROLL = 512
 
-ENGINES = ('auto', 'generic', 'block', 'straightline', 'pallas')
+ENGINES = ('auto', 'generic', 'block', 'straightline', 'pallas', 'fused')
 
 # backends where 'auto' considers the pallas megastep engine: mosaic
 # kernels only COMPILE on real TPUs — elsewhere they would run under
@@ -1671,6 +1686,67 @@ def pallas_ineligible(mp, cfg: InterpreterConfig) -> str:
     return block_ineligible(mp, cfg)
 
 
+def fused_ineligible(mp, cfg: InterpreterConfig) -> str:
+    """Why ``(mp, cfg)`` cannot run on the fused measure-in-megastep
+    engine (``engine='fused'``) — ``None`` when it can.
+
+    The fused engine is the span megastep kernel with the measurement
+    chain grafted INTO the kernel body: when the span hits a
+    measurement trigger it demodulates the readout window in VMEM and
+    lands the bit in the carry's measurement slot, so a
+    branch-on-measurement program retires in ONE kernel pass — no
+    epoch ``while_loop`` round-trips out to the resolver (docs/PERF.md
+    "fused epoch").  That only types out for:
+
+    * physics-closed runs — the injected-bits entry points have no
+      readout window to demodulate (``sim.physics.run_physics_batch``
+      is the entry point);
+    * the parity device — the in-kernel discriminator consumes the
+      deterministic quarter-turn co-state (bloch/statevec projections
+      draw host-side uniforms the kernel cannot host);
+    * span-shaped programs (the straight-line field rules) whose
+      measurement count has a static bound within ``max_meas`` — an
+      overflowing program re-resolves slot ``max_meas - 1`` with
+      epoch-boundary ordering the single pass cannot reproduce;
+    * no CW measurement windows (``cw_horizon == 0``) — a CW window
+      has no static length for the in-kernel energy mask.
+
+    Model-level gates (sigma == 0, white noise, no ring-up, 2-class
+    discrimination, statically-enumerable envelope addresses) live in
+    :func:`..sim.physics.run_physics_batch`, which owns the readout
+    model this engine specializes.
+    """
+    from ..ops._pallas_common import HAS_PALLAS
+    if not HAS_PALLAS:
+        return 'jax.experimental.pallas unavailable in this jax build'
+    if not cfg.physics:
+        return ('injected-bits run (no readout window to demodulate) ' \
+                '— the fused engine closes the physics loop; run via ' \
+                'sim.physics.run_physics_batch')
+    if cfg.device != 'parity':
+        return (f'device {cfg.device!r} (the in-kernel discriminator '
+                f'consumes the parity quarter-turn co-state)')
+    if cfg.cw_horizon > 0:
+        return 'CW measurement windows (cw_horizon > 0) have no ' \
+               'static length'
+    if cfg.trace:
+        return 'trace mode records per-step state'
+    reason = _sl_ineligible_fields(np.asarray(mp.soa.kind),
+                                   np.asarray(mp.soa.jump_addr),
+                                   np.asarray(mp.soa.func_id), cfg)
+    if reason:
+        return reason
+    soa_np = _soa_from_static(_soa_static(mp))
+    mb, _ = _static_meas_bounds(soa_np, cfg)
+    if mb is None:
+        return 'measurement count not statically boundable'
+    if mb > cfg.max_meas:
+        return (f'static measurement bound {mb} exceeds max_meas='
+                f'{cfg.max_meas} (overflow re-resolves the last slot '
+                f'with epoch-boundary ordering)')
+    return None
+
+
 @functools.lru_cache(maxsize=128)
 def _block_plan(blk: tuple):
     """Cached block table for a static program: ``(bid_at, bodies)``
@@ -1694,15 +1770,18 @@ def resolve_engine(mp, cfg: InterpreterConfig) -> str:
 
     ``None`` preserves the legacy ``cfg.straightline`` tri-state
     (straightline vs generic only); ``'generic'`` / ``'straightline'``
-    / ``'block'`` / ``'pallas'`` force an engine (the specialized
-    engines raise with the ineligibility reason); ``'auto'`` walks the
-    ladder — pallas first on TPU backends
+    / ``'block'`` / ``'pallas'`` / ``'fused'`` force an engine (the
+    specialized engines raise with the ineligibility reason —
+    ``'fused'`` is the physics-only measure-in-megastep rung, never
+    picked by ``'auto'`` because its remaining gates live in the
+    readout MODEL, which the program/config pair cannot see);
+    ``'auto'`` walks the ladder — pallas first on TPU backends
     (:data:`_PALLAS_AUTO_BACKENDS`) where eligible under the same size
     caps as the XLA rung it subsumes, then straight-line when eligible
     and small enough to unroll, then block when eligible and the
     deduped body total is under :data:`BLOCK_AUTO_MAX_UNROLL` (and at
     least one body exists), else generic.  Returns one of
-    ``'generic' | 'block' | 'straightline' | 'pallas'``.
+    ``'generic' | 'block' | 'straightline' | 'pallas' | 'fused'``.
     """
     eng = cfg.engine
     if eng is None:
@@ -1727,6 +1806,13 @@ def resolve_engine(mp, cfg: InterpreterConfig) -> str:
             raise ValueError(f"engine='pallas' but the program is "
                              f"ineligible: {reason}")
         return 'pallas'
+    if eng == 'fused':
+        reason = fused_ineligible(mp, cfg)
+        if reason:
+            raise ValueError(f"engine='fused' (measure-in-megastep) "
+                             f"but the program/config is ineligible: "
+                             f"{reason}")
+        return 'fused'
     if eng == 'auto':
         sl_ok = straightline_ineligible(mp, cfg) is None
         if jax.default_backend() in _PALLAS_AUTO_BACKENDS \
@@ -1795,14 +1881,21 @@ def _exec_straightline(st0: dict, soa_np, spc, interp, meas_bits,
 
 def _sl_apply_instr(st: dict, stalled, i: int, N: int, f: dict, spc,
                     interp, meas_bits, meas_valid,
-                    cfg: InterpreterConfig, dev=None):
+                    cfg: InterpreterConfig, dev=None, fused=None):
     """Apply instruction index ``i`` (static fields ``f``, one value
     per core) to every lane with ``pc == i`` — the straight-line
     engine's per-instruction step body, shared verbatim with the
     pallas megastep kernel (:func:`_exec_span_pallas`) so the two
     engines are bit-identical by construction.  Returns the updated
     ``(st, stalled)`` pair; ``st`` leaves are ``[B, C, ...]`` (``B``
-    is a shot TILE inside the kernel)."""
+    is a shot TILE inside the kernel).
+
+    ``fused``: the measure-in-megastep directive
+    (:func:`_exec_span_pallas_fused`) — energy tables, responses, and
+    static window metadata.  When set, a measurement trigger also
+    demodulates its readout window HERE and writes the discriminated
+    bit into ``st['meas_bits']`` / ``st['meas_valid']`` (carried as
+    STATE), so a later fproc read of the same slot never stalls."""
     st = dict(st)
     B, C = st['pc'].shape
     pmask_np = _PMASKS
@@ -1983,6 +2076,20 @@ def _sl_apply_instr(st: dict, stalled, i: int, N: int, f: dict, spc,
                                        st['meas_env'])
             st['meas_gtime'] = jnp.where(mwr, trig[..., None],
                                          st['meas_gtime'])
+            if fused is not None:
+                # measure-in-megastep (docs/PERF.md "fused epoch"):
+                # demodulate THIS window now.  At sigma=0 the matched-
+                # filter accumulation is exactly gs*E, so the bit needs
+                # only the window energy — a masked sum over the static
+                # per-address energy tables (no gathers; the same code
+                # lowers inside the kernel body)
+                energy = _fused_window_energy(fused, pp, nsamp, env_len)
+                bit = _fused_discriminate(fused, energy, state_bit)
+                st['meas_bits'] = jnp.where(mwr, bit[..., None],
+                                            st['meas_bits'])
+                st['meas_valid'] = jnp.where(
+                    mwr, jnp.ones_like(st['meas_valid']),
+                    st['meas_valid'])
 
     # ---- phase reset / idle -------------------------------------
     if has(m_rst):
@@ -2320,8 +2427,339 @@ def _pallas_mode(prog: tuple, cfg: InterpreterConfig) -> str:
     return 'span' if span else 'block'
 
 
+# ---------------------------------------------------------------------------
+# Bit-packed megastep carry (cfg.packed_carry, docs/PERF.md "fused
+# epoch").  The pallas engines round-trip the whole machine state
+# through HBM once per kernel call; most of that state is booleans,
+# small enums, and clock values a STATIC program analysis can bound.
+# carry_packspec() derives, host-side, a per-leaf packing directive
+# (ops/exec_pallas.PackLeaf) from the decoded program + ISA field
+# masks, and ships it through the jit wrappers as a hashable static
+# value; ops/exec_pallas.span_call applies it at the kernel boundary.
+# Soundness: every width below bounds EVERY value the field can hold
+# over one span/body execution from the engine's entry state, so
+# decode(encode(x)) == x for every reachable carry.
+
+_ERR_ALL = (ERR_MISSED_TRIG | ERR_PULSE_OVERFLOW | ERR_MEAS_OVERFLOW
+            | ERR_FPROC_DEADLOCK | ERR_SYNC_DONE | ERR_FPROC_ID
+            | ERR_STICKY_RACE | ERR_CW_MEAS | ERR_COFIRE_ORDER)
+_FAULT_ALL = functools.reduce(lambda a, b: a | b,
+                              (bit for _, bit in FAULT_CODES))
+_JUMP_KINDS = (isa.K_JUMP_I, isa.K_JUMP_COND, isa.K_JUMP_FPROC)
+# pulse-latch regsel bits whose register sourcing makes pulse DURATION
+# dynamic: env (bit 0, length nibble) and cfg (bit 4, element select)
+_RSEL_TIMING = 0b10001
+
+
+def _bl(x: int) -> int:
+    return max(int(x).bit_length(), 1)
+
+
+def _static_pc_width(soa_np):
+    """Bits covering every value ``pc`` can hold: the fall-through
+    range ``[0, N]`` plus every static jump target (a taken OOB jump
+    parks the lane AT the raw target).  None when a negative target
+    exists (sign bit needed — not worth a lane)."""
+    kind = soa_np[..., _F['kind']]
+    ja = soa_np[..., _F['jump_addr']]
+    jm = np.isin(kind, _JUMP_KINDS)
+    hi = int(soa_np.shape[1])
+    if np.any(jm):
+        t = ja[jm]
+        if int(t.min()) < 0:
+            return None
+        hi = max(hi, int(t.max()))
+    return _bl(hi)
+
+
+def _static_meas_bounds(soa_np, cfg: InterpreterConfig):
+    """``(meas_bound, reset_bound)``: per-core worst-case counts of
+    measurement pulses and phase resets one SPAN execution can retire.
+
+    ``reset_bound`` is the static reset-instruction count (each span
+    index retires at most once).  ``meas_bound`` needs dataflow: a
+    trigger is a measurement iff the LATCHED cfg field selects
+    ``cfg.meas_elem``, so we run a forward possible-values analysis of
+    the cfg nibble (init 0; a reg-sourced cfg write is TOP) over the
+    forward-only span CFG.  Returns ``meas_bound=None`` when a
+    backward edge makes the single ascending pass invalid."""
+    kind = soa_np[..., _F['kind']]
+    C, N = kind.shape
+    n_rst = int(max((int(np.sum(kind[c] == isa.K_PULSE_RESET))
+                     for c in range(C)), default=0))
+    bound = 0
+    for c in range(C):
+        k = kind[c]
+        wen = soa_np[c, :, _F['p_wen']]
+        rsel = soa_np[c, :, _F['p_regsel']]
+        pcfg = soa_np[c, :, _F['p_cfg']]
+        ja = soa_np[c, :, _F['jump_addr']]
+        is_p = np.isin(k, (isa.K_PULSE_WRITE, isa.K_PULSE_TRIG))
+        jump_preds = [[] for _ in range(N)]
+        for i in np.nonzero(np.isin(k, _JUMP_KINDS))[0]:
+            t = int(ja[i])
+            if 0 <= t < N:
+                jump_preds[t].append(int(i))
+        outs = [frozenset()] * N   # None = TOP (any nibble)
+        cap = 0
+        for i in range(N):
+            s, top = (frozenset((0,)), False) if i == 0 \
+                else (frozenset(), False)
+            srcs = []
+            if i > 0 and int(k[i - 1]) not in (isa.K_JUMP_I, isa.K_DONE):
+                srcs.append(outs[i - 1])
+            for jp in jump_preds[i]:
+                if jp >= i:
+                    return None, n_rst        # backward edge
+                srcs.append(outs[jp])
+            for o in srcs:
+                if o is None:
+                    top = True
+                else:
+                    s = s | o
+            own = None if top else s
+            if is_p[i] and (int(wen[i]) >> 4) & 1:
+                own = None if (int(rsel[i]) >> 4) & 1 \
+                    else frozenset((int(pcfg[i]) & 0xf,))
+            outs[i] = own
+            if int(k[i]) == isa.K_PULSE_TRIG and (
+                    own is None
+                    or any((v & 3) == cfg.meas_elem for v in own)):
+                cap += 1
+        bound = max(bound, cap)
+    return bound, n_rst
+
+
+def _static_clock_bound(soa_np, cfg: InterpreterConfig, spc_np, interp_np):
+    """Upper bound on every clock value (``time`` / ``meas_avail`` /
+    ``rst_time`` / ``meas_gtime``) one SPAN execution can produce, or
+    None when the program makes clocks data-dependent (INC_QCLK
+    rewrites the offset; a reg-sourced envelope/cfg latch makes pulse
+    duration dynamic).  Walks each core's instruction list once —
+    sound because a span index retires at most once — accumulating the
+    per-kind time advances of ``_sl_apply_instr`` with every pulse
+    charged the worst static duration."""
+    kind = soa_np[..., _F['kind']]
+    C, N = kind.shape
+    if np.any(kind == isa.K_INC_QCLK):
+        return None
+    bound = 0
+    for c in range(C):
+        k = kind[c]
+        wen = soa_np[c, :, _F['p_wen']].astype(np.int64)
+        rsel = soa_np[c, :, _F['p_regsel']].astype(np.int64)
+        penv = soa_np[c, :, _F['p_env']].astype(np.int64)
+        cmd = soa_np[c, :, _F['cmd_time']].astype(np.int64)
+        is_p = np.isin(k, (isa.K_PULSE_WRITE, isa.K_PULSE_TRIG))
+        if np.any((wen[is_p] & rsel[is_p] & _RSEL_TIMING) != 0):
+            return None
+        # worst static duration: longest latched envelope at the
+        # slowest element clock (CW 0xfff counts as 0 — physics-mode
+        # CW measurement windows are gated out of the packed engines)
+        lens = (penv[is_p & ((wen & 1) == 1)] >> 12) & 0xfff
+        lens = lens[lens != 0xfff]
+        interp_max = int(np.max(interp_np[c])) if interp_np[c].size else 1
+        spc_min = max(int(np.min(spc_np[c])), 1) if spc_np[c].size else 1
+        dur_max = 0
+        for L in np.unique(lens).tolist():
+            ns = int(L) * 4 * interp_max
+            dur_max = max(dur_max, -(-ns // spc_min))
+        t = int(INIT_TIME)
+        for i in range(N):
+            ki = int(k[i])
+            if ki in (isa.K_PULSE_TRIG, isa.K_IDLE):
+                t = max(t, max(int(cmd[i]), 0)) + cfg.pulse_load_clks
+            elif ki in (isa.K_PULSE_WRITE, isa.K_PULSE_RESET):
+                t += cfg.pulse_regwrite_clks
+            elif ki == isa.K_REG_ALU:
+                t += cfg.alu_instr_clks
+            elif ki in (isa.K_JUMP_I, isa.K_JUMP_COND):
+                t += cfg.jump_cond_clks
+            elif ki in (isa.K_JUMP_FPROC, isa.K_ALU_FPROC):
+                t += cfg.jump_fproc_clks
+        bound = max(bound, t + dur_max + cfg.meas_latency)
+    return bound if 0 <= bound < 2**31 else None
+
+
+def _spc_interp_np(mp):
+    """Host numpy form of the element-clock tables (the values
+    :func:`_program_constants` devices — needed statically here)."""
+    max_elems = max((len(t.elem_cfgs) for t in mp.tables), default=0) or 1
+    spc = np.ones((mp.n_cores, max_elems), np.int64)
+    interp = np.zeros((mp.n_cores, max_elems), np.int64)
+    for c, t in enumerate(mp.tables):
+        for e, ec in enumerate(t.elem_cfgs):
+            spc[c, e] = ec.samples_per_clk
+            interp[c, e] = ec.interp_ratio
+    return spc, interp
+
+
+def use_packed_carry(cfg: InterpreterConfig) -> bool:
+    """Resolve the ``cfg.packed_carry`` tri-state: AUTO packs exactly
+    when the megastep kernel COMPILES (resolved ``pallas_interpret``
+    False — a real TPU backend), where the HBM-crossing stream is the
+    measured cost; the interpreter path stays unpacked so tier-1 CPU
+    parity covers both layouts via the explicit True pin."""
+    if cfg.packed_carry is not None:
+        return bool(cfg.packed_carry)
+    itp = cfg.pallas_interpret
+    if itp is None:
+        itp = _default_pallas_interpret()
+    return itp is False
+
+
+def carry_packspec(mp, cfg: InterpreterConfig, trim_regs: bool = True,
+                   fused: bool = False):
+    """Derive the bit-packed carry layout for ``(mp, cfg)`` under the
+    pallas engine, as a HASHABLE nested tuple (it rides the jit
+    wrappers as a static argument; :func:`_packspec_decode` rebuilds
+    the ``{'state'|'consts': {key: PackLeaf}}`` dict at the kernel
+    call).  ``trim_regs`` must be False when the caller injects a
+    nonzero initial register file (the trim drops statically-unwritten
+    registers, refilled with the zero init).  ``fused=True`` adds the
+    measure-in-megastep co-state (physics measurement slots, device
+    counter, in-kernel bits).  Returns None when nothing packs.
+    """
+    prog = _soa_static(mp)
+    soa_np = _soa_from_static(prog)
+    spc_np, interp_np = _spc_interp_np(mp)
+    span = _pallas_mode(prog, cfg) == 'span'
+    if fused and not span:
+        raise ValueError('fused packspec needs a span-shaped program')
+    kind = soa_np[..., _F['kind']]
+    C, N = kind.shape
+    PL = lambda trim=None, fill=0, widths=None, sentinel=None: \
+        (trim, fill, widths, sentinel)
+    st, co = {}, {}
+
+    # flag/enum fields: width = the ISA's own value mask, any mode
+    st['done'] = PL(widths=1)
+    st['err'] = PL(widths=_bl(_ERR_ALL))
+    st['fault'] = PL(widths=_bl(_FAULT_ALL))
+    st['pp'] = PL(widths=tuple(
+        int(m).bit_length() for m in _PMASKS.tolist()) * C)
+    w_pc = _static_pc_width(soa_np)
+    if w_pc is not None:
+        st['pc'] = PL(widths=w_pc)
+    if trim_regs:
+        wm = np.isin(kind, (isa.K_REG_ALU, isa.K_ALU_FPROC))
+        written = sorted(set(
+            int(r) for r in soa_np[..., _F['out_reg']][wm].tolist())
+            & set(range(isa.N_REGS)))
+        if len(written) < isa.N_REGS:
+            st['regs'] = PL(trim=tuple(written) or (0,))
+
+    if span:
+        # span-only: every instruction index retires at most once from
+        # the zeroed entry state, so counters, slot occupancy, and (in
+        # the absence of INC_QCLK / reg-sourced durations) every clock
+        # value have static program bounds
+        tb = _static_clock_bound(soa_np, cfg, spc_np, interp_np)
+        w_t = None
+        if tb is not None:
+            w_t = _bl(tb)
+            if tb >= (1 << w_t) - 1:
+                w_t += 1    # keep the all-ones code free as a sentinel
+            st['time'] = PL(widths=w_t)
+        if not np.any(kind == isa.K_INC_QCLK):
+            st['offset'] = PL(widths=1)
+        n_pt = int(max((int(np.sum(kind[c] == isa.K_PULSE_TRIG))
+                        for c in range(C)), default=0))
+        m_bound, n_rst = _static_meas_bounds(soa_np, cfg)
+        mb = n_pt if m_bound is None else m_bound
+        st['n_pulses'] = PL(widths=_bl(n_pt))
+        st['n_resets'] = PL(widths=_bl(n_rst))
+        st['n_meas'] = PL(widths=_bl(mb))
+        M, R = cfg.max_meas, cfg.max_resets
+        mk = max(min(mb, M), 1)
+        rk = max(min(n_rst, R), 1)
+        mtrim = tuple(range(mk)) if mk < M else None
+        st['meas_avail'] = PL(
+            trim=mtrim, fill=int(INT32_MAX), widths=w_t,
+            sentinel=int(INT32_MAX) if w_t is not None else None)
+        st['rst_time'] = PL(trim=tuple(range(rk)) if rk < R else None,
+                            widths=w_t)
+        if cfg.opcode_histogram:
+            cnt = np.stack([np.sum(kind == kk, axis=1)
+                            for kk in range(isa.N_KINDS)], axis=-1)
+            st['op_hist'] = PL(widths=tuple(
+                _bl(x) for x in cnt.reshape(-1).tolist()))
+        if cfg.record_pulses and n_pt < cfg.max_pulses:
+            P, keep = cfg.max_pulses, max(n_pt, 1)
+            st['rec'] = PL(trim=tuple(
+                fi * P + p for fi in range(len(_REC_FIELDS))
+                for p in range(keep)))
+        if fused:
+            # measure-in-megastep: the demodulated bit and its physics
+            # window parameters ride the carry as STATE (docs/PERF.md
+            # "fused epoch"); widths are the pulse-param masks, slots
+            # trim to the same static measurement bound
+            st['meas_bits'] = PL(trim=mtrim, widths=1)
+            st['meas_valid'] = PL(trim=mtrim, widths=1)
+            st['phys_wait'] = PL(widths=1)
+            st['meas_state'] = PL(trim=mtrim, widths=1)
+            for key, w in (('meas_env', 24), ('meas_phase', 17),
+                           ('meas_freq', 9), ('meas_amp', 16)):
+                st[key] = PL(trim=mtrim, widths=w)
+            st['meas_gtime'] = PL(trim=mtrim, widths=w_t)
+            if cfg.x90_amp > 0:
+                dq = (2 * int(_PMASKS[3]) + cfg.x90_amp) \
+                    // (2 * cfg.x90_amp)
+                st['qturns'] = PL(widths=_bl(2 + n_pt * dq))
+        elif mtrim is not None:
+            # injected-bits consts: values are caller-arbitrary int32
+            # (never width-packed) but slots past the static bound are
+            # never selected by the fproc read
+            co['meas_bits'] = PL(trim=mtrim)
+    else:
+        # block mode loops, so only execution-count-independent fields
+        # pack; the lane-activity const is a boolean mask
+        co['act'] = PL(widths=1)
+
+    clean = lambda d: {k: v for k, v in d.items()
+                       if v[0] is not None or v[2] is not None}
+    st, co = clean(st), clean(co)
+    if not st and not co:
+        return None
+    enc = lambda d: tuple(sorted((k,) + v for k, v in d.items()))
+    return (enc(st), enc(co))
+
+
+def _packspec_decode(pack):
+    """Static-tuple -> ``{'state'|'consts': {key: PackLeaf}}`` (the
+    form ``ops.exec_pallas.span_call`` consumes)."""
+    if pack is None:
+        return None
+    from ..ops.exec_pallas import PackLeaf
+    mk = lambda e: {k: PackLeaf(t, f, w, s) for (k, t, f, w, s) in e}
+    return {'state': mk(pack[0]), 'consts': mk(pack[1])}
+
+
+def carry_stream_bytes(mp, cfg: InterpreterConfig, fused: bool = False):
+    """``(unpacked, packed)`` modeled per-shot bytes of the megastep
+    kernel's HBM-crossing streams for ``(mp, cfg)`` — the quantity the
+    ``2 x carry x steps`` exec-phase HBM model prices
+    (tools/exec_profile.py, bench utilization rows)."""
+    from ..ops import exec_pallas
+    C, M = mp.n_cores, cfg.max_meas
+    st = dict(jax.eval_shape(lambda: _init_state(1, C, cfg)))
+    i32 = jax.ShapeDtypeStruct((1, C, M), jnp.int32)
+    if fused:
+        st['meas_bits'] = i32
+        st['meas_valid'] = jax.ShapeDtypeStruct((1, C, M), jnp.bool_)
+        consts = {}
+    else:
+        consts = {'meas_bits': i32}
+    pack = carry_packspec(mp, cfg, fused=fused)
+    su, cu = exec_pallas.span_stream_bytes(st, consts)
+    sp, cp = exec_pallas.span_stream_bytes(st, consts,
+                                           _packspec_decode(pack))
+    return su + cu, sp + cp
+
+
 def _exec_span_pallas(st0: dict, soa_np, spc, interp, meas_bits,
-                      cfg: InterpreterConfig, interpret) -> dict:
+                      cfg: InterpreterConfig, interpret,
+                      pack=None) -> dict:
     """The megastep span executor: the ENTIRE forward-jump-only program
     as one Pallas call (docs/PERF.md "megastep").
 
@@ -2353,13 +2791,142 @@ def _exec_span_pallas(st0: dict, soa_np, spc, interp, meas_bits,
 
     out = exec_pallas.span_call(st, {'meas_bits': meas_bits},
                                 {'spc': spc, 'interp': interp}, body,
-                                interpret=interpret)
+                                interpret=interpret,
+                                packspec=_packspec_decode(pack))
     out['_steps'] = steps + N
     return out
 
 
+def _fused_window_energy(fused, pp, nsamp, env_len):
+    """Window energy ``amp^2 * sum_s e^2(s) * [s < count]`` of the
+    measurement pulse latched in ``pp`` — the scale of the sigma=0
+    matched-filter accumulation (the carrier's unit magnitude drops
+    out, physics ``_resolve_analytic``).
+
+    Computed against the static per-address DAC-resolution envelope
+    energy rows (``fused['e2']``, ops/resolve_pallas
+    ``build_energy_tables``): an address-equality row select over the
+    statically-enumerated envelope addresses plus an iota-vs-count
+    mask, chunked so the ``[B, C, chunk]`` intermediate bounds VMEM —
+    no gathers, so the same code lowers inside the megastep kernel."""
+    e2 = fused['e2']                                     # [C, R, Wp] f32
+    Wp = e2.shape[2]
+    # CW windows (length nibble 0xfff) demodulate over cw_samp=0 under
+    # this engine's eligibility (cw_horizon == 0) — energy 0, like the
+    # epoch resolver's _window_scalars
+    count = jnp.where(env_len == 0xfff, 0,
+                      jnp.minimum(nsamp, fused['w']))    # [B, C]
+    addr = (pp[..., 0] & 0xfff) * 4
+    chunk = min(int(fused.get('chunk') or Wp), Wp)
+    tot = jnp.zeros(addr.shape, jnp.float32)
+    for r, a in enumerate(fused['addrs']):
+        acc = jnp.zeros(addr.shape, jnp.float32)
+        for s0 in range(0, Wp, chunk):
+            blk = e2[:, r, s0:s0 + chunk]                # [C, L]
+            m = (s0 + jnp.arange(blk.shape[1]))[None, None, :] \
+                < count[..., None]
+            acc = acc + jnp.sum(jnp.where(m, blk[None], 0.0), axis=-1)
+        tot = tot + jnp.where(addr == a, acc, 0.0)
+    amp = pp[..., 3].astype(jnp.float32) / fused['amp_scale']
+    return amp * amp * tot
+
+
+def _fused_discriminate(fused, energy, state_bit):
+    """2-class threshold of the sigma=0 accumulation ``gs * E``: the
+    same projection onto the |0>-|1> axis as physics
+    ``_discriminate_acc``.  At sigma=0 the accumulation is EXACTLY the
+    state's clean response scaled by the (nonnegative) energy, so the
+    projection's sign depends only on which response scaled it — the
+    in-kernel bit and the epoch resolver's bit agree for every float
+    realization of E, which is what makes the fused engine
+    bit-identical to the generic engine by construction."""
+    g0b, g1b = fused['g0'][None], fused['g1'][None]      # [1, C, 2]
+    gs = jnp.where(state_bit[..., None] == 1, g1b, g0b)  # [B, C, 2]
+    acc_i = gs[..., 0] * energy
+    acc_q = gs[..., 1] * energy
+    a0_i, a0_q = g0b[..., 0] * energy, g0b[..., 1] * energy
+    a1_i, a1_q = g1b[..., 0] * energy, g1b[..., 1] * energy
+    proj = (acc_i - (a0_i + a1_i) / 2) * (a1_i - a0_i) \
+        + (acc_q - (a0_q + a1_q) / 2) * (a1_q - a0_q)
+    return (proj > 0).astype(jnp.int32)
+
+
+# VMEM chunk (DAC samples) of the fused engine's in-kernel energy mask
+# — bounds the [tile, C, chunk] f32 intermediate the masked sum builds
+_FUSED_ENERGY_CHUNK = 512
+
+
+def _exec_span_pallas_fused(st0: dict, soa_np, spc, interp, meas_bits,
+                            meas_valid, cfg: InterpreterConfig,
+                            interpret, fargs, pack=None):
+    """The measure-in-megastep span executor (``engine='fused'``): the
+    whole forward-jump-only PHYSICS program as one Pallas call, with
+    each measurement window demodulated inside the kernel the moment
+    its trigger retires (docs/PERF.md "fused epoch").
+
+    Semantically one epoch of :func:`_exec_straightline` plus the
+    resolver, collapsed: ``meas_bits`` / ``meas_valid`` ride the carry
+    as STATE, the :func:`_sl_apply_instr` bodies run with the
+    ``fused`` directive so the bit lands in the slot at the trigger,
+    and a later fproc read of that slot is served in-kernel — a
+    branch-on-measurement program retires in ONE pass where the epoch
+    loop needed an exec -> resolve -> inject round-trip per
+    measurement layer.  ``fargs``: energy tables + responses from
+    ``sim.physics`` (``e2`` [C, R, Wp] f32, ``g0``/``g1`` [C, 2] f32,
+    static ``addrs``/``w``/``amp_scale``).  Returns
+    ``(st, meas_bits, meas_valid)``.
+    """
+    from ..ops import exec_pallas
+    counter_inc('pallas_trace')   # runs at trace time of the outer jit:
+    # the fused path shares the pallas retrace contract (<= 1 per
+    # program content)
+    N = soa_np.shape[1]
+    rows = [{name: np.asarray(soa_np[:, i, _F[name]])
+             for name in _FIELDS}
+            for i in range(N)]
+    st = dict(st0)
+    steps = st.pop('_steps')
+    paused = st.pop('paused', None)   # [B] epoch flag, caller-managed
+    st['meas_bits'] = meas_bits
+    st['meas_valid'] = meas_valid
+    addrs, W = fargs['addrs'], fargs['w']
+    amp_scale = fargs['amp_scale']
+    chunk = min(_FUSED_ENERGY_CHUNK, int(fargs['e2'].shape[2]))
+    C = st['pc'].shape[1]
+
+    def body(stt, cc, hh):
+        stalled = jnp.zeros(stt['pc'].shape, bool)
+        fus = {'e2': hh['e2'], 'g0': hh['g0'], 'g1': hh['g1'],
+               'addrs': addrs, 'w': W, 'amp_scale': amp_scale,
+               'chunk': chunk}
+        for i, f in enumerate(rows):
+            stt, stalled = _sl_apply_instr(
+                stt, stalled, i, N, f, hh['spc'], hh['interp'],
+                stt['meas_bits'], stt['meas_valid'], cfg, dev=None,
+                fused=fus)
+        # in-kernel bits are valid the instant they fire, so no lane
+        # ever stalls on its own slot — phys_wait stays all-False and
+        # the epoch loop exits after this single pass
+        stt['phys_wait'] = stalled
+        return stt
+
+    out = exec_pallas.span_call(
+        st, {},
+        {'spc': spc, 'interp': interp, 'e2': fargs['e2'],
+         'g0': fargs['g0'], 'g1': fargs['g1']},
+        body, interpret=interpret, packspec=_packspec_decode(pack),
+        shot_slack=8 * C * chunk)
+    out['_steps'] = steps + N
+    if paused is not None:
+        out['paused'] = paused
+    bits = out.pop('meas_bits')
+    valid = out.pop('meas_valid')
+    return out, bits, valid
+
+
 def _exec_block_body_pallas(st: dict, act, rows_np, spc, interp,
-                            cfg: InterpreterConfig, interpret) -> dict:
+                            cfg: InterpreterConfig, interpret,
+                            packspec=None) -> dict:
     """Pallas form of :func:`_exec_block_body`: one superinstruction's
     ``[C, L, F]`` run as ONE kernel call over shot tiles, applying the
     same :func:`_blk_apply_row` bodies in VMEM.  ``act`` rides along
@@ -2377,12 +2944,12 @@ def _exec_block_body_pallas(st: dict, act, rows_np, spc, interp,
 
     return exec_pallas.span_call(st, {'act': act},
                                  {'spc': spc, 'interp': interp}, body,
-                                 interpret=interpret)
+                                 interpret=interpret, packspec=packspec)
 
 
 def _exec_blocks(st0: dict, blk: tuple, spc, interp, sync_part, meas_bits,
                  meas_valid, cfg: InterpreterConfig, dev=None,
-                 pallas_interpret=None) -> dict:
+                 pallas_interpret=None, pallas_pack=None) -> dict:
     """The block-compiled engine: an outer while_loop over CFG blocks.
 
     Per iteration, each core either (a) takes ONE generic :func:`_step`
@@ -2468,7 +3035,7 @@ def _exec_blocks(st0: dict, blk: tuple, spc, interp, sync_part, meas_bits,
                 # shared, so the paths are bit-identical)
                 st2 = _exec_block_body_pallas(
                     st2, bact, soa_np[:, s:s + L, :], spc, interp, cfg,
-                    pallas_interpret)
+                    pallas_interpret, _packspec_decode(pallas_pack))
         # (3) quiescence / pause / deadlock / exactness per _exec_loop
         same = jnp.all((st2['pc'] == st_in['pc'])
                        & (st2['time'] == st_in['time'])
@@ -2549,12 +3116,14 @@ def _run_batch(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
 def _run_batch_engine(soa, spc, interp, sync_part, meas_bits,
                       cfg: InterpreterConfig, n_cores: int, init_regs=None,
                       traits=None, engine: str = 'generic',
-                      prog: tuple = None) -> dict:
+                      prog: tuple = None, pack=None) -> dict:
     """Engine-dispatched :func:`_run_batch` for callers that build their
     own jit boundary (the shard_map locals in ``parallel.sweep``):
     ``engine`` is a RESOLVED engine name (:func:`resolve_engine`) and
     ``prog`` the :func:`_soa_static` tuple the specialized engines
-    trace against (must be a host constant at trace time)."""
+    trace against (must be a host constant at trace time).  ``pack``
+    is the optional :func:`carry_packspec` tuple for the pallas rung
+    (host-static too — it is derived from the program)."""
     if engine == 'generic':
         return _run_batch(soa, spc, interp, sync_part, meas_bits, cfg,
                           n_cores, init_regs, traits)
@@ -2580,11 +3149,12 @@ def _run_batch_engine(soa, spc, interp, sync_part, meas_bits,
             itp = _default_pallas_interpret()
         if _pallas_mode(prog, cfg) == 'span':
             st = _exec_span_pallas(st0, _soa_from_static(prog), spc,
-                                   interp, meas_bits, cfg, itp)
+                                   interp, meas_bits, cfg, itp,
+                                   pack=pack)
         else:
             st = _exec_blocks(st0, prog, spc, interp, sync_part,
                               meas_bits, meas_valid, cfg,
-                              pallas_interpret=itp)
+                              pallas_interpret=itp, pallas_pack=pack)
     else:
         raise ValueError(f'unresolved engine {engine!r}')
     st.pop('phys_wait', None)
@@ -2638,16 +3208,19 @@ def _run_batch_blk_jit(spc, interp, sync_part, meas_bits, cfg, n_cores,
                              n_cores, init_regs, engine='block', prog=blk)
 
 
-@functools.partial(jax.jit, static_argnames=('cfg', 'n_cores', 'pal'))
+@functools.partial(jax.jit,
+                   static_argnames=('cfg', 'n_cores', 'pal', 'pack'))
 def _run_batch_pal_jit(spc, interp, sync_part, meas_bits, cfg, n_cores,
-                       init_regs, pal=None):
+                       init_regs, pal=None, pack=None):
     """Injected-bits batch on the Pallas megastep engine.  ``pal`` is
     the content-keyed static program (:func:`_soa_static`) — identical
     programs share one cache entry, and the span/block mode pick plus
-    the in-kernel instruction specialization happen at trace time."""
+    the in-kernel instruction specialization happen at trace time.
+    ``pack`` is the optional :func:`carry_packspec` static tuple."""
     counter_inc('pallas_trace')
     return _run_batch_engine(None, spc, interp, sync_part, meas_bits, cfg,
-                             n_cores, init_regs, engine='pallas', prog=pal)
+                             n_cores, init_regs, engine='pallas', prog=pal,
+                             pack=pack)
 
 
 def pallas_trace_count() -> int:
@@ -2773,7 +3346,7 @@ def aot_compile_batch(spec, jax_device=None) -> float:
                          '(n_programs/n_shots set — BucketSpec.bind)')
     cfg = spec.cfg
     if cfg.straightline or cfg.engine in ('straightline', 'block',
-                                          'pallas'):
+                                          'pallas', 'fused'):
         raise ValueError('AOT precompilation covers the generic '
                          'multi-program engine only (content-keyed '
                          'engines have no shape-only executable)')
@@ -2833,7 +3406,7 @@ def aot_batch_cached(spec, jax_device=None) -> bool:
         return False
     cfg = spec.cfg
     if cfg.straightline or cfg.engine in ('straightline', 'block',
-                                          'pallas'):
+                                          'pallas', 'fused'):
         return False
     if cfg.straightline is None or cfg.engine is not None:
         cfg = replace(cfg, straightline=False, engine=None)
@@ -2973,7 +3546,7 @@ def simulate_multi_batch(mps, meas_bits, init_regs=None,
     else:
         cfg = replace(cfg, **kw)
     if cfg.straightline or cfg.engine in ('straightline', 'block',
-                                          'pallas'):
+                                          'pallas', 'fused'):
         raise ValueError(
             'simulate_multi_batch runs the generic engine only: the '
             'straight-line, block, and pallas executors key their '
@@ -3112,10 +3685,16 @@ def simulate(mp, meas_bits=None, init_regs=None,
     if meas_bits is None:
         meas_bits = jnp.zeros((mp.n_cores, cfg.max_meas), jnp.int32)
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)
+    trim_regs = init_regs is None
     if init_regs is None:
         init_regs = jnp.zeros((mp.n_cores, isa.N_REGS), jnp.int32)
     init_regs = jnp.asarray(init_regs, jnp.int32)
     eng = resolve_engine(mp, cfg)
+    if eng == 'fused':
+        raise ValueError(
+            "engine='fused' demodulates measurement windows in-kernel; "
+            'the injected-bits entry points have no window — run via '
+            'sim.physics.run_physics_batch')
     if eng == 'straightline':
         out = _run_batch_sl_jit(spc, interp, meas_bits[None], cfg,
                                 mp.n_cores, init_regs[None],
@@ -3125,9 +3704,11 @@ def simulate(mp, meas_bits=None, init_regs=None,
                                  cfg, mp.n_cores, init_regs[None],
                                  blk=_soa_static(mp))
     elif eng == 'pallas':
+        pack = carry_packspec(mp, cfg, trim_regs=trim_regs) \
+            if use_packed_carry(cfg) else None
         out = _run_batch_pal_jit(spc, interp, sync_part, meas_bits[None],
                                  cfg, mp.n_cores, init_regs[None],
-                                 pal=_soa_static(mp))
+                                 pal=_soa_static(mp), pack=pack)
     else:
         return _check_strict(
             _run_jit(soa, spc, interp, sync_part, meas_bits, cfg,
@@ -3154,6 +3735,7 @@ def simulate_batch(mp, meas_bits, init_regs=None,
     cfg, strict = _fault_policy(cfg)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)
+    trim_regs = init_regs is None
     init_regs = jnp.zeros((mp.n_cores, isa.N_REGS), jnp.int32) \
         if init_regs is None else jnp.asarray(init_regs, jnp.int32)
     if init_regs.ndim == 2:
@@ -3161,6 +3743,11 @@ def simulate_batch(mp, meas_bits, init_regs=None,
             init_regs[None],
             (meas_bits.shape[0],) + tuple(init_regs.shape))
     eng = resolve_engine(mp, cfg)
+    if eng == 'fused':
+        raise ValueError(
+            "engine='fused' demodulates measurement windows in-kernel; "
+            'the injected-bits entry points have no window — run via '
+            'sim.physics.run_physics_batch')
     if eng == 'straightline':
         return _check_strict(
             _run_batch_sl_jit(spc, interp, meas_bits, cfg, mp.n_cores,
@@ -3171,10 +3758,12 @@ def simulate_batch(mp, meas_bits, init_regs=None,
                                mp.n_cores, init_regs,
                                blk=_soa_static(mp)), strict)
     if eng == 'pallas':
+        pack = carry_packspec(mp, cfg, trim_regs=trim_regs) \
+            if use_packed_carry(cfg) else None
         return _check_strict(
             _run_batch_pal_jit(spc, interp, sync_part, meas_bits, cfg,
                                mp.n_cores, init_regs,
-                               pal=_soa_static(mp)), strict)
+                               pal=_soa_static(mp), pack=pack), strict)
     return _check_strict(
         _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
                        mp.n_cores, init_regs, program_traits(mp)), strict)

@@ -66,19 +66,25 @@ from .device import DeviceModel, STATEVEC_MAX_CORES
 from .interpreter import (InterpreterConfig, _program_constants, _init_state,
                           _exec_loop, _finalize, _check_fabric,
                           program_traits, use_straightline, _soa_static,
-                          resolve_engine, _fault_policy, _check_strict)
+                          resolve_engine, _fault_policy, _check_strict,
+                          carry_packspec, use_packed_carry)
 
 
 def _engine_static(mp, cfg: InterpreterConfig):
-    """``(sl, blk)`` content-keyed static programs for the physics epoch
-    loop: exactly one is non-``None`` when :func:`resolve_engine` picks
-    a specialized engine, both ``None`` for the generic engine."""
+    """``(sl, blk, fus)`` content-keyed static programs for the physics
+    epoch loop: at most one is non-``None`` when :func:`resolve_engine`
+    picks a specialized engine, all ``None`` for the generic engine.
+    ``fus`` selects the measure-in-megastep span kernel
+    (``engine='fused'``), which demodulates windows in-kernel and
+    collapses the epoch loop to one pass."""
     eng = resolve_engine(mp, cfg)
     if eng == 'straightline':
-        return _soa_static(mp), None
+        return _soa_static(mp), None, None
     if eng == 'block':
-        return None, _soa_static(mp)
-    return None, None
+        return None, _soa_static(mp), None
+    if eng == 'fused':
+        return None, None, _soa_static(mp)
+    return None, None, None
 
 # default-qchip X90 amplitude word: round(0.48 * (2^16 - 1))
 X90_AMP_DEFAULT = 31457
@@ -852,7 +858,8 @@ _build_tables_jit = functools.partial(
                                              'native_rng', 'rows',
                                              'dev_static', 'cw',
                                              'colored', 'classify3',
-                                             'sl', 'blk'))
+                                             'sl', 'blk', 'fus',
+                                             'fpack'))
 def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      tabs, freq_stack, g0, g1, sigma, inv_ring,
                      key, dev_params, meas_u,
@@ -865,7 +872,8 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
                      traj_key=None, dev_static: tuple = None,
                      cw: int = 0, colored: bool = False,
                      rho=None, g2=None, classify3: bool = False,
-                     sl: tuple = None, blk: tuple = None) -> dict:
+                     sl: tuple = None, blk: tuple = None,
+                     fus: tuple = None, fpack: tuple = None) -> dict:
     B = init_states.shape[0]
     C, M = n_cores, cfg.max_meas
     st0 = _init_state(B, C, cfg, init_regs)
@@ -914,6 +922,16 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
         prebuilt = (tabs['toeplitz'], tabs['basis'])
     colored_tabs = _ar1_tables(
         rho, _aligned_chunk(chunk, W, interps)) if colored else None
+    fused_args = None
+    if fus is not None:
+        # measure-in-megastep: per-address DAC-resolution energy rows,
+        # built ONCE outside the (single-iteration) epoch loop — the
+        # kernel's whole demodulation is a masked sum against them
+        from ..ops.resolve_pallas import build_energy_tables
+        fused_args = {
+            'e2': build_energy_tables(env_pads, rows, W, interps),
+            'g0': g0, 'g1': g1, 'addrs': rows, 'w': W,
+            'amp_scale': float(AMP_SCALE)}
 
     def cond(carry):
         st, bits, valid, _cls, ep = carry
@@ -925,7 +943,7 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
         # in meas_bits), within the epoch bound either way.  The
         # straight-line executor terminates structurally (forward-only,
         # one visit per instruction) so only the epoch bound applies.
-        budget_ok = True if sl is not None \
+        budget_ok = True if sl is not None or fus is not None \
             else (st['_steps'] < cfg.max_steps)
         can_exec = (~jnp.all(st['done'])) & budget_ok
         fired = jnp.arange(cfg.max_meas)[None, None, :] \
@@ -935,7 +953,22 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
 
     def body(carry):
         st, bits, valid, cls, ep = carry
-        if sl is not None:
+        if fus is not None:
+            # measure-in-megastep: exec + resolve in ONE kernel pass —
+            # the bit lands in its slot at the trigger, every fproc
+            # read is served in-kernel, and the loop exits after this
+            # iteration (epochs == 1, docs/PERF.md "fused epoch")
+            from .interpreter import (_exec_span_pallas_fused,
+                                      _soa_from_static,
+                                      _default_pallas_interpret)
+            itp = cfg.pallas_interpret
+            if itp is None:
+                itp = _default_pallas_interpret()
+            st, bits, valid = _exec_span_pallas_fused(
+                st, _soa_from_static(fus), spc, interp, bits, valid,
+                cfg, itp, fused_args, pack=fpack)
+            st['paused'] = jnp.any(st['phys_wait'] & ~st['done'], -1)
+        elif sl is not None:
             from .interpreter import _exec_straightline, _soa_from_static
             st = _exec_straightline(st, _soa_from_static(sl), spc, interp,
                                     bits, valid, cfg, dev)
@@ -950,7 +983,9 @@ def _run_physics_jit(soa, spc, interp, sync_part, init_states, init_regs,
         else:
             st = _exec_loop(st, soa, spc, interp, sync_part, bits, valid,
                             cfg, dev, traits)
-        if mode == 'analytic':
+        if fus is not None:
+            pass    # bits landed in-kernel; nothing left to resolve
+        elif mode == 'analytic':
             bits, valid, cls = _resolve_analytic(
                 st, bits, valid, key, tables, env_pads, response, W, cw,
                 iq3, cls)
@@ -1279,8 +1314,42 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
     inv_ring = jnp.float32(0.0 if model.ring_tau <= 0
                            else 1.0 / model.ring_tau)
     interps = tuple(int(x) for x in np.asarray(interp_m))
-    rows = _static_meas_env_addrs(mp) if model.resolve_mode == 'fused' \
+    eng_sl, eng_blk, eng_fus = _engine_static(mp, cfg)
+    rows = _static_meas_env_addrs(mp) \
+        if (model.resolve_mode == 'fused' or eng_fus is not None) \
         else None
+    fpack = None
+    if eng_fus is not None:
+        # program/config eligibility was settled by resolve_engine
+        # (span shape, parity device, no CW, static meas bound); what
+        # remains is the readout MODEL the kernel specializes: the
+        # sigma=0 matched filter over statically-enumerable envelopes
+        blockers = []
+        if float(model.sigma) != 0.0:
+            blockers.append(
+                f'sigma={model.sigma} (the in-kernel demodulator is '
+                f'the sigma=0 matched filter; noise draws stay with '
+                f'the epoch resolver)')
+        if model.ring_tau > 0:
+            blockers.append('ring_tau > 0 (the resonator ring-up '
+                            'transient needs the per-sample resolver)')
+        if model.noise_ar1 > 0:
+            blockers.append('noise_ar1 > 0 (colored ADC noise needs '
+                            "resolve_mode='persample')")
+        if rows is None:
+            blockers.append('envelope addresses not statically '
+                            'enumerable (a register-sourced envelope '
+                            'write, or more than 8 distinct addresses)')
+        if blockers:
+            raise ValueError(
+                "engine='fused' (measure-in-megastep) is ineligible "
+                'for this readout model: ' + '; '.join(blockers)
+                + " — use resolve_mode='fused' (the in-kernel epoch "
+                'resolver) for the general model')
+        if use_packed_carry(cfg):
+            fpack = carry_packspec(mp, cfg,
+                                   trim_regs=init_regs is None,
+                                   fused=True)
     if tables is not None:
         _validate_tables(mp, model, tables, W, interps, rows,
                          skip_traced=True)
@@ -1291,7 +1360,6 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
                                    model.resolve_mode, W,
                                    model.resolve_chunk, interps, rows,
                                    _tables_meta(model, W, interps, mp))
-    eng_sl, eng_blk = _engine_static(mp, cfg)
     return _check_strict(_run_physics_jit(
         soa, spc, interp, sync_part, init_states, init_regs, tables,
         freq_stack, as_iq(model.g0), as_iq(model.g1),
@@ -1305,4 +1373,5 @@ def run_physics_batch(mp, model: ReadoutPhysics, key, shots: int,
         jnp.float32(model.noise_ar1),
         g2=as_iq(model.g2) if model.g2 is not None else None,
         classify3=bool(model.classify3),
-        sl=eng_sl, blk=eng_blk), strict_faults)
+        sl=eng_sl, blk=eng_blk, fus=eng_fus, fpack=fpack),
+        strict_faults)

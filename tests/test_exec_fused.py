@@ -1,0 +1,361 @@
+"""Fused measure-in-megastep engine + bit-packed VMEM carry.
+
+The fifth engine-ladder rung (docs/PERF.md "fused epoch",
+``engine='fused'``): when a span hits a measurement instruction, the
+readout window is synthesized and demodulated INSIDE the span kernel,
+so the bit lands in the carry's measurement slot at the trigger and the
+physics epoch ``while_loop`` collapses to one trip — no
+exec -> resolve -> inject round-trip per measurement layer.  The
+bit-packed carry (``packed_carry=True``) shrinks the megastep's
+HBM-crossing streams by packing booleans/enums/counters to their
+static widths.
+
+Contract pinned here: EXACT per-stat equality with the generic engine
+(fault word included) on branch-on-measurement programs and the golden
+suite, composition under vmap and a dp=2 mesh, the <= 1 retrace
+budget, and the engine-selection/ineligibility surface.  Every test
+runs on CPU through the kernel interpreter (``pallas_interpret``
+resolves to True off-TPU) — tools/check_junit.py fails the suite if
+any of these testcases SKIPS.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from bench import build_machine_program
+from distributed_processor_tpu import isa
+from distributed_processor_tpu.decoder import machine_program_from_cmds
+from distributed_processor_tpu.models.default_qchip import make_default_qchip
+from distributed_processor_tpu.models.experiments import active_reset
+from distributed_processor_tpu.models.golden_suite import GOLDEN_PROGRAMS
+from distributed_processor_tpu.parallel import make_mesh
+from distributed_processor_tpu.parallel.sweep import sharded_physics_stat_sums
+from distributed_processor_tpu.pipeline import compile_to_machine
+from distributed_processor_tpu.serve import ExecutionService
+from distributed_processor_tpu.sim import faultinject as fi
+from distributed_processor_tpu.sim.interpreter import (
+    InterpreterConfig, _program_constants, _run_batch_engine, _soa_static,
+    carry_packspec, carry_stream_bytes, fused_ineligible, pallas_trace_count,
+    program_traits, resolve_engine, simulate_batch)
+from distributed_processor_tpu.sim.physics import (ReadoutPhysics,
+                                                   run_physics_batch)
+from distributed_processor_tpu.simulator import Simulator
+
+pytestmark = pytest.mark.pallas
+
+
+@pytest.fixture(scope='module')
+def reset_mp():
+    """Active reset: mid-circuit measurement + branch on the bit."""
+    sim = Simulator(n_qubits=2)
+    return sim.compile(active_reset(['Q0', 'Q1']))
+
+
+KW = dict(max_pulses=32, max_meas=4)
+SIGMA0 = ReadoutPhysics(sigma=0.0)
+
+
+def _run(mp, init, engine=None, **kw):
+    merged = {**KW, **kw}
+    return run_physics_batch(mp, SIGMA0, 5, init.shape[0],
+                             init_states=init,
+                             max_steps=mp.n_instr * 4 + 64,
+                             **({'engine': engine} if engine else {}),
+                             **merged)
+
+
+def _assert_equal_outputs(a, b, skip=('steps', 'epochs'), msg=''):
+    assert set(a) == set(b), msg
+    for k in a:
+        if k in skip:
+            continue
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=f'{msg}{k}')
+
+
+def _span_mp():
+    """Forward-jump-only injected-bits program (no measurement)."""
+    return machine_program_from_cmds([[
+        isa.pulse_cmd(amp_word=1000, cfg_word=0, env_word=(8 << 12) | 3,
+                      cmd_time=10),
+        isa.alu_cmd('reg_alu', 'i', 5, 'add', alu_in1=1,
+                    write_reg_addr=1),
+        isa.pulse_cmd(amp_word=2000, cfg_word=2, env_word=(4 << 12) | 1,
+                      cmd_time=40),
+        isa.done_cmd(),
+    ]])
+
+
+def _loop_mp():
+    """Counted backward loop: span-ineligible, so fused-ineligible."""
+    return machine_program_from_cmds([[
+        isa.pulse_cmd(cmd_time=100, cfg_word=0, env_word=4096),
+        isa.alu_cmd('reg_alu', 'i', 1, 'add', alu_in1=0,
+                    write_reg_addr=0),
+        isa.alu_cmd('jump_cond', 'i', 3, 'ge', alu_in1=0,
+                    jump_cmd_ptr=0),
+        isa.done_cmd(),
+    ]])
+
+
+# ---------------------------------------------------------------------------
+# measure-in-megastep bit-identity (branch-on-measurement, fault word)
+# ---------------------------------------------------------------------------
+
+def test_fused_bit_identity_active_reset(reset_mp):
+    """The fused engine retires the whole active-reset program —
+    measurement, demodulation, branch — in ONE epoch, bit-identical to
+    the generic engine's epoch loop on every stat."""
+    rng = np.random.default_rng(7)
+    init = rng.integers(0, 2, (16, 2)).astype(np.int32)
+    gen = _run(reset_mp, init, engine='generic')
+    fus = _run(reset_mp, init, engine='fused')
+    _assert_equal_outputs(gen, fus, msg='fused: ')
+    # the bits are REAL demodulated bits (sigma=0: bit == state) and
+    # the epoch while_loop collapsed
+    np.testing.assert_array_equal(np.asarray(fus['meas_bits'])[:, :, 0],
+                                  init)
+    assert int(np.asarray(gen['epochs'])) > 1
+    assert int(np.asarray(fus['epochs'])) == 1
+
+
+def test_fused_fault_word_identity(reset_mp):
+    """A starved pulse budget traps the same fault word per shot on
+    both engines — bit-identity includes the fault machinery."""
+    rng = np.random.default_rng(3)
+    init = rng.integers(0, 2, (8, 2)).astype(np.int32)
+    gen = _run(reset_mp, init, engine='generic', max_pulses=1)
+    assert np.any(np.asarray(gen['fault'])), 'fixture must actually trap'
+    fus = _run(reset_mp, init, engine='fused', max_pulses=1)
+    _assert_equal_outputs(gen, fus, msg='fault: ')
+
+
+def test_fused_packed_carry_parity(reset_mp):
+    """The bit-packed carry layout composes with the fused engine:
+    pack/unpack shims at the kernel boundary are bit-transparent."""
+    rng = np.random.default_rng(11)
+    init = rng.integers(0, 2, (8, 2)).astype(np.int32)
+    fus = _run(reset_mp, init, engine='fused')
+    packed = _run(reset_mp, init, engine='fused', packed_carry=True)
+    _assert_equal_outputs(fus, packed, skip=(), msg='packed fused: ')
+
+
+def test_fused_golden_suite_sweep():
+    """Every golden program either runs bit-identically through the
+    fused engine or is rejected with a named ineligibility — never a
+    silent wrong answer.  At least one golden must actually exercise
+    the fused path."""
+    compared = rejected = 0
+    for name in sorted(GOLDEN_PROGRAMS):
+        n_qubits, thunk = GOLDEN_PROGRAMS[name]
+        qchip = make_default_qchip(max(n_qubits, 2))
+        mp = compile_to_machine(thunk(), qchip, n_qubits=n_qubits)
+        kw = dict(init_states=np.zeros((4, mp.n_cores), np.int32),
+                  max_steps=4 * mp.n_instr + 64, max_pulses=64,
+                  max_meas=16, max_resets=64)
+        try:
+            gen = run_physics_batch(mp, SIGMA0, 9, 4, engine='generic',
+                                    **kw)
+        except ValueError:
+            continue        # golden outside the physics entry's domain
+        try:
+            fus = run_physics_batch(mp, SIGMA0, 9, 4, engine='fused',
+                                    **kw)
+        except ValueError as e:
+            assert 'ineligible' in str(e), f'{name}: {e}'
+            rejected += 1
+            continue
+        _assert_equal_outputs(gen, fus, msg=f'{name}: ')
+        compared += 1
+    assert compared >= 1, \
+        f'no golden exercised the fused path ({rejected} rejected)'
+
+
+# ---------------------------------------------------------------------------
+# packed carry on the injected-bits pallas rung (golden suite + faults)
+# ---------------------------------------------------------------------------
+
+_NONTERMINATING_GOLDENS = frozenset({'simple_loop', 'nested_loop'})
+
+
+@pytest.mark.parametrize('name', sorted(GOLDEN_PROGRAMS))
+def test_golden_suite_packed_carry_equality(name):
+    """Every terminating golden program runs bit-identically on the
+    pallas engine with the bit-packed carry — every output key, the
+    fault word included."""
+    if name in _NONTERMINATING_GOLDENS:
+        return
+    n_qubits, thunk = GOLDEN_PROGRAMS[name]
+    qchip = make_default_qchip(max(n_qubits, 2))
+    mp = compile_to_machine(thunk(), qchip, n_qubits=n_qubits)
+    cfg_kw = dict(mp.static_bounds(), max_meas=16, max_resets=64)
+    rng = np.random.default_rng(17)
+    bits = rng.integers(0, 2, size=(8, mp.n_cores, 16))
+    gen = simulate_batch(mp, bits,
+                         cfg=InterpreterConfig(engine='generic', **cfg_kw))
+    assert not bool(gen['incomplete']), name
+    pal = simulate_batch(mp, bits, cfg=InterpreterConfig(
+        engine='pallas', pallas_interpret=True, packed_carry=True,
+        **cfg_kw))
+    _assert_equal_outputs(gen, pal, skip=('steps',), msg=f'{name}: ')
+
+
+def test_packed_carry_fault_word_identity():
+    """Packed carry round-trips the fault word exactly on a trapping
+    span (the overflow-starved fixture)."""
+    mp = _span_mp()
+    kw = dict(max_steps=2 * mp.n_instr + 64, max_pulses=1, max_meas=2,
+              max_resets=2)
+    bits = np.zeros((4, mp.n_cores, 2), np.int32)
+    gen = simulate_batch(mp, bits,
+                         cfg=InterpreterConfig(engine='generic', **kw))
+    assert np.any(np.asarray(gen['fault'])), 'fixture must actually trap'
+    pal = simulate_batch(mp, bits, cfg=InterpreterConfig(
+        engine='pallas', pallas_interpret=True, packed_carry=True, **kw))
+    _assert_equal_outputs(gen, pal, skip=('steps',))
+
+
+def test_packed_carry_reduction_floor():
+    """The modeled per-shot carry bytes shrink >= 3x under the packed
+    layout on the bench workload (the exec_profile row's claim)."""
+    mp = build_machine_program(4, 6)
+    cfg = InterpreterConfig(
+        max_steps=2 * mp.n_instr + 64,
+        max_pulses=int(mp.max_pulses_per_core(1)) + 4,
+        max_meas=2, max_resets=2, record_pulses=False)
+    unpacked, packed = carry_stream_bytes(mp, cfg)
+    assert packed * 3 <= unpacked, (unpacked, packed)
+
+
+# ---------------------------------------------------------------------------
+# composition: vmap, dp=2 mesh, retrace budget
+# ---------------------------------------------------------------------------
+
+def test_packed_carry_under_vmap():
+    """The packed-carry megastep is a plain JAX program: vmapping it
+    over a leading group axis matches the vmapped generic engine."""
+    mp = _span_mp()
+    cfg = InterpreterConfig(max_steps=2 * mp.n_instr + 64, max_pulses=8,
+                            max_meas=2, max_resets=2,
+                            pallas_interpret=True, packed_carry=True)
+    soa, spc, interp, sync_part = _program_constants(mp, cfg)
+    prog = _soa_static(mp)
+    traits = program_traits(mp)
+    pack = carry_packspec(mp, cfg)
+    rng = np.random.default_rng(7)
+    bits = np.asarray(
+        rng.integers(0, 2, size=(3, 8, mp.n_cores, 2)), np.int32)
+
+    def pal(mb):
+        return _run_batch_engine(None, spc, interp, sync_part, mb, cfg,
+                                 mp.n_cores, engine='pallas', prog=prog,
+                                 pack=pack)
+
+    def gen(mb):
+        return _run_batch_engine(soa, spc, interp, sync_part, mb, cfg,
+                                 mp.n_cores, engine='generic',
+                                 traits=traits)
+
+    p = jax.jit(jax.vmap(pal))(bits)
+    g = jax.jit(jax.vmap(gen))(bits)
+    _assert_equal_outputs(g, p, skip=('steps',), msg='vmap: ')
+
+
+def test_fused_dp2_mesh(reset_mp):
+    """dp=2 mesh: the fused engine inside shard_map produces exactly
+    the per-shard statistics of the generic epoch loop (same keys, same
+    thermal sampling, bit-identical demodulated bits)."""
+    mesh = make_mesh(n_dp=2)
+    kw = dict(max_steps=reset_mp.n_instr * 4 + 64, **KW)
+    gen = sharded_physics_stat_sums(reset_mp, SIGMA0, 21, 32, mesh,
+                                    engine='generic', **kw)
+    fus = sharded_physics_stat_sums(reset_mp, SIGMA0, 21, 32, mesh,
+                                    engine='fused', **kw)
+    assert set(gen) == set(fus)
+    for k in gen:
+        np.testing.assert_array_equal(np.asarray(gen[k]),
+                                      np.asarray(fus[k]), err_msg=k)
+
+
+def test_fused_retrace_budget(reset_mp):
+    """Identical fused calls share one trace: the fused span executor
+    books at most one pallas trace for one program content."""
+    rng = np.random.default_rng(13)
+    init = rng.integers(0, 2, (4, 2)).astype(np.int32)
+    n0 = pallas_trace_count()
+    a = _run(reset_mp, init, engine='fused')
+    n1 = pallas_trace_count()
+    assert n1 - n0 <= 1, 'more than one fused trace for one program'
+    b = _run(reset_mp, init, engine='fused')
+    assert pallas_trace_count() == n1, 'retrace on an identical call'
+    _assert_equal_outputs(a, b, skip=())
+
+
+# ---------------------------------------------------------------------------
+# engine selection + ineligibility surface
+# ---------------------------------------------------------------------------
+
+def test_fused_engine_selection(reset_mp):
+    phys = dict(max_steps=256, max_pulses=16, max_meas=4,
+                physics=True, device='parity')
+    assert resolve_engine(
+        reset_mp, InterpreterConfig(engine='fused', **phys)) == 'fused'
+    # 'auto' never picks fused: its remaining gates live in the readout
+    # model, which resolve_engine cannot see
+    assert resolve_engine(
+        reset_mp, InterpreterConfig(engine='auto', **phys)) != 'fused'
+
+
+def test_fused_ineligibility_named(reset_mp):
+    base = dict(max_steps=256, max_pulses=16, max_meas=4)
+    # injected-bits cfg (physics=False): no window to demodulate
+    cfg = InterpreterConfig(engine='fused', **base)
+    assert fused_ineligible(reset_mp, cfg)
+    with pytest.raises(ValueError, match='ineligible'):
+        resolve_engine(reset_mp, cfg)
+    with pytest.raises(ValueError, match='fused'):
+        simulate_batch(reset_mp, np.zeros((2, 2, 4), np.int32), cfg=cfg)
+    # span-ineligible program (backward loop)
+    loop_cfg = InterpreterConfig(engine='fused', physics=True,
+                                 device='parity', **base)
+    assert fused_ineligible(_loop_mp(), loop_cfg)
+    with pytest.raises(ValueError, match='ineligible'):
+        resolve_engine(_loop_mp(), loop_cfg)
+    # model-level blocker: noise makes the in-kernel energy sum diverge
+    # from the resolver's float realization, so sigma > 0 is rejected
+    rng = np.random.default_rng(5)
+    init = rng.integers(0, 2, (4, 2)).astype(np.int32)
+    with pytest.raises(ValueError, match='sigma'):
+        run_physics_batch(reset_mp, ReadoutPhysics(sigma=0.05), 5, 4,
+                          init_states=init, max_steps=256,
+                          engine='fused', **KW)
+
+
+def test_faultfuzz_generic_vs_fused():
+    """The mutant corpus cross-checks generic vs fused on the
+    timing-independent fault codes (physics-closed at sigma=0);
+    ineligible mutants skip, none may diverge."""
+    r = fi.check_fused_consistency(seed=0, n=24, shots=2)
+    assert not r['failures'], r['failures']
+    assert r['checked'] >= 1, 'no mutant exercised the fused engine'
+
+
+# ---------------------------------------------------------------------------
+# serving integration: the serve tier names the fused mode
+# ---------------------------------------------------------------------------
+
+def test_serve_rejects_fused(reset_mp):
+    # a submitted cfg pinning the fused engine is rejected, named
+    with ExecutionService(max_wait_ms=1.0) as svc:
+        with pytest.raises(ValueError, match='fused'):
+            svc.submit(reset_mp, shots=2, cfg=InterpreterConfig(
+                max_steps=64, max_meas=4, engine='fused'))
+    # the singleton ladder rejects fused at construction, naming why
+    with pytest.raises(ValueError, match='fused'):
+        ExecutionService(singleton_engine='fused')
+    # an unknown singleton engine's message names the full ladder,
+    # fused included
+    with pytest.raises(ValueError, match='fused'):
+        ExecutionService(singleton_engine='warp')

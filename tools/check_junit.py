@@ -33,6 +33,10 @@ NO_SKIP_MODULES = {
     'test_exec_pallas':
         'pallas exec-kernel tests must run on CPU via interpret '
         'mode, never skip (see docs/PERF.md "megastep")',
+    'test_exec_fused':
+        'fused measure-in-megastep + packed-carry tests must run on '
+        'CPU via interpret mode, never skip (see docs/PERF.md "fused '
+        'epoch")',
     'test_compilecache':
         'compile front-door tests are pure CPU (numpy compile + '
         'content hashing), there is no legitimate skip condition — a '

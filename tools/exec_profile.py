@@ -136,6 +136,19 @@ def decompose_engines(n_qubits: int = 8, depth: int = 12,
             row['fixed_cost_reduction_vs_generic'] = round(
                 gen['fixed_s_total'] / row['fixed_s_total'], 2) \
                 if row['fixed_s_total'] else None
+    # modeled megastep carry traffic: the unpacked vs bit-packed per-shot
+    # bytes the 2*carry*steps exec-phase HBM model prices — the packed
+    # layout's claimed reduction as a machine-readable number
+    try:
+        from distributed_processor_tpu.sim.interpreter import \
+            carry_stream_bytes
+        u, p = carry_stream_bytes(mp, InterpreterConfig(**base))
+        out['carry_bytes_per_shot'] = {
+            'unpacked': int(u), 'packed': int(p),
+            'packed_reduction': round(u / p, 2) if p else None}
+    except Exception as e:                          # non-span program etc.
+        out['carry_bytes_per_shot'] = {
+            'error': f'{type(e).__name__}: {e}'[:200]}
     return out
 
 

@@ -7,7 +7,9 @@ budgets — see ``sim/faultinject.py``) and asserts every mutant is
 rejected at decode, rejected by the static validator, trapped with a
 correct ``fault_shots`` code by every engine that runs it, or provably
 benign.  Also cross-checks the vmapped multi-program executable and
-the dp=2 mesh-sharded sweep against per-program runs.
+the dp=2 mesh-sharded sweep against per-program runs, and the fused
+measure-in-megastep engine against the generic engine on
+physics-closed (sigma=0) runs for timing-independent fault codes.
 
 Deterministic in ``--seed``: a failing case name (``base+mutator#k``)
 reproduces exactly.  Exit nonzero on any failure — wired into the
@@ -69,6 +71,16 @@ def main(argv=None) -> int:
                                     n=4 if args.quick else 8)
     print(f'vmap cross-check: {bad} per-program mismatches')
     failed |= bad != 0
+
+    # generic vs fused measure-in-megastep on timing-independent fault
+    # codes (physics-closed at sigma=0; ineligible mutants are skipped)
+    fr = fi.check_fused_consistency(seed=args.seed,
+                                    n=24 if args.quick else 96)
+    print(f'fused cross-check: {fr["checked"]} checked, '
+          f'{fr["skipped"]} skipped, {len(fr["failures"])} failures')
+    for name, detail in fr['failures']:
+        print(f'FAILURE: {name}: {detail}')
+    failed |= bool(fr['failures'])
 
     if not args.no_mesh:
         bad = fi.check_mesh_consistency(seed=args.seed,
