@@ -1154,6 +1154,46 @@ def _observability_overhead_row():
     return out
 
 
+def _integrity_overhead_row():
+    """What the integrity fabric costs: the continuous-batching
+    workload with audits off (the default), sampled at
+    ``BENCH_INTEGRITY_SAMPLE``, and strict ``audit_sample=1`` — the
+    audits-off throughput must stay within noise of the unaudited
+    baseline (zero-cost default), and the audited rounds report
+    overhead proportional to the sampling rate plus the audit count
+    actually paid (docs/ROBUSTNESS.md "Integrity").
+    ``BENCH_INTEGRITY_REQS`` / ``BENCH_INTEGRITY_SHOTS`` size the
+    workload."""
+    n_reqs = int(os.environ.get('BENCH_INTEGRITY_REQS', 32))
+    shots = int(os.environ.get('BENCH_INTEGRITY_SHOTS', 32))
+    sampled = float(os.environ.get('BENCH_INTEGRITY_SAMPLE', 0.125))
+    out = {'n_reqs': n_reqs, 'shots_per_req': shots}
+    base_svc_s = None
+    for label, kwargs in (
+            ('off', {}),
+            ('sampled', {'audit_sample': sampled,
+                         'audit_mode': 'flag'}),
+            ('strict', {'audit_sample': 1.0, 'audit_mode': 'strict'})):
+        row = continuous_batching_comparison(
+            n_reqs=n_reqs, shots=shots, service_kwargs=kwargs)
+        entry = {
+            'audit_sample': kwargs.get('audit_sample', 0.0),
+            'audit_mode': kwargs.get('audit_mode', 'flag'),
+            'service_warm_s': row['service_warm_s'],
+            'throughput_ratio': row['throughput_ratio'],
+            'latency_p99_ms': row['latency_p99_ms'],
+            'audits': row['audits'],
+            'audit_mismatches': row['audit_mismatches'],
+        }
+        if base_svc_s is None:
+            base_svc_s = row['service_warm_s']
+        elif base_svc_s > 0:
+            entry['overhead_vs_off'] = round(
+                row['service_warm_s'] / base_svc_s - 1.0, 4)
+        out[label] = entry
+    return out
+
+
 def _fleet_observability_overhead_row():
     """What fleet-wide observability costs: the same closed-loop
     workload through one fleet of replica processes at trace_sample
@@ -1757,6 +1797,20 @@ def main():
         fleet_obs_row = None
     artifact.row('fleet_observability_overhead', fleet_obs_row)
 
+    # integrity-overhead row: the same workload with the silent-data-
+    # corruption auditor off / sampled / strict — what "zero-cost when
+    # off, proportional when on" costs in practice (BENCH_INTEGRITY_*)
+    if secondaries and os.environ.get('BENCH_INTEGRITY', '1') != '0':
+        try:
+            integrity_row = _timed_row(_integrity_overhead_row)
+        except _RowTimeout as e:
+            integrity_row = {'error': 'timeout', 'detail': str(e)}
+        except Exception as e:  # pragma: no cover - defensive
+            integrity_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+    else:
+        integrity_row = None
+    artifact.row('integrity_overhead', integrity_row)
+
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
     result = {
@@ -1810,6 +1864,7 @@ def main():
             'compile_front_door': front_door,
             'observability_overhead': obs_row,
             'fleet_observability_overhead': fleet_obs_row,
+            'integrity_overhead': integrity_row,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

@@ -505,12 +505,17 @@ def cmd_fleet_status(args):
             client.close()
         st = g.get('stats', {})
         fl = g.get('flight', {})
+        # mismatches/audits (plus any scrubber quarantines): a nonzero
+        # numerator is a silent-data-corruption alarm, not noise
+        ig = st.get('integrity') or {}
         rows.append({
             'replica': addr,
             'health': st.get('health'),
             'queue_depth': st.get('queue_depth'),
             'est_wait_ms': st.get('est_wait_ms'),
             'completed': st.get('completed'),
+            'integrity': (f"{ig.get('mismatches', 0)}"
+                          f"/{ig.get('audits', 0)}" if ig else ''),
             'flight_recorded': fl.get('recorded'),
             'flight_dropped': fl.get('dropped'),
             'flight_counts': fl.get('counts'),
@@ -528,7 +533,8 @@ def cmd_fleet_status(args):
         print(json.dumps(rows, indent=2))
         return
     cols = ('replica', 'health', 'queue_depth', 'est_wait_ms',
-            'completed', 'flight_recorded', 'flight_dropped')
+            'completed', 'integrity', 'flight_recorded',
+            'flight_dropped')
     widths = {c: max(len(c), *(len(str(r.get(c, ''))) for r in rows))
               for c in cols}
     print('  '.join(c.ljust(widths[c]) for c in cols))

@@ -11,6 +11,17 @@ process trusts.  Any load failure — corrupt zlib stream, truncated
 pickle, version skew, key mismatch — is a MISS, never an exception:
 the cache recompiles and overwrites.
 
+Format v2 adds an integrity digest (docs/ROBUSTNESS.md "Integrity"):
+the MachineProgram is pickled separately and stored alongside a CRC32
+of those exact bytes, verified before unpickling on load.  The outer
+zlib stream has its own adler32, but that only covers the compressed
+blob on THIS read — the digest pins the program content across the
+store's whole shared-warm-tier lifetime (an entry written by one
+replica and mmap'd, copied, or rsync'd to another still proves out).
+A digest mismatch counts ``integrity.store_digest_fail`` and is the
+usual remove+miss.  v1 entries fail the version check and recompile —
+the standard skew path, no migration needed.
+
 The filename encodes ``<content-key>-<qchip-fp[:16]>.mpc`` so epoch
 invalidation can unlink exactly one calibration epoch's entries
 without deserializing anything.
@@ -23,8 +34,11 @@ import os
 import pickle
 import zlib
 
+from ..integrity import content_crc32
+from ..utils import profiling
+
 STORE_MAGIC = 'dproc-compilecache'
-STORE_VERSION = 1
+STORE_VERSION = 2
 _SUFFIX = '.mpc'
 
 
@@ -51,7 +65,11 @@ class PersistentStore:
                     or payload.get('version') != STORE_VERSION
                     or payload.get('key') != key):
                 raise ValueError('version/key skew')
-            return payload['mp']
+            blob = payload['mp_pickle']
+            if content_crc32((blob,)) != payload['crc']:
+                profiling.counter_inc('integrity.store_digest_fail')
+                raise ValueError('store entry digest mismatch')
+            return pickle.loads(blob)
         except FileNotFoundError:
             return None
         except (OSError, zlib.error, pickle.UnpicklingError, EOFError,
@@ -66,8 +84,11 @@ class PersistentStore:
             return None
 
     def save(self, key: str, qchip_fp: str, mp) -> None:
+        mp_pickle = pickle.dumps(mp, protocol=pickle.HIGHEST_PROTOCOL)
         payload = {'magic': STORE_MAGIC, 'version': STORE_VERSION,
-                   'key': key, 'qchip_fp': qchip_fp, 'mp': mp}
+                   'key': key, 'qchip_fp': qchip_fp,
+                   'mp_pickle': mp_pickle,
+                   'crc': content_crc32((mp_pickle,))}
         blob = zlib.compress(pickle.dumps(payload))
         fname = self._fname(key, qchip_fp)
         tmp = fname + '.tmp'

@@ -18,6 +18,9 @@ dict.  Event taxonomy (docs/OBSERVABILITY.md):
 ``batch_failure``    a batch raised (infra or program class)
 ``chaos_inject``     ChaosMonkey injected a non-ok outcome
 ``cache_invalidate`` compile-cache calibration-epoch invalidation
+``integrity_violation`` audit or digest mismatch (edge-triggered per
+                     executor: one event per clean->bad transition)
+``scrubber_fail``    background scrubber canary mismatched golden ref
 
 Cost discipline: ``record`` is one dict build + ``deque.append``
 (atomic under the GIL) + an ``itertools.count`` draw — no lock, safe

@@ -25,6 +25,7 @@ queues grow into missed deadlines.  ``serve.chaos`` injects seeded
 crashes/hangs/slowdowns under ``_run_batch`` to prove all of it.
 """
 
+from ..integrity import IntegrityError
 from .batcher import Coalescer, bucket_key
 from .bucketspec import BucketSpec
 from .catalog import BucketCatalog
@@ -43,7 +44,8 @@ from .service import (CANARY_THREAD_PREFIX, DISPATCH_THREAD_PREFIX,
 from .supervise import (HEALTH_LIVE, HEALTH_PROBING,
                         HEALTH_QUARANTINED, CircuitBreaker, RetryPolicy)
 from .transport import (WIRE_THREAD_PREFIX, ReplicaClient,
-                        ReplicaLostError, ReplicaServer)
+                        ReplicaLostError, ReplicaServer,
+                        WireCorruptionError)
 
 __all__ = [
     'BucketCatalog',
@@ -66,6 +68,7 @@ __all__ = [
     'HEALTH_LIVE',
     'HEALTH_PROBING',
     'HEALTH_QUARANTINED',
+    'IntegrityError',
     'OverloadError',
     'QueueFullError',
     'ROUTER_THREAD_PREFIX',
@@ -80,6 +83,7 @@ __all__ = [
     'SoakReport',
     'WARMUP_THREAD_PREFIX',
     'WIRE_THREAD_PREFIX',
+    'WireCorruptionError',
     'bucket_key',
     'fleet_soak',
     'is_terminal_error',

@@ -55,14 +55,17 @@ def continuous_batching_comparison(n_reqs: int = 32, n_qubits: int = 2,
                                    seed: int = 0,
                                    max_wait_ms: float = 100.0,
                                    trace_sample: float = 0.0,
-                                   trace_out: str = None) -> dict:
+                                   trace_out: str = None,
+                                   service_kwargs: dict = None) -> dict:
     """Warm throughput of ``n_reqs`` service submissions vs the same
     requests dispatched sequentially; returns a JSON-able row.
 
     ``trace_sample`` > 0 turns on per-request tracing in the measured
     service (the observability-overhead bench varies it); ``trace_out``
-    exports the warm round's Chrome-trace JSON
-    (docs/OBSERVABILITY.md)."""
+    exports the warm round's Chrome-trace JSON (docs/OBSERVABILITY.md);
+    ``service_kwargs`` forwards extra :class:`ExecutionService` knobs
+    (the integrity-overhead bench varies ``audit_sample`` /
+    ``audit_mode`` through it)."""
     qubits = [f'Q{i}' for i in range(n_qubits)]
     qchip = make_default_qchip(n_qubits)
     mps = [compile_to_machine(active_reset(qubits) + prog, qchip,
@@ -91,7 +94,8 @@ def continuous_batching_comparison(n_reqs: int = 32, n_qubits: int = 2,
                                max_wait_ms=max_wait_ms,
                                max_queue=4 * n_reqs,
                                trace_sample=trace_sample,
-                               trace_keep=2 * n_reqs)
+                               trace_keep=2 * n_reqs,
+                               **(service_kwargs or {}))
         try:
             t0 = time.perf_counter()
             handles = [svc.submit(mp, b) for mp, b in zip(mps, bits)]
@@ -136,6 +140,8 @@ def continuous_batching_comparison(n_reqs: int = 32, n_qubits: int = 2,
         'bit_identical': True,
         'trace_sample': trace_sample,
         'trace_events': n_events,
+        'audits': stats['integrity']['audits'],
+        'audit_mismatches': stats['integrity']['mismatches'],
         'note': 'both sides warm, same generic-engine cfg; ratio is '
                 'N per-program dispatches vs coalesced multi-program '
                 'dispatch(es); results asserted bit-identical first',

@@ -49,6 +49,7 @@ try:
 except ImportError:          # pragma: no cover - non-posix
     fcntl = None
 
+from ..utils import profiling
 from .bucketspec import BucketSpec
 
 CATALOG_MAGIC = 'dproc-bucket-catalog'
@@ -157,7 +158,12 @@ class BucketCatalog:
         problem merges nothing (in-memory state is never discarded);
         called at first load and — under :func:`_file_lock` — before
         every rewrite, so concurrent replicas' writes compose instead
-        of last-writer-wins."""
+        of last-writer-wins.  Spec entries are validated ONE AT A
+        TIME: a peer that wrote one garbled spec (torn write on a
+        non-posix filesystem, a buggy or older writer) costs exactly
+        that spec — counted under ``catalog.merge_drops`` — instead of
+        aborting the merge and poisoning every replica that shares the
+        file."""
         try:
             with open(self.path, 'r', encoding='utf-8') as f:
                 doc = json.load(f)
@@ -170,17 +176,25 @@ class BucketCatalog:
             last_seen = doc.get('last_seen', {})
             if not isinstance(last_seen, dict):
                 last_seen = {}
+            dropped = 0
             for d in doc.get('specs', ()):
-                spec = BucketSpec.from_json(d)
-                ident = spec.identity()
-                seen = int(last_seen.get(self._ident_key(ident),
-                                         self._run))
+                try:
+                    spec = BucketSpec.from_json(d)
+                    ident = spec.identity()
+                    seen = int(last_seen.get(self._ident_key(ident),
+                                             self._run))
+                except (ValueError, TypeError, KeyError,
+                        AttributeError):
+                    dropped += 1
+                    continue
                 if ident not in self._specs:
                     self._specs[ident] = spec
                     self._last_seen[ident] = seen
                 else:
                     self._last_seen[ident] = max(
                         self._last_seen[ident], seen)
+            if dropped:
+                profiling.counter_inc('catalog.merge_drops', dropped)
         except (OSError, ValueError, TypeError, KeyError):
             pass
 

@@ -60,7 +60,7 @@ class ChaosThreadDeath(BaseException):
 
 
 # injection outcomes, drawn per _run_batch call
-OUTCOMES = ('crash', 'hang', 'slow', 'die', 'ok')
+OUTCOMES = ('crash', 'hang', 'slow', 'die', 'corrupt', 'ok')
 
 
 @dataclass(frozen=True)
@@ -76,7 +76,11 @@ class ChaosPlan:
     dispatch eventually completes as a straggler, which the service
     must discard via the attempt token; 'slow' sleeps ``slow_s``
     (service-time jitter below the watchdog); 'die' raises
-    :class:`ChaosThreadDeath` and kills the dispatcher.
+    :class:`ChaosThreadDeath` and kills the dispatcher; 'corrupt' runs
+    the batch then flips ONE seeded bit in one request's result stats
+    — the silent-data-corruption model (docs/ROBUSTNESS.md
+    "Integrity"): no exception is raised, so only the audit fabric can
+    catch it.
     """
     seed: int = 0
     script: tuple = ()
@@ -84,6 +88,7 @@ class ChaosPlan:
     p_hang: float = 0.0
     p_slow: float = 0.0
     p_die: float = 0.0
+    p_corrupt: float = 0.0
     hang_s: float = 0.25
     slow_s: float = 0.01
 
@@ -92,7 +97,8 @@ class ChaosPlan:
             if out not in OUTCOMES:
                 raise ValueError(
                     f'script outcome {out!r} not in {OUTCOMES}')
-        if self.p_crash + self.p_hang + self.p_slow + self.p_die > 1.0:
+        if self.p_crash + self.p_hang + self.p_slow + self.p_die \
+                + self.p_corrupt > 1.0:
             raise ValueError('injection probabilities sum above 1')
 
 
@@ -133,6 +139,9 @@ class ChaosMonkey:
                     out = 'slow'
                 elif r < p.p_crash + p.p_hang + p.p_slow + p.p_die:
                     out = 'die'
+                elif r < p.p_crash + p.p_hang + p.p_slow + p.p_die \
+                        + p.p_corrupt:
+                    out = 'corrupt'
                 else:
                     out = 'ok'
             self.injected[out] += 1
@@ -141,6 +150,31 @@ class ChaosMonkey:
     def script_exhausted(self) -> bool:
         with self._lock:
             return not self._script
+
+    def _corrupt_results(self, results):
+        """One seeded bit flip in one request's result stats — in the
+        first integer stat field, so the corruption always lands in
+        bits the tenant would consume (meas/regs/fault words), never
+        in a float that might round away.  Raises if the results carry
+        no integer array: an injection that cannot corrupt must not be
+        counted as one."""
+        from ..integrity import flip_bit
+        with self._lock:
+            ri = int(self._rng.integers(len(results)))
+            bit = int(self._rng.integers(0, 16))
+            idx = int(self._rng.integers(0, 1 << 16))
+        stats = dict(results[ri])
+        for k in sorted(stats):
+            a = np.asarray(stats[k])
+            if a.dtype.kind in 'iu' and a.size:
+                stats[k] = flip_bit(a, bit=bit, index=idx)
+                break
+        else:
+            raise ValueError('corrupt injection found no integer '
+                             'stat array to flip')
+        out = list(results)
+        out[ri] = stats
+        return out
 
     def install(self) -> 'ChaosMonkey':
         if self._orig is not None:
@@ -173,6 +207,8 @@ class ChaosMonkey:
                 time.sleep(plan.hang_s)
             elif out == 'slow':
                 time.sleep(plan.slow_s)
+            elif out == 'corrupt':
+                return self._corrupt_results(orig(ex, key, batch, cfg))
             return orig(ex, key, batch, cfg)
 
         self._orig = orig

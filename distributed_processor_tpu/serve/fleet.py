@@ -79,7 +79,8 @@ class Fleet:
                  monitor_interval_s: float = 0.05,
                  ready_timeout_s: float = 300.0,
                  name: str = None, router_kwargs: dict = None,
-                 trace_sample: float = 0.0, slo_budgets: dict = None):
+                 trace_sample: float = 0.0, slo_budgets: dict = None,
+                 integrity: bool = False):
         if n_replicas < 1:
             raise ValueError('n_replicas must be >= 1')
         self.name = name or 'fleet'
@@ -107,6 +108,11 @@ class Fleet:
             self._service.setdefault('trace_sample', trace_sample)
         if slo_budgets:
             router_kwargs.setdefault('slo_budgets', dict(slo_budgets))
+        if integrity:
+            # end-to-end digests across the wire (docs/ROBUSTNESS.md
+            # "Integrity"): submit-time program CRC verified by the
+            # replica, replica-stamped result digest verified here
+            router_kwargs.setdefault('integrity', True)
         self.router = FleetRouter(name=self.name, **router_kwargs)
         self._lock = threading.Lock()
         self._closing = False

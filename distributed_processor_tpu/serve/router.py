@@ -53,6 +53,7 @@ import time
 
 import numpy as np
 
+from ..integrity import IntegrityError, program_digest, stats_digest
 from ..sim.interpreter import is_infrastructure_error
 from ..utils import profiling
 from ..obs import (ClockOffsetEstimator, FlightRecorder, Histogram,
@@ -108,7 +109,8 @@ class _FleetRequest:
 
 class _Replica:
     __slots__ = ('rid', 'client', 'breaker', 'alive', 'quarantined',
-                 'last_beat', 'digest', 'inflight', 'gossip_pending')
+                 'last_beat', 'digest', 'inflight', 'gossip_pending',
+                 'reconnect_t')
 
     def __init__(self, rid, client, breaker):
         self.rid = rid
@@ -120,6 +122,7 @@ class _Replica:
         self.digest = {}
         self.inflight = {}          # wire_id -> (_FleetRequest, token)
         self.gossip_pending = False
+        self.reconnect_t = 0.0      # last re-dial attempt (throttle)
 
     def routable(self) -> bool:
         return self.alive and not self.quarantined \
@@ -152,7 +155,8 @@ class FleetRouter:
                  name: str = None, flight_events: int = 512,
                  trace_sample: float = 0.0, trace_keep: int = 1024,
                  slo_budgets: dict = None,
-                 slo_min_samples: int = 16):
+                 slo_min_samples: int = 16,
+                 integrity: bool = False):
         if liveness_window_ms <= gossip_interval_ms:
             raise ValueError('liveness window must exceed the gossip '
                              'interval (one missed beat is not death)')
@@ -177,6 +181,12 @@ class FleetRouter:
         self._flight_cache: dict = {}   # rid -> last ring digest/pull
         self._slo_budgets = dict(slo_budgets or {})
         self._slo_min_samples = int(slo_min_samples)
+        # integrity fabric (docs/ROBUSTNESS.md "Integrity"): stamp a
+        # program content digest on every submit (the replica verifies
+        # it survived the pickle round trip) and verify the replica's
+        # result-stat digest on every reply — a mismatch becomes a
+        # retryable IntegrityError, never delivered bits
+        self._integrity = bool(integrity)
         self._slo_state: dict = {}      # stage -> currently-breached
         self._slo_last: dict = {}       # stage -> last evaluation
         self._slo_breaches = 0
@@ -297,6 +307,8 @@ class FleetRouter:
                        cfg=cfg if cfg is not None else self._default_cfg,
                        priority=priority, deadline_ms=deadline_ms,
                        fault_mode=fault_mode)
+        if self._integrity:
+            payload['_crc'] = program_digest(mp)
         return self._enqueue('submit', payload,
                              self._affinity_key(mp, payload['cfg']))
 
@@ -442,6 +454,26 @@ class FleetRouter:
             # when the router did not
             piggyback = payload['__trace__']
             payload = payload['result']
+        if ok and isinstance(payload, dict) and '__icrc__' in payload:
+            # replica-stamped result digest (innermost wrapper): a
+            # stat block that mutated anywhere between the replica's
+            # stamp and here fails verification and takes the
+            # cross-replica retry path instead of reaching the handle
+            want = payload['__icrc__']
+            payload = payload['result']
+            try:
+                good = stats_digest(payload) == want
+            except Exception:           # noqa: BLE001 - mangled stats
+                good = False
+            if not good:
+                profiling.counter_inc('integrity.wire_checksum_fail')
+                self.flight_recorder.record('integrity_violation',
+                                            rid=rid,
+                                            boundary='result-digest')
+                ok = False
+                payload = IntegrityError(
+                    f'result-stat digest mismatch from replica {rid}: '
+                    f'corrupted between replica stamp and router')
         with self._lock:
             if self._stale(freq, rid, token):
                 return
@@ -673,12 +705,40 @@ class FleetRouter:
                         self._on_gossip(rep.rid, ok, resp, t_send))
                 except ReplicaLostError:
                     rep.gossip_pending = False
+            self._reconnect_dead(time.monotonic())
             self._check_staleness(time.monotonic())
             self._check_slo()
             with self._cv:
                 if self._closing:
                     return
                 self._cv.wait(self._gossip_interval_s)
+
+    def _reconnect_dead(self, now: float) -> None:
+        """Re-dial replicas whose TCP connection died while the
+        process may have survived — e.g. a wire-corruption teardown
+        (:class:`~.transport.WireCorruptionError` resets the
+        connection by design) or a transient network blip.  Without
+        this, a surviving replica whose socket dropped would stay
+        delisted forever: the gossip revival path only helps replicas
+        whose connection still works.  Throttled per replica to the
+        liveness window; a process that is genuinely gone refuses the
+        dial (swallowed — the fleet monitor respawns it with a fresh
+        address and calls :meth:`add_replica` itself)."""
+        targets = []
+        with self._lock:
+            if self._closing:
+                return
+            for rep in self._replicas.values():
+                if rep.client is not None and not rep.client.alive \
+                        and now - rep.reconnect_t \
+                        >= self._liveness_window_s:
+                    rep.reconnect_t = now
+                    targets.append((rep.rid, rep.client.address))
+        for rid, address in targets:
+            try:
+                self.add_replica(rid, address)
+            except (OSError, ReplicaLostError):
+                pass
 
     def _on_gossip(self, rid, ok, resp, t_send: float = None) -> None:
         t_recv = time.monotonic()

@@ -66,6 +66,7 @@ import jax
 
 from .. import isa
 from ..decoder import machine_program_from_cmds, stack_machine_programs
+from ..integrity import IntegrityError, diff_stats
 from ..obs import FlightRecorder, Histogram, Tracer, write_chrome_trace
 from ..sim.interpreter import (ENGINES, InterpreterConfig, FaultError,
                                aot_batch_cached, aot_compile_batch,
@@ -92,6 +93,7 @@ SUPERVISE_THREAD_PREFIX = 'dproc-serve-supervise'
 CANARY_THREAD_PREFIX = 'dproc-serve-canary'
 COMPILE_THREAD_PREFIX = 'dproc-serve-compile'
 WARMUP_THREAD_PREFIX = 'dproc-serve-warmup'
+SCRUB_THREAD_PREFIX = 'dproc-serve-scrub'
 
 _SERVICE_SEQ = itertools.count()
 
@@ -206,6 +208,11 @@ class _DeviceExecutor:
         self.canary_ok = 0
         self.canary_fail = 0
         self.canary_thread = None
+        # integrity fabric (docs/ROBUSTNESS.md "Integrity"): the last
+        # audit's verdict (edge-triggers the integrity_violation
+        # flight event) and the scrubber's consecutive-failure count
+        self.integrity_bad = False
+        self.scrub_fails = 0
         self.dispatches = 0
         self.programs_dispatched = 0
         self.occupancy = collections.Counter()          # batch size -> n
@@ -347,6 +354,20 @@ class ExecutionService:
     supervisor-detected executor deaths/hangs when ``flight_dump_dir``
     (or ``$DPROC_FLIGHT_DIR``) is set, and on demand via
     :meth:`dump_flight`.
+
+    **Integrity fabric** (docs/ROBUSTNESS.md "Integrity"; all off by
+    default, zero-cost on the hot path).  ``audit_sample=1/N``
+    re-executes every Nth completed batch on a different engine (and
+    different device when the pool has one) before delivery and
+    bit-compares per stat, fault words included — a confirmed mismatch
+    records an edge-triggered ``integrity_violation`` flight event and,
+    under ``audit_mode='strict'``, fails the batch with a typed
+    :class:`~..integrity.IntegrityError` (infrastructure-class: it
+    retries, feeds the breaker, and never surfaces tainted bits).
+    ``scrub_interval_s`` starts a background scrubber that replays the
+    golden canary program per idle executor and routes
+    ``breaker_threshold`` consecutive mismatches into the standard
+    quarantine -> canary re-admission lifecycle.
     """
 
     def __init__(self, cfg: InterpreterConfig = None, *,
@@ -368,7 +389,10 @@ class ExecutionService:
                  catalog_max_age_runs: int = 32,
                  trace_sample: float = 0.0, trace_keep: int = 1024,
                  flight_events: int = 512,
-                 flight_dump_dir: str = None):
+                 flight_dump_dir: str = None,
+                 audit_sample: float = 0.0,
+                 audit_mode: str = 'flag',
+                 scrub_interval_s: float = None):
         if max_batch_programs < 1:
             raise ValueError('max_batch_programs must be >= 1')
         if max_queue < 1:
@@ -411,6 +435,14 @@ class ExecutionService:
             raise ValueError('max_est_wait_ms must be positive or None')
         if trace_sample < 0 or trace_sample > 1:
             raise ValueError('trace_sample must be in [0, 1]')
+        if audit_sample < 0 or audit_sample > 1:
+            raise ValueError('audit_sample must be in [0, 1]')
+        if audit_mode not in ('flag', 'strict'):
+            raise ValueError("audit_mode must be 'flag' or 'strict'; "
+                             f'got {audit_mode!r}')
+        if scrub_interval_s is not None and scrub_interval_s <= 0:
+            raise ValueError('scrub_interval_s must be positive or '
+                             'None')
         # observability: per-request tracing (sampled) + flight
         # recorder — created before the executors so the first
         # dispatch can already emit into them
@@ -485,6 +517,24 @@ class ExecutionService:
         self._ewma_prog_s = None
         self._canary_mp = None         # lazily-built tiny probe program
         self._canary_ref = None        # first canary result: bit reference
+        # -- integrity fabric (docs/ROBUSTNESS.md "Integrity") -----------
+        # audit_sample=1/N re-executes every Nth completed batch on a
+        # different engine (and device when the pool has one) before
+        # delivery; the scrubber replays the canary program per
+        # executor on an idle cadence.  Both feed the breaker /
+        # quarantine machinery; all counters under _cv's lock.
+        self._audit_sample = float(audit_sample)
+        self._audit_every = 0 if audit_sample <= 0 \
+            else max(1, round(1.0 / audit_sample))
+        self._audit_mode = audit_mode
+        self._audit_tick = 0
+        self._audits = 0
+        self._audit_mismatches = 0
+        self._scrub_interval_s = scrub_interval_s
+        self._scrubber_runs = 0
+        self._scrubber_fail = 0
+        self._integrity_quarantines = 0
+        self._breaker_threshold = max(int(breaker_threshold), 1)
         # -- compile front door (guarded by _cv's lock where noted) ------
         if compile_workers < 1:
             raise ValueError('compile_workers must be >= 1')
@@ -527,6 +577,13 @@ class ExecutionService:
                 name=f'{SUPERVISE_THREAD_PREFIX}-{self.name}',
                 daemon=True)
             self._supervisor.start()
+        self._scrubber = None
+        if scrub_interval_s is not None:
+            self._scrubber = threading.Thread(
+                target=self._scrub_loop,
+                name=f'{SCRUB_THREAD_PREFIX}-{self.name}',
+                daemon=True)
+            self._scrubber.start()
 
     # -- submission ------------------------------------------------------
 
@@ -1155,6 +1212,69 @@ class ExecutionService:
                 ex.breaker.trip(now)
             self._cv.notify_all()
 
+    # -- background scrubber (docs/ROBUSTNESS.md "Integrity") ------------
+
+    def _scrub_loop(self):
+        """The scrubber thread: every ``scrub_interval_s`` it replays
+        the golden canary program on each idle live executor and
+        bit-compares against the pool-wide canary reference.  A
+        device that has started corrupting fails ``breaker_threshold``
+        consecutive scrubs and goes through the standard
+        quarantine -> canary re-admission lifecycle — benched by the
+        same machinery that benches a crashing one, without waiting
+        for tenant traffic to trip an audit."""
+        while True:
+            with self._cv:
+                if self._closing:
+                    return
+                self._cv.wait(self._scrub_interval_s)
+                if self._closing:
+                    return
+                idle = [ex for ex in self._executors
+                        if ex.health == HEALTH_LIVE and not ex.busy]
+            for ex in idle:
+                self._scrub_one(ex)
+
+    def _scrub_one(self, ex: _DeviceExecutor):
+        with self._cv:
+            if self._closing or ex.health != HEALTH_LIVE or ex.busy:
+                return
+            self._scrubber_runs += 1
+        profiling.counter_inc('integrity.scrubber_runs')
+        ok = False
+        try:
+            key, batch, ncfg = self._canary_work()
+            # through _run_batch, so chaos injection (including
+            # 'corrupt') exercises the scrubber exactly like traffic
+            out = self._run_batch(ex, key, batch, ncfg)[0]
+            ref = {k: np.asarray(v) for k, v in out.items()}
+            with self._cv:
+                if self._canary_ref is None:
+                    clean = not np.asarray(ref.get('fault', 0)).any()
+                    if clean:
+                        self._canary_ref = ref
+                    ok = clean
+                else:
+                    ok = not diff_stats(ref, self._canary_ref)
+        except BaseException:   # noqa: BLE001 - injected faults included
+            ok = False
+        now = time.monotonic()
+        with self._cv:
+            if ok:
+                ex.scrub_fails = 0
+                return
+            ex.scrub_fails += 1
+            self._scrubber_fail += 1
+            self.flight_recorder.record('scrubber_fail',
+                                        executor=ex.label(),
+                                        consecutive=ex.scrub_fails)
+            if ex.scrub_fails >= self._breaker_threshold \
+                    and ex.health == HEALTH_LIVE and self._supervision:
+                self._integrity_quarantines += 1
+                profiling.counter_inc('integrity.quarantines')
+                ex.scrub_fails = 0
+                self._quarantine_locked(ex, now)
+
     # -- dispatcher ------------------------------------------------------
 
     def _dispatch_loop(self, ex: _DeviceExecutor):
@@ -1288,6 +1408,19 @@ class ExecutionService:
         except Exception as exc:      # noqa: BLE001 - fail the batch, live on
             self._on_batch_failure(ex, key, batch, exc)
             return
+        if self._audit_every:
+            with self._cv:
+                self._audit_tick += 1
+                do_audit = self._audit_tick % self._audit_every == 0
+            if do_audit:
+                bad = self._audit_batch(ex, key, batch, cfg, results)
+                if bad is not None:
+                    # strict policy: the tainted bits never reach a
+                    # handle — the batch takes the infrastructure
+                    # retry path (fresh execution re-derives the
+                    # truth) and the breaker hears about it
+                    self._on_batch_failure(ex, key, batch, bad)
+                    return
         t_run = time.monotonic()
         completed = failed = 0
         for req, res in zip(batch, results):
@@ -1367,6 +1500,9 @@ class ExecutionService:
             tripped = ex.breaker.record_failure()
             if tripped and ex.health == HEALTH_LIVE \
                     and self._supervision:
+                if isinstance(exc, IntegrityError):
+                    self._integrity_quarantines += 1
+                    profiling.counter_inc('integrity.quarantines')
                 self._quarantine_locked(ex, now)
             self._retry_batch_locked(key, batch, exc, now)
             self._cv.notify_all()
@@ -1407,6 +1543,99 @@ class ExecutionService:
                                 error=type(exc).__name__)
                     ctx.instant('park', reason='retry-backoff')
                 self._parked.append((now + delay, key, req))
+
+    # -- differential audit (docs/ROBUSTNESS.md "Integrity") -------------
+
+    def _audit_engine(self, mp, cfg, served: str) -> str:
+        """The audit rung: the first engine of the CPU-safe ladder
+        subset that is not the one that served and accepts this
+        program — a differential re-execution is only evidence when
+        the second opinion goes through an independent code path."""
+        for eng in ('block', 'straightline', 'generic'):
+            if eng == served:
+                continue
+            try:
+                resolve_engine(mp, replace(cfg, engine=eng))
+                return eng
+            except ValueError:
+                continue
+        return 'generic'
+
+    def _audit_batch(self, ex: _DeviceExecutor, key, batch, cfg,
+                     results):
+        """Re-execute every request of a completed batch on a
+        DIFFERENT engine (and a different live device when the pool
+        has one) and bit-compare per stat, fault words included.
+
+        Timing-dependent fault codes (budget exhaustion, deadlock,
+        starvation) legitimately differ across engines, so a
+        cross-engine disagreement alone is not corruption: it
+        escalates to a confirm re-run under the exact served
+        configuration, and only a confirmed mismatch counts — a real
+        bit flip disagrees with ANY correct re-execution, so
+        detection survives the escalation while legitimate engine
+        divergence never cries wolf.  Audit-internal failures are
+        inconclusive and never punish the batch.
+
+        Returns the :class:`IntegrityError` to fail the batch with
+        under ``audit_mode='strict'``, else None (flag mode records
+        the violation and lets delivery proceed)."""
+        with self._cv:
+            self._audits += 1
+            alts = [v.device for v in self._executors
+                    if v is not ex and v.health == HEALTH_LIVE]
+        profiling.counter_inc('integrity.audits')
+        alt_dev = alts[0] if alts else ex.device
+        singleton = len(batch) == 1 and self.singleton_engine is not None
+        bad = []
+        for req, res in zip(batch, results):
+            try:
+                want = {k: np.asarray(v) for k, v in res.items()}
+                if singleton:
+                    scfg = replace(cfg, engine=self.singleton_engine)
+                    served = resolve_engine(req.mp, scfg)
+                else:
+                    # the multi path is the generic engine; the solo
+                    # generic run is its documented bit-identical
+                    # equivalent (padding is inert, demux trims it)
+                    scfg = replace(cfg, engine='generic')
+                    served = 'generic'
+                alt = self._audit_engine(req.mp, cfg, served)
+                got = jax.tree.map(np.asarray, simulate_batch(
+                    req.mp, req.meas_bits, req.init_regs,
+                    cfg=replace(cfg, engine=alt),
+                    jax_device=alt_dev))
+                keys = diff_stats(got, want)
+                if keys and alt != served:
+                    got = jax.tree.map(np.asarray, simulate_batch(
+                        req.mp, req.meas_bits, req.init_regs,
+                        cfg=scfg, jax_device=alt_dev))
+                    keys = diff_stats(got, want)
+                if keys:
+                    bad.append((req.seq, keys))
+            except Exception:   # noqa: BLE001 - inconclusive audit
+                continue
+        with self._cv:
+            self._audit_mismatches += len(bad)
+            was_bad = ex.integrity_bad
+            ex.integrity_bad = bool(bad)
+        if not bad:
+            return None
+        profiling.counter_inc('integrity.mismatches', len(bad))
+        if not was_bad:
+            # edge-triggered: a persistently-corrupting executor logs
+            # one violation event, not one per audited batch
+            self.flight_recorder.record(
+                'integrity_violation', executor=ex.label(),
+                mode=self._audit_mode, n=len(bad),
+                stats=sorted({k for _, keys in bad for k in keys}))
+        if self._audit_mode != 'strict':
+            return None
+        seqs = [seq for seq, _ in bad]
+        return IntegrityError(
+            f'audit mismatch on executor {ex.label()}: requests '
+            f'{seqs} disagree with differential re-execution '
+            f'(silent data corruption)')
 
     def _run_batch(self, ex: _DeviceExecutor, key, batch, cfg):
         """Execute one coalesced batch on ``ex``'s device; returns
@@ -1689,6 +1918,7 @@ class ExecutionService:
                 'respawns': ex.respawns,
                 'canary_ok': ex.canary_ok,
                 'canary_fail': ex.canary_fail,
+                'integrity_bad': ex.integrity_bad,
             } for ex in self._executors]
             health = collections.Counter(
                 ex.health for ex in self._executors)
@@ -1735,6 +1965,15 @@ class ExecutionService:
                 'hangs': self._hangs,
                 'canary': {'ok': self._canary_ok,
                            'fail': self._canary_fail},
+                'integrity': {
+                    'audit_sample': self._audit_sample,
+                    'audit_mode': self._audit_mode,
+                    'audits': self._audits,
+                    'mismatches': self._audit_mismatches,
+                    'scrubber_runs': self._scrubber_runs,
+                    'scrubber_fail': self._scrubber_fail,
+                    'quarantines': self._integrity_quarantines,
+                },
                 'est_wait_ms': None if est_s is None
                 else float(est_s * 1e3),
                 'compile': {
@@ -1879,6 +2118,10 @@ class ExecutionService:
                 self._stop_supervisor = True
                 self._cv.notify_all()
             self._supervisor.join(timeout)
+        if self._scrubber is not None:
+            # the scrub loop observes _closing (set above, cv
+            # notified) both before and after its interval wait
+            self._scrubber.join(timeout)
         for ex in self._executors:
             t = ex.canary_thread
             if t is not None:

@@ -35,6 +35,19 @@ returns the replica's whole metrics-registry snapshot for labeled
 re-exposition; ``flight`` returns the full flight ring for the
 federated post-mortem pull.
 
+Every frame carries a CRC32 content checksum in its header
+(docs/ROBUSTNESS.md "Integrity"): the sender digests the pickle bytes,
+the receiver verifies before unpickling, and a mismatch — or a
+declared length past the wire bound, or a payload that truncates
+mid-read — raises :class:`WireCorruptionError` and tears the
+connection down.  A garbled frame therefore becomes a typed,
+connection-scoped event the fleet retry machinery recovers from
+(:class:`ReplicaLostError` -> re-dispatch; the router re-dials torn
+connections on the gossip cadence), never a hang and never a
+silently-wrong unpickle.  The check is a single C-speed pass over
+bytes already in hand — negligible next to the pickle itself — so it
+is always on.
+
 Server side, submissions are enqueued into the service from the
 connection's reader thread (``ExecutionService.submit`` never blocks on
 execution) and a small waiter pool sends each response when its handle
@@ -57,11 +70,14 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from concurrent.futures import ThreadPoolExecutor
+
+from ..utils import profiling
 
 WIRE_THREAD_PREFIX = 'dproc-serve-wire'
 
-_LEN = struct.Struct('>I')
+_HDR = struct.Struct('>II')   # (payload length, payload CRC32)
 _MAX_FRAME = 1 << 29          # 512 MiB: desync/corruption guard
 
 OPS = ('submit', 'submit_source', 'stats', 'ping', 'gossip',
@@ -77,22 +93,63 @@ class ReplicaLostError(RuntimeError):
     crash."""
 
 
+class WireCorruptionError(ConnectionError):
+    """A frame failed its integrity checks: header CRC32 mismatch or a
+    declared length past the wire bound.  A ConnectionError subclass
+    on purpose — every existing teardown path (server per-connection
+    loop, client reader loop) already treats ConnectionError as
+    "this connection is no longer trustworthy", which is exactly the
+    right response to corruption: reset, re-dial, retry; NEVER unpickle
+    the garbled bytes."""
+
+
+# test/chaos hook (docs/ROBUSTNESS.md "Integrity"): a callable
+# ``bytes -> bytes`` applied to every received payload BEFORE the CRC
+# check, simulating corruption on the wire so detection — not
+# injection — is what gets exercised.  Process-global by design: the
+# chaos driver corrupts every connection the process reads.
+_wire_corruptor = None
+
+
+def install_wire_corruptor(fn):
+    """Install (or with None, remove) the receive-path corruptor;
+    returns the previous hook so tests can restore it."""
+    global _wire_corruptor
+    prev = _wire_corruptor
+    _wire_corruptor = fn
+    return prev
+
+
 def send_frame(sock: socket.socket, obj, lock: threading.Lock) -> None:
-    """Pickle ``obj`` and write one length-prefixed frame.  ``lock``
-    serializes concurrent writers (responses from the waiter pool
-    interleave with reader-thread error replies)."""
+    """Pickle ``obj`` and write one CRC-stamped length-prefixed frame.
+    ``lock`` serializes concurrent writers (responses from the waiter
+    pool interleave with reader-thread error replies)."""
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     with lock:
-        sock.sendall(_LEN.pack(len(data)) + data)
+        sock.sendall(_HDR.pack(len(data), zlib.crc32(data)) + data)
 
 
 def recv_frame(sock: socket.socket):
-    """Read one frame; raises ConnectionError on EOF/desync."""
-    head = _recv_exact(sock, _LEN.size)
-    (n,) = _LEN.unpack(head)
+    """Read and verify one frame; raises :class:`WireCorruptionError`
+    on an oversized declared length or a CRC mismatch, plain
+    ConnectionError on EOF / mid-frame truncation.  The payload is
+    only unpickled after its checksum passes."""
+    head = _recv_exact(sock, _HDR.size)
+    n, crc = _HDR.unpack(head)
     if n > _MAX_FRAME:
-        raise ConnectionError(f'frame of {n} bytes exceeds wire bound')
-    return pickle.loads(_recv_exact(sock, n))
+        profiling.counter_inc('integrity.wire_checksum_fail')
+        raise WireCorruptionError(
+            f'frame of {n} bytes exceeds wire bound '
+            f'({_MAX_FRAME}): header corrupt or stream desynced')
+    data = _recv_exact(sock, n)
+    if _wire_corruptor is not None:
+        data = _wire_corruptor(data)
+    if zlib.crc32(data) != crc:
+        profiling.counter_inc('integrity.wire_checksum_fail')
+        raise WireCorruptionError(
+            f'frame CRC mismatch ({n} bytes): payload corrupted on '
+            f'the wire')
+    return pickle.loads(data)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -189,6 +246,26 @@ class ReplicaServer:
                 # request: open a forced replica-side context so the
                 # spans recorded here ship back on the resolve reply
                 trace_id = payload.pop('_trace', None)
+                # `_crc` = the router's submit-time program digest
+                # (docs/ROBUSTNESS.md "Integrity"): verify the decoded
+                # program content-matches what the caller submitted —
+                # a frame CRC covers the wire, this covers the
+                # pickle/unpickle round trip and anything between
+                # digest and send.  Its presence also asks for a
+                # result-stat digest on the resolve reply.
+                want_crc = payload.pop('_crc', None)
+                if want_crc is not None \
+                        and payload.get('mp') is not None:
+                    from ..integrity import (IntegrityError,
+                                             program_digest)
+                    got_crc = program_digest(payload['mp'])
+                    if got_crc != want_crc:
+                        profiling.counter_inc(
+                            'integrity.wire_checksum_fail')
+                        raise IntegrityError(
+                            f'submitted program digest mismatch '
+                            f'(want {want_crc:#010x}, decoded '
+                            f'{got_crc:#010x}): corrupted in transit')
                 kw = dict(payload)
                 if trace_id is not None:
                     kw['_handle'] = self._svc.traced_handle(
@@ -196,7 +273,8 @@ class ReplicaServer:
                 handle = self._svc.submit(**kw) if op == 'submit' \
                     else self._svc.submit_source(**kw)
                 self._pool.submit(self._send_on_resolve, conn, wlock,
-                                  req_id, handle, t_recv)
+                                  req_id, handle, t_recv,
+                                  want_crc is not None)
                 return
             if op == 'stats':
                 self._reply(conn, wlock, req_id, True,
@@ -222,7 +300,6 @@ class ReplicaServer:
                 })
                 return
             if op == 'fleet-metrics':
-                from ..utils import profiling
                 self._reply(conn, wlock, req_id, True, {
                     'mono': time.monotonic(),
                     'metrics': profiling.registry().snapshot()})
@@ -243,7 +320,8 @@ class ReplicaServer:
                         _picklable_error(exc))
 
     def _send_on_resolve(self, conn, wlock, req_id, handle,
-                         t_recv: float = None) -> None:
+                         t_recv: float = None,
+                         want_digest: bool = False) -> None:
         # blocks until the service resolves the handle: shutdown
         # force-fails every unresolved handle, so this always returns
         try:
@@ -253,6 +331,15 @@ class ReplicaServer:
         try:
             if exc is None:
                 result = handle.result()
+                if want_digest:
+                    # stamp the result-stat digest before any other
+                    # wrapping (innermost: the router unwraps the
+                    # trace envelope first, then verifies this) so the
+                    # digest covers exactly the stat block the tenant
+                    # would receive
+                    from ..integrity import stats_digest
+                    result = {'__icrc__': stats_digest(result),
+                              'result': result}
                 if handle._trace is not None:
                     # piggyback the replica-side spans (replica-clock
                     # times; the two mono stamps bound the server-side

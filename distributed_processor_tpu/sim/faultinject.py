@@ -459,6 +459,52 @@ def check_fused_consistency(seed: int = 0, n: int = 40,
     return {'checked': checked, 'skipped': skipped, 'failures': failures}
 
 
+def check_audit_consistency(seed: int = 0, n: int = 24,
+                            shots: int = 4) -> dict:
+    """Serve the mutant corpus with ``audit_sample=1`` and count
+    false-positive integrity violations (docs/ROBUSTNESS.md
+    "Integrity": the auditor must never cry wolf on legitimately
+    identical engines).
+
+    Every valid mutant — including ones that trap, where
+    timing-dependent fault codes legitimately differ across engines —
+    goes through an :class:`~..serve.ExecutionService` whose audit
+    sampler re-executes each completed batch on a different engine and
+    escalates cross-engine disagreement to a served-configuration
+    confirm run.  With no corruption injected, ``false_positives``
+    (the service's confirmed-mismatch count) must be 0.  Mutants the
+    decoder/validator reject are skipped (they never reach dispatch).
+    Returns ``{'checked', 'skipped', 'audits', 'false_positives'}``.
+    """
+    from ..serve import ExecutionService
+    checked = skipped = 0
+    with ExecutionService(None, max_batch_programs=4,
+                          audit_sample=1.0, audit_mode='flag') as svc:
+        handles = []
+        for m in gen_mutants(seed, n):
+            try:
+                mp = machine_program_from_cmds(m.cmds)
+                validate_program(mp, m.cfg)
+            except (ValueError, OverflowError, ProgramValidationError):
+                skipped += 1
+                continue
+            cfg = replace(m.cfg, engine=None, straightline=False,
+                          fault_mode='count', opcode_histogram=False)
+            mb = np.zeros((shots, mp.n_cores, cfg.max_meas), np.int32)
+            try:
+                handles.append(svc.submit(mp, mb, cfg=cfg))
+            except ValueError:
+                skipped += 1     # cfg the serve path refuses typed
+                continue
+            checked += 1
+        for h in handles:
+            h.result(timeout=300)
+        st = svc.stats()['integrity']
+    return {'checked': checked, 'skipped': skipped,
+            'audits': st['audits'],
+            'false_positives': st['mismatches']}
+
+
 @dataclass
 class FuzzReport:
     n: int = 0

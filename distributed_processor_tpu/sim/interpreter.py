@@ -152,6 +152,12 @@ def is_infrastructure_error(exc: BaseException) -> bool:
     pure waste and can mask real bugs, so these always propagate to
     the caller on the first attempt (docs/ROBUSTNESS.md
     "serving-layer failures").
+
+    :class:`~..integrity.IntegrityError` (detected silent data
+    corruption — docs/ROBUSTNESS.md "Integrity") is a plain
+    RuntimeError and therefore infrastructure-class BY DESIGN: a
+    re-execution on a different engine/device/replica re-derives the
+    correct bits, which is exactly what the retry machinery does.
     """
     if isinstance(exc, (FaultError, ValueError, TypeError, KeyError,
                         IndexError, AssertionError,

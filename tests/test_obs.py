@@ -435,7 +435,7 @@ _STATS_KEYS = {
     'shed', 'overload_rejected', 'breaker_trips', 'readmissions',
     'executor_deaths', 'hangs', 'canary', 'est_wait_ms', 'compile',
     'source', 'devices', 'compile_cache', 'latency_p50_ms',
-    'latency_p99_ms', 'latency_samples',
+    'latency_p99_ms', 'latency_samples', 'integrity',
 }
 _WARMUP_KEYS = {'aot_compiled', 'replayed', 'in_progress'}
 _HEALTH_KEYS = {'live', 'quarantined', 'probing'}
@@ -448,8 +448,11 @@ _DEVICE_KEYS = {
     'steals', 'stolen_from', 'cold_compiles', 'warm_hits',
     'home_buckets', 'breaker_trips', 'consecutive_failures',
     'readmissions', 'hangs', 'deaths', 'respawns', 'canary_ok',
-    'canary_fail',
+    'canary_fail', 'integrity_bad',
 }
+_INTEGRITY_KEYS = {'audit_sample', 'audit_mode', 'audits',
+                   'mismatches', 'scrubber_runs', 'scrubber_fail',
+                   'quarantines'}
 # serve.* counters the service maintains in the global registry
 _SERVE_COUNTERS = {
     'serve.submitted', 'serve.dispatches',
@@ -472,6 +475,7 @@ def test_stats_key_manifest_is_byte_compatible():
     assert set(snap['canary']) == _CANARY_KEYS
     assert set(snap['compile']) == _COMPILE_KEYS
     assert set(snap['source']) == _SOURCE_KEYS
+    assert set(snap['integrity']) == _INTEGRITY_KEYS
     for dev in snap['devices']:
         assert set(dev) == _DEVICE_KEYS
     for label, row in snap['compile']['per_bucket'].items():

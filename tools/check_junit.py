@@ -54,6 +54,12 @@ NO_SKIP_MODULES = {
         'dependency — a skip means the replica-loss contract '
         '(docs/FLEET.md: failover bit-identity, gossip staleness, '
         'warm respawn) stopped being exercised',
+    'test_integrity':
+        'integrity-fabric tests (digests, wire checksums, audit '
+        'sampler, scrubber quarantine) run on pure CPU + localhost '
+        'sockets with no hardware dependency — a skip means the '
+        'silent-data-corruption contract (docs/ROBUSTNESS.md '
+        '"Integrity") stopped being exercised',
     'test_fleet_obs':
         'fleet observability tests (trace stitching, clock-offset '
         'alignment, merged metrics, federated flight recorder) run on '

@@ -7,9 +7,11 @@ budgets — see ``sim/faultinject.py``) and asserts every mutant is
 rejected at decode, rejected by the static validator, trapped with a
 correct ``fault_shots`` code by every engine that runs it, or provably
 benign.  Also cross-checks the vmapped multi-program executable and
-the dp=2 mesh-sharded sweep against per-program runs, and the fused
+the dp=2 mesh-sharded sweep against per-program runs, the fused
 measure-in-megastep engine against the generic engine on
-physics-closed (sigma=0) runs for timing-independent fault codes.
+physics-closed (sigma=0) runs for timing-independent fault codes, and
+the serve-tier differential auditor (``audit_sample=1``) for
+false-positive integrity violations across engine pairs.
 
 Deterministic in ``--seed``: a failing case name (``base+mutator#k``)
 reproduces exactly.  Exit nonzero on any failure — wired into the
@@ -90,6 +92,15 @@ def main(argv=None) -> int:
         else:
             print(f'mesh cross-check: {bad} fault-stat mismatches')
             failed |= bad != 0
+
+    # serve the corpus under audit_sample=1: the differential auditor
+    # must never flag legitimately identical engines as corruption
+    ar = fi.check_audit_consistency(seed=args.seed,
+                                    n=16 if args.quick else 48)
+    print(f'audit cross-check: {ar["checked"]} served, '
+          f'{ar["skipped"]} skipped, {ar["audits"]} audits, '
+          f'{ar["false_positives"]} false positives')
+    failed |= ar['false_positives'] != 0
 
     print('faultfuzz ' + ('FAILED' if failed else 'OK'))
     return 1 if failed else 0

@@ -29,6 +29,17 @@ window, SIGCONT it back into re-admission.  Same contract, plus:
 goodput must stay positive inside the kill window.
 
     python tools/servechaos.py --fleet 2 --quick
+
+``--corrupt`` turns the soak into a silent-data-corruption drill
+(docs/ROBUSTNESS.md "Integrity"): single mode flips one bit in
+completed result stats (``ChaosPlan.p_corrupt``) under a strict
+``audit_sample=1`` differential auditor; fleet mode flips one bit in
+received wire frames against the frame CRCs and end-to-end digests.
+Pass bar either way: every flip detected-and-typed or
+retried-to-correct — zero silently-wrong bits, zero hangs.
+
+    python tools/servechaos.py --corrupt --quick
+    python tools/servechaos.py --corrupt --fleet 2 --quick
 """
 
 import argparse
@@ -70,6 +81,22 @@ def main(argv=None) -> int:
     ap.add_argument('--p-hang', type=float, default=0.03)
     ap.add_argument('--p-slow', type=float, default=0.10)
     ap.add_argument('--p-die', type=float, default=0.02)
+    ap.add_argument('--corrupt', action='store_true',
+                    help='silent-data-corruption soak: inject bit '
+                         'flips into completed result stats (single '
+                         'mode, via ChaosMonkey p_corrupt + a strict '
+                         'audit_sample=1 auditor) or into received '
+                         'wire frames (--fleet mode, via the '
+                         'transport corruptor hook + frame CRCs); '
+                         'every flip must be detected-and-typed or '
+                         'retried-to-correct — zero silently-wrong '
+                         'bits (docs/ROBUSTNESS.md "Integrity")')
+    ap.add_argument('--p-corrupt', type=float, default=0.25,
+                    help='per-batch result corruption probability '
+                         'under --corrupt (single mode)')
+    ap.add_argument('--min-corrupt', type=int, default=None,
+                    help='fail unless at least this many corruptions '
+                         'were injected (default: scaled to -n)')
     ap.add_argument('--hang-s', type=float, default=1.0,
                     help='injected hang duration (past the watchdog)')
     ap.add_argument('--json', action='store_true',
@@ -107,11 +134,17 @@ def main(argv=None) -> int:
     n = args.n if args.n is not None else (60 if args.quick else 200)
     p_crash = args.p_crash * (0.5 if args.quick else 1.0)
     p_die = args.p_die * (0.5 if args.quick else 1.0)
+    p_corrupt = args.p_corrupt if args.corrupt else 0.0
     mps, _bits, cfg = _workload(min(n, 12), args.qubits, args.depth,
                                 args.shots, args.seed)
     plan = ChaosPlan(seed=args.seed, p_crash=p_crash, p_hang=args.p_hang,
                      p_slow=args.p_slow, p_die=p_die,
+                     p_corrupt=p_corrupt,
                      hang_s=args.hang_s, slow_s=0.01)
+    # under --corrupt the auditor IS the detector: audit every batch,
+    # strict mode so tainted bits are failed-and-retried, never served
+    integrity_kwargs = dict(audit_sample=1.0, audit_mode='strict') \
+        if args.corrupt else {}
     t0 = time.monotonic()
     with ExecutionService(
             cfg, max_batch_programs=4, max_wait_ms=5.0,
@@ -121,7 +154,7 @@ def main(argv=None) -> int:
             breaker_cooldown_ms=100.0,
             supervise_interval_ms=10.0,
             trace_sample=1.0 if args.trace_out else 0.0,
-            trace_keep=4 * n) as svc:
+            trace_keep=4 * n, **integrity_kwargs) as svc:
         with ChaosMonkey(svc, plan) as monkey:
             report = soak(svc, mps, cfg, n_requests=n,
                           shots=args.shots, seed=args.seed,
@@ -151,6 +184,7 @@ def main(argv=None) -> int:
         'readmissions': stats['readmissions'],
         'hangs_detected': stats['hangs'],
         'executor_deaths': stats['executor_deaths'],
+        'integrity': stats['integrity'],
         'wall_s': round(wall_s, 3),
         # the incident timeline: what the chaos actually did, in order
         # (docs/OBSERVABILITY.md "flight recorder")
@@ -170,6 +204,17 @@ def main(argv=None) -> int:
     if report.terminated() != report.submitted:
         failures.append(f'{report.submitted - report.terminated()} '
                         f'handle(s) neither completed nor typed-failed')
+    if args.corrupt:
+        n_corrupt = int(out['injected'].get('corrupt', 0))
+        min_corrupt = args.min_corrupt if args.min_corrupt is not None \
+            else (8 if args.quick else 25)
+        if n_corrupt < min_corrupt:
+            failures.append(f'only {n_corrupt} corruption(s) injected '
+                            f'(need >= {min_corrupt}): the soak did '
+                            f'not exercise the auditor')
+        if n_corrupt and not stats['integrity']['mismatches']:
+            failures.append(f'{n_corrupt} corruption(s) injected but '
+                            f'the auditor flagged ZERO mismatches')
     out['ok'] = not failures
     if args.json:
         print(json.dumps(out, indent=2))
@@ -202,6 +247,9 @@ def _fleet_mode(args) -> int:
     with Fleet(
             n_rep,
             interp_cfg=None,
+            # --corrupt: program digests ride submits, result-stat
+            # digests ride responses (docs/ROBUSTNESS.md "Integrity")
+            integrity=args.corrupt,
             service={'max_batch_programs': 4, 'max_wait_ms': 5.0,
                      'max_queue': 4 * n,
                      'max_est_wait_ms': 10000.0},
@@ -227,10 +275,38 @@ def _fleet_mode(args) -> int:
                 rid, 'submit',
                 dict(mp=mps[0], meas_bits=_bits[0], cfg=cfg),
                 timeout_s=600.0)
-        report = fleet_soak(fleet, mps, cfg, n_requests=n,
-                            shots=args.shots, seed=args.seed,
-                            rate_hz=args.rate_hz, actions=actions,
-                            result_timeout_s=180.0)
+        # --corrupt: flip one bit in ~every 30th frame THIS process
+        # receives (result frames and gossip pulls alike), after the
+        # replica stamped its CRC — so what is under test is detection
+        # and recovery (frame reset, gossip-cadence re-dial, cross-
+        # replica retry), not the injection itself.  Installed after
+        # warmup and removed before the post-mortem pulls.
+        wire_injected = [0]
+        prev_hook = None
+        if args.corrupt:
+            from distributed_processor_tpu.integrity import \
+                flip_payload_bit
+            from distributed_processor_tpu.serve import transport
+            seen = [0]
+
+            def _corruptor(data):
+                seen[0] += 1
+                if seen[0] % 30 == 0 and data:
+                    wire_injected[0] += 1
+                    return flip_payload_bit(
+                        data, bit_index=(7 * seen[0]) % (len(data) * 8))
+                return data
+
+            prev_hook = transport.install_wire_corruptor(_corruptor)
+        try:
+            report = fleet_soak(fleet, mps, cfg, n_requests=n,
+                                shots=args.shots, seed=args.seed,
+                                rate_hz=args.rate_hz, actions=actions,
+                                result_timeout_s=180.0)
+        finally:
+            if args.corrupt:
+                from distributed_processor_tpu.serve import transport
+                transport.install_wire_corruptor(prev_hook)
         stats = fleet.stats()
         # federated post-mortem: the router's ring + every replica's
         # (live-pulled where reachable, last gossiped digest where
@@ -268,6 +344,7 @@ def _fleet_mode(args) -> int:
         'respawns': {r: p['respawns']
                      for r, p in stats['processes'].items()},
         'slo_breaches': stats.get('slo_breaches', 0),
+        'wire_corruptions_injected': wire_injected[0],
         'wall_s': round(wall_s, 3),
         'trace_events': trace_events,
         # federated incident timeline summary (--flight-out carries
@@ -292,6 +369,14 @@ def _fleet_mode(args) -> int:
                         f'handle(s) neither completed nor typed-failed')
     if ok_in_kill == 0:
         failures.append('goodput hit ZERO inside the kill window')
+    if args.corrupt:
+        min_corrupt = args.min_corrupt if args.min_corrupt is not None \
+            else (4 if args.quick else 10)
+        if wire_injected[0] < min_corrupt:
+            failures.append(f'only {wire_injected[0]} wire '
+                            f'corruption(s) injected (need >= '
+                            f'{min_corrupt}): the soak did not '
+                            f'exercise the frame CRCs')
     out['ok'] = not failures
     if args.json:
         print(json.dumps(out, indent=2))
