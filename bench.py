@@ -818,6 +818,121 @@ def fused_epoch_comparison(n_qubits: int, shots: int,
     }
 
 
+def ici_fabric_comparison(n_cores: int, shots: int,
+                          reps: int = 3) -> dict:
+    """Cross-chip ICI fabric (the ``ici_fabric`` row): one
+    repetition-code round's core axis sharded over the
+    ``('dp', 'cores')`` mesh — the fproc/sync barrier riding
+    ``lax.all_gather`` collectives over ICI — against the same
+    workload on a single device.  Bit-identity over every output key
+    (the fault word included) is asserted BEFORE any timing; the row
+    reports warm median batch times plus a raw collective microbench
+    (time per fabric-shaped all_gather and per scalar psum over the
+    cores axis) that anchors the docs/PERF.md "ICI fabric" roofline.
+
+    Knobs: BENCH_ICI_CORES / BENCH_ICI_SHOTS / BENCH_ICI_REPS; needs
+    >= 2 devices (``_ici_fabric_row`` shells to a forced-device CPU
+    child otherwise; the degraded rerun pins tiny shapes).
+    """
+    from jax.sharding import PartitionSpec as P
+    from distributed_processor_tpu.models.repetition import (
+        _lut_fabric_kwargs, repetition_round_machine_program)
+    from distributed_processor_tpu.parallel import (
+        make_cores_mesh, sharded_cores_simulate)
+    from distributed_processor_tpu.parallel.sweep import shard_map
+    from distributed_processor_tpu.sim.interpreter import (
+        InterpreterConfig, simulate_batch)
+
+    n_dev = len(jax.local_devices())
+    shards = 1
+    while (shards * 2 <= n_dev and n_cores % (shards * 2) == 0
+           and shards * 2 <= n_cores):
+        shards *= 2
+    if shards < 2:
+        return {'skipped': f'needs >= 2 devices dividing {n_cores} '
+                           f'cores; host advertises {n_dev} device(s)'}
+    mesh = make_cores_mesh(n_cores=shards, n_dp=1)
+    mp = repetition_round_machine_program(n_data=n_cores)
+    kw = dict(mp.static_bounds(), max_meas=4, max_resets=4,
+              **_lut_fabric_kwargs(n_cores))
+    rng = np.random.default_rng(2)
+    bits = rng.integers(0, 2, (shots, n_cores, 4))
+
+    # bit-identity gate before any timing: every key the sharded entry
+    # returns, the fault word included
+    single = simulate_batch(mp, bits,
+                            cfg=InterpreterConfig(engine='generic', **kw))
+    sharded = sharded_cores_simulate(mp, bits, mesh,
+                                     cfg=InterpreterConfig(**kw))
+    mismatched = [k for k in sorted(set(single) & set(sharded))
+                  if not np.array_equal(np.asarray(single[k]),
+                                        np.asarray(sharded[k]))]
+    assert not mismatched, \
+        f'sharded/single-device runs diverged on {mismatched}'
+
+    def timed(fn, ready):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(ready(out))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    t_single = timed(lambda: simulate_batch(
+        mp, bits, cfg=InterpreterConfig(engine='generic', **kw)),
+        lambda o: o['err'])
+    t_sharded = timed(lambda: sharded_cores_simulate(
+        mp, bits, mesh, cfg=InterpreterConfig(**kw)),
+        lambda o: o['err'])
+
+    # raw collective microbench: a dependency-chained scan of N
+    # fabric-shaped collectives per axis primitive, timed warm — the
+    # per-hop latency the sync/fproc barrier pays every interpreter
+    # step (each chain step folds the gathered word back into the
+    # carry so XLA cannot batch or elide the collectives)
+    n_coll = 100
+    x0 = np.zeros((shots, n_cores), np.int32)
+
+    def ag_chain(x):
+        def body(c, _):
+            g = jax.lax.all_gather(c, 'cores', axis=1, tiled=True)
+            return c + (jnp.sum(g, axis=1, keepdims=True)
+                        .astype(jnp.int32) & 1), None
+        return jax.lax.scan(body, x, None, length=n_coll)[0]
+
+    def psum_chain(x):
+        def body(c, _):
+            s = jax.lax.psum(jnp.sum(c) & 1, 'cores')
+            return c + s.astype(jnp.int32), None
+        return jax.lax.scan(body, x, None, length=n_coll)[0]
+
+    def coll_us(chain):
+        fn = jax.jit(shard_map(chain, mesh=mesh,
+                               in_specs=(P(None, 'cores'),),
+                               out_specs=P(None, 'cores'),
+                               check_vma=False))
+        jax.block_until_ready(fn(x0))           # compile + warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(x0))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)) / n_coll * 1e6
+
+    return {
+        'n_cores': n_cores, 'cores_shards': shards, 'shots': shots,
+        'reps': reps, 'platform': jax.devices()[0].platform,
+        'bit_identity': True,
+        't_ms_single_device': round(t_single * 1e3, 2),
+        't_ms_sharded': round(t_sharded * 1e3, 2),
+        'sharded_over_single': round(t_sharded / t_single, 3)
+        if t_single else None,
+        'allgather_us': round(coll_us(ag_chain), 2),
+        'psum_us': round(coll_us(psum_chain), 2),
+    }
+
+
 def statevec_utilization(step: _ModeStep, batch: int,
                          t_batch: float) -> dict:
     """Roofline position of the statevec trajectory step (round-4
@@ -999,7 +1114,13 @@ def _degraded_rerun(attempts):
                  # the epoch count + bit-identity are still real
                  ('BENCH_FUSED_QUBITS', '2'),
                  ('BENCH_FUSED_SHOTS', '64'),
-                 ('BENCH_FUSED_REPS', '1')):
+                 ('BENCH_FUSED_REPS', '1'),
+                 # ici_fabric row on forced CPU devices: a tiny core
+                 # count + batch — the collective latencies and the
+                 # bit-identity gate are still real
+                 ('BENCH_ICI_CORES', '4'),
+                 ('BENCH_ICI_SHOTS', '64'),
+                 ('BENCH_ICI_REPS', '1')):
         env.setdefault(k, v)
     print('preflight failed on the accelerator backend; rerunning the '
           'bench DEGRADED on CPU (JAX_PLATFORMS=cpu)', file=sys.stderr)
@@ -1226,6 +1347,50 @@ def _compile_front_door_row():
         seed=int(os.environ.get('BENCH_COMPILE_SEED', 0)),
         stampede_threads=int(os.environ.get('BENCH_COMPILE_THREADS',
                                             8)))
+
+
+def _ici_fabric_row():
+    """Cross-chip ICI fabric: the cores-sharded interpreter
+    (``BENCH_ICI_CORES``-core repetition round, sync/fproc riding
+    all_gather) vs single-device, bit-identity gated before timing,
+    plus the raw collective latency microbench behind the docs/PERF.md
+    "ICI fabric" roofline.  Runs in-process when this process already
+    sees >= 2 devices (TPU hosts); otherwise shells out to a CPU child
+    with ``--xla_force_host_platform_device_count`` so the collectives
+    are real — the same off-TPU path as the serve scaling row."""
+    import re
+    import subprocess
+    n_cores = int(os.environ.get('BENCH_ICI_CORES', 8))
+    shots = int(os.environ.get('BENCH_ICI_SHOTS', 256))
+    reps = int(os.environ.get('BENCH_ICI_REPS', 3))
+    if len(jax.local_devices()) >= 2:
+        return ici_fabric_comparison(n_cores, shots, reps=reps)
+    want = 1
+    while want * 2 <= n_cores and want * 2 <= 8:
+        want *= 2
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    flags = re.sub(r'--xla_force_host_platform_device_count=\d+', '',
+                   env.get('XLA_FLAGS', ''))
+    env['XLA_FLAGS'] = (flags + ' --xla_force_host_platform_device_'
+                        f'count={want}').strip()
+    if not env.get('BENCH_NO_CACHE'):
+        env.setdefault('JAX_COMPILATION_CACHE_DIR', _CACHE_DIR)
+    env['PYTHONPATH'] = os.pathsep.join(
+        p for p in (os.path.dirname(os.path.abspath(__file__)),
+                    env.get('PYTHONPATH', '')) if p)
+    code = (f'import json, bench; print(json.dumps('
+            f'bench.ici_fabric_comparison({n_cores}, {shots}, '
+            f'reps={reps})))')
+    proc = subprocess.run(
+        [sys.executable, '-c', code], env=env, capture_output=True,
+        text=True,
+        timeout=float(os.environ.get('BENCH_ICI_TIMEOUT', 900)))
+    if proc.returncode != 0:
+        return {'error': f'forced-device child rc={proc.returncode}: '
+                         f'{proc.stderr.strip()[-300:]}'}
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    row['forced_device_child'] = True
+    return row
 
 
 def main():
@@ -1811,6 +1976,22 @@ def main():
         integrity_row = None
     artifact.row('integrity_overhead', integrity_row)
 
+    # cross-chip ICI fabric row: one program's core axis sharded over
+    # the ('dp', 'cores') mesh, sync/fproc riding all_gather
+    # collectives — bit-identity asserted before any timing, plus the
+    # raw collective microbench behind the docs/PERF.md "ICI fabric"
+    # roofline (BENCH_ICI_* knobs; BENCH_ICI_SHOTS=0 skips it)
+    if secondaries and int(os.environ.get('BENCH_ICI_SHOTS', 256)):
+        try:
+            ici_row = _timed_row(_ici_fabric_row)
+        except _RowTimeout as e:
+            ici_row = {'error': 'timeout', 'detail': str(e)}
+        except Exception as e:  # pragma: no cover - defensive
+            ici_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+    else:
+        ici_row = None
+    artifact.row('ici_fabric', ici_row)
+
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
     result = {
@@ -1865,6 +2046,7 @@ def main():
             'observability_overhead': obs_row,
             'fleet_observability_overhead': fleet_obs_row,
             'integrity_overhead': integrity_row,
+            'ici_fabric': ici_row,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

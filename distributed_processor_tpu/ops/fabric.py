@@ -12,6 +12,7 @@ the shot axis.
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 
@@ -33,6 +34,15 @@ class MeasLUT:
         # address bit position per core (0 for unmasked cores)
         self._addr_shift = np.zeros(len(self.input_mask), dtype=np.int32)
         self._addr_shift[self.input_mask] = np.arange(k)
+        # Hoisted jnp constants: forming them per address()/__call__
+        # made every call re-stage host->device transfers of the same
+        # static masks, so jit retraced when the object identity (and
+        # thus the constant) changed.  One weight vector folds mask and
+        # shift: bits @ weights == sum(bits * mask << shift).
+        self._addr_weights = jnp.asarray(
+            self.input_mask.astype(np.int32) * (1 << self._addr_shift))
+        self._bit_shifts = jnp.arange(len(self.input_mask),
+                                      dtype=jnp.int32)
 
     @classmethod
     def from_fpga_config(cls, fpga_config) -> 'MeasLUT':
@@ -49,13 +59,22 @@ class MeasLUT:
     def address(self, bits):
         """bits ``[..., n_cores]`` -> table address ``[...]``."""
         bits = jnp.asarray(bits, jnp.int32)
-        shifts = jnp.asarray(self._addr_shift)
-        mask = jnp.asarray(self.input_mask, jnp.int32)
-        return jnp.sum(bits * mask * (1 << shifts), axis=-1)
+        return jnp.sum(bits * self._addr_weights, axis=-1)
 
     def __call__(self, bits):
         """bits ``[..., n_cores]`` -> per-core LUT output bits, same shape."""
         addr = self.address(bits)
         entry = self.table[addr]                        # [...]
-        n = len(self.input_mask)
-        return (entry[..., None] >> jnp.arange(n)) & 1
+        return (entry[..., None] >> self._bit_shifts) & 1
+
+    def sharded_call(self, bits, axis_name, axis: int = -1):
+        """``__call__`` for bits sharded over mesh axis ``axis_name``:
+        all_gathers the per-shard bit slices (tiled, so the concat
+        follows mesh-axis order and matches the replicated layout
+        bit-for-bit), then runs the ordinary table gather.  Returns the
+        FULL-width output on every shard — callers slice out their own
+        cores.  Used by the cores-sharded interpreter fabric
+        (sim/interpreter.py lut branch, docs/PERF.md "ICI fabric")."""
+        full = jax.lax.all_gather(jnp.asarray(bits, jnp.int32),
+                                  axis_name, axis=axis, tiled=True)
+        return self(full)

@@ -1,9 +1,10 @@
-from .mesh import make_mesh, shot_sharding
-from .driver import run_physics_sweep, run_multi_sweep
+from .mesh import make_mesh, make_cores_mesh, shot_sharding
+from .driver import run_physics_sweep, run_multi_sweep, run_cores_sweep
 from .sweep import (sharded_simulate, sweep_stats, sweep_stat_sums,
                     sharded_demod, sharded_physics_stats,
                     sharded_physics_stat_sums, sharded_multi_stats,
-                    run_spanned)
+                    sharded_cores_simulate, sharded_cores_stat_sums,
+                    sharded_cores_stats, run_spanned)
 from .param_sweep import (swept_pulse_machine_program, grid_init_regs,
                           sweep_cfg, AMP_REG, FREQ_REG)
 from .multihost import (initialize_multihost, make_global_mesh,

@@ -169,6 +169,16 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
         from .sweep import shard_map      # version shim lives there
+        if 'cores' in mesh.axis_names and mesh.shape['cores'] > 1:
+            # loud blocker, same naming as the engine ladder: physics
+            # sweeps shard SHOTS only (cores_ineligible owns the why)
+            from ..sim.interpreter import cores_ineligible
+            reason = cores_ineligible(mp, replace(cfg, physics=True))
+            raise ValueError(
+                f'run_physics_sweep shards shots over dp only; a '
+                f"cores={mesh.shape['cores']} mesh axis is ineligible "
+                f'here: {reason} — injected-bits programs shard cores '
+                f'via run_cores_sweep / sweep.sharded_cores_stats')
         n_dp = mesh.shape['dp']
         if batch % n_dp:
             raise ValueError(f'batch {batch} not divisible by mesh '
@@ -268,6 +278,72 @@ def run_physics_sweep(mp, model, total_shots: int, batch: int,
         # FAULT_CODES order) — zero everywhere for a healthy sweep
         'fault_shots': faults,
         'incomplete_batches': incomplete,
+    }
+
+
+def run_cores_sweep(mp, total_shots: int, batch: int, p1=0.5, key=0,
+                    cfg: InterpreterConfig = None, init_regs=None,
+                    mesh=None, **cfg_kw) -> dict:
+    """Injected-bits sweep of ONE many-core program with its core axis
+    sharded over the mesh ``'cores'`` axis (docs/PERF.md "ICI
+    fabric"): the cross-chip twin of :func:`run_multi_sweep`'s
+    injected-bits loop, for programs whose carry no single device can
+    hold.  Measurement bits are Bernoulli(``p1``) per (shot, core,
+    slot) from a per-batch key folded on the batch INDEX (the same
+    deterministic stream contract as the other drivers); per-batch
+    integer sums come back replicated from
+    :func:`.sweep.sharded_cores_stat_sums` and fold host-side.
+
+    ``mesh`` must be a ``('dp', 'cores')`` mesh
+    (:func:`.mesh.make_cores_mesh`) — required, there is no
+    single-device fallback to mis-shard onto.  Returns
+    ``run_multi_sweep``-style scalars: ``shots``, ``engine``
+    (always ``'generic'`` — the only rung hosting the collective
+    fabric), ``mean_pulses [n_cores]``, ``err_rate``, ``err_shots``,
+    ``mean_qclk [n_cores]``, ``fault_shots`` (per-code name → count).
+    ``cfg.fault_mode='strict'`` raises
+    :class:`~..sim.interpreter.FaultError` after the sweep if any
+    shot trapped.
+    """
+    from dataclasses import replace
+    from .sweep import sharded_cores_stat_sums
+    cfg = replace(cfg, **cfg_kw) if cfg else InterpreterConfig(**cfg_kw)
+    cfg, strict_faults = _fault_policy(cfg)
+    if mesh is None:
+        raise ValueError("run_cores_sweep needs a ('dp', 'cores') mesh "
+                         '(parallel.mesh.make_cores_mesh)')
+    if total_shots <= 0 or batch <= 0:
+        raise ValueError(f'need positive total_shots/batch, got '
+                         f'{total_shots}/{batch}')
+    if total_shots % batch:
+        raise ValueError(f'total_shots {total_shots} not divisible by '
+                         f'batch {batch}')
+    if isinstance(key, int):
+        key = jax.random.PRNGKey(key)
+    n_cores = mp.n_cores
+    p1 = jnp.broadcast_to(jnp.asarray(p1, jnp.float32), (n_cores,))
+    sums = None
+    for i in range(total_shots // batch):
+        k = jax.random.fold_in(key, i)
+        bits = (jax.random.uniform(k, (batch, n_cores, cfg.max_meas))
+                < p1[None, :, None]).astype(jnp.int32)
+        stats = sharded_cores_stat_sums(mp, bits, mesh,
+                                        init_regs=init_regs, cfg=cfg)
+        host = {name: np.asarray(v) for name, v in stats.items()}
+        sums = host if sums is None else \
+            {name: sums[name] + host[name] for name in sums}
+    faults = {name: int(n) for (name, _), n
+              in zip(FAULT_CODES, sums['fault_shots'])}
+    if strict_faults and any(faults.values()):
+        raise FaultError(sums['fault_shots'])
+    return {
+        'shots': total_shots,
+        'engine': 'generic',     # the rung hosting the collective fabric
+        'mean_pulses': sums['pulse_sum'] / total_shots,
+        'err_rate': float(sums['err_shots'] / total_shots),
+        'err_shots': int(sums['err_shots']),
+        'mean_qclk': sums['qclk_sum'] / total_shots,
+        'fault_shots': faults,
     }
 
 

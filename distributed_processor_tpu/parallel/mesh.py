@@ -26,6 +26,37 @@ def make_mesh(n_dp: int = None, n_mp: int = 1, devices=None) -> Mesh:
     return Mesh(devs, ('dp', 'mp'))
 
 
+def make_cores_mesh(n_cores: int = None, n_dp: int = None,
+                    devices=None) -> Mesh:
+    """Build a ``('dp', 'cores')`` mesh: the ``'cores'`` axis shards a
+    SINGLE program's core axis over chips — the per-core interpreter
+    lanes run on different devices and the fproc/sync fabric rides
+    ``lax.all_gather`` collectives over ICI (docs/PERF.md "ICI
+    fabric") — while ``'dp'`` still shards shots.
+
+    ``n_cores`` is the number of SHARDS of the core axis (devices one
+    program spans), not the program's core count; the program's
+    ``n_cores`` must divide evenly over it
+    (``parallel.sweep.sharded_cores_simulate`` validates).  Defaults:
+    all devices on the cores axis (``n_dp=1``).
+    """
+    devices = devices if devices is not None else jax.devices()
+    if n_cores is None:
+        n_cores = len(devices) // (n_dp or 1)
+    if n_cores < 1:
+        raise ValueError(f'need a positive cores axis; got {n_cores}')
+    if n_dp is None:
+        n_dp = len(devices) // n_cores
+    if n_dp < 1 or n_dp * n_cores > len(devices):
+        raise ValueError(
+            f'mesh dp={n_dp} x cores={n_cores} needs {n_dp * n_cores} '
+            f'devices; host advertises {len(devices)} (force more on '
+            f'CPU with XLA_FLAGS=--xla_force_host_platform_device_'
+            f'count=N)')
+    devs = np.asarray(devices[:n_dp * n_cores]).reshape(n_dp, n_cores)
+    return Mesh(devs, ('dp', 'cores'))
+
+
 def shot_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for ``[shots, ...]`` arrays: shots over the dp axis."""
     return NamedSharding(mesh, P('dp'))

@@ -37,7 +37,10 @@ from .. import isa
 from ..sim.interpreter import (InterpreterConfig, _program_constants,
                                _run_batch, _run_batch_engine, _pad_meas,
                                _soa_static, resolve_engine, carry_packspec,
-                               use_packed_carry, fault_shot_counts)
+                               use_packed_carry, fault_shot_counts,
+                               program_traits, _fault_policy,
+                               _check_strict)
+from ..utils.profiling import counter_inc
 
 
 def _mesh_engine(mp, cfg: InterpreterConfig, trim_regs: bool = True):
@@ -164,6 +167,173 @@ def sweep_stats(mp, meas_bits, mesh, init_regs=None,
     n_shots = np.asarray(meas_bits).shape[0]
     out = sweep_stat_sums(mp, meas_bits, mesh, init_regs=init_regs,
                           cfg=cfg, **kw)
+    return dict(mean_pulses=out['pulse_sum'] / n_shots,
+                err_rate=out['err_shots'] / n_shots,
+                mean_qclk=out['qclk_sum'] / n_shots,
+                fault_shots=out['fault_shots'])
+
+
+# ---------------------------------------------------------------------------
+# sharded-cores execution (docs/PERF.md "ICI fabric"): ONE program's
+# core axis over the mesh 'cores' axis.  The per-core interpreter lanes
+# run on different devices; the fproc fabric and sync barrier read
+# producer-side state through lax.all_gather collectives inside the
+# epoch loop (sim/interpreter.py _step under cfg.cores_axis) — the ICI
+# stand-in for the gateware's sync_iface/fproc wiring, bit-identical to
+# the single-device generic engine by construction.
+
+
+def _cores_cfg(mp, mesh, cfg: InterpreterConfig) -> InterpreterConfig:
+    """Validate + normalize a config for sharded-cores execution on
+    ``mesh``: the mesh must carry ``('dp', 'cores')`` axes, the
+    program's core count must split evenly over the cores axis, and
+    the (mp, cfg) pair must be eligible —
+    :func:`~..sim.interpreter.resolve_engine` raises with the blocker
+    (:func:`~..sim.interpreter.cores_ineligible` names it) otherwise."""
+    from dataclasses import replace
+    for axis in ('dp', 'cores'):
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"sharded-cores execution needs a ('dp', 'cores') mesh "
+                f'(parallel.mesh.make_cores_mesh); got axes '
+                f'{tuple(mesh.axis_names)}')
+    if cfg.cores_axis is None:
+        cfg = replace(cfg, cores_axis='cores')
+    elif cfg.cores_axis != 'cores':
+        raise ValueError(
+            f"cfg.cores_axis={cfg.cores_axis!r} does not name this "
+            f"mesh's 'cores' axis")
+    n_shards = mesh.shape['cores']
+    if mp.n_cores % n_shards:
+        raise ValueError(
+            f'{mp.n_cores} program cores not divisible over the '
+            f'cores axis ({n_shards} shards)')
+    resolve_engine(mp, cfg)       # raises with the named blocker
+    return cfg
+
+
+# the executors are cached per (mesh, cfg, traits) — NOT per program:
+# the program tensor and per-core constants are traced arguments, so
+# every same-shape program shares one trace and the retrace contract is
+# at most one per mesh shape (the 'cores_trace' counter +
+# tests/test_ici_fabric.py pin it)
+_CORES_SPECS = (P('cores'), P('cores'), P('cores'), P(),
+                P('dp', 'cores'), P('dp', 'cores'))
+
+
+@functools.lru_cache(maxsize=64)
+def _cores_executor(mesh, cfg: InterpreterConfig, traits):
+    """Full-output executor: program planes / per-core constants shard
+    along 'cores' (axis 0); ``sync_part`` stays replicated full-width
+    (the barrier needs every participant); shots shard along 'dp' with
+    the core axis of meas_bits/init_regs along 'cores'."""
+
+    def local(soa, spc, interp, sync_part, mb, ir):
+        counter_inc('cores_trace')
+        out = _run_batch(soa, spc, interp, sync_part, mb, cfg,
+                         int(soa.shape[0]), ir, traits)
+        # drop scalar diagnostics: every remaining leaf is [B, C, ...]
+        out.pop('steps')
+        out.pop('incomplete')
+        out.pop('op_hist', None)
+        return out
+
+    fn = shard_map(local, mesh=mesh, in_specs=_CORES_SPECS,
+                   out_specs=P('dp', 'cores'), check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _cores_stats_executor(mesh, cfg: InterpreterConfig, traits):
+    """Stats executor: per-core partial sums concatenate to full width
+    over 'cores' (tiled all_gather — a deterministic shard-order
+    concat, NOT a reduction: each core's sum lives on exactly one
+    shard), cross-core folds (err/fault are any-over-cores) gather
+    FIRST so every shard folds the identical full-width words, and
+    only the shot axis reduces with a ``psum`` (over 'dp')."""
+
+    def local(soa, spc, interp, sync_part, mb, ir):
+        counter_inc('cores_trace')
+        out = _run_batch(soa, spc, interp, sync_part, mb, cfg,
+                         int(soa.shape[0]), ir, traits)
+        gat = lambda x, a: jax.lax.all_gather(x, 'cores', axis=a,
+                                              tiled=True)
+        stats = dict(
+            pulse_sum=gat(jnp.sum(out['n_pulses'], axis=0), 0),
+            err_shots=jnp.sum(jnp.any(gat(out['err'], 1) != 0, axis=1)),
+            qclk_sum=gat(jnp.sum(out['qclk'], axis=0), 0),
+            fault_shots=fault_shot_counts(gat(out['fault'], 1)))
+        return jax.tree.map(lambda x: jax.lax.psum(x, 'dp'), stats)
+
+    fn = shard_map(local, mesh=mesh, in_specs=_CORES_SPECS,
+                   out_specs=P(), check_vma=False)
+    return jax.jit(fn)
+
+
+def _cores_args(mp, meas_bits, mesh, init_regs, cfg):
+    """Shared argument prep for the sharded-cores entry points."""
+    soa, spc, interp, sync_part = _program_constants(mp, cfg)
+    meas_bits = _pad_meas(meas_bits, cfg.max_meas)
+    n_shots = meas_bits.shape[0]
+    n_dp = mesh.shape['dp']
+    if n_shots % n_dp:
+        raise ValueError(f'{n_shots} shots not divisible by dp={n_dp}')
+    init_regs = _shotwise_init_regs(init_regs, n_shots, mp.n_cores)
+    return soa, spc, interp, sync_part, meas_bits, init_regs
+
+
+def sharded_cores_simulate(mp, meas_bits, mesh, init_regs=None,
+                           cfg: InterpreterConfig = None, **kw):
+    """Run ONE program with its core axis sharded over the mesh
+    ``'cores'`` axis (shots still shard over ``'dp'``): the per-core
+    interpreter lanes run on different devices and the fproc/sync
+    barrier is ``lax`` collectives inside the epoch loop — the real
+    distributed processor, with ICI standing in for the gateware's
+    ``sync_iface``/``fproc`` fabric.  Bit-identical per stat (fault
+    words included) to the single-device generic engine by
+    construction; tests/test_ici_fabric.py pins it on the golden
+    suite.
+
+    ``meas_bits``: ``[n_shots, n_cores, n_meas]`` with ``n_shots``
+    divisible by the dp axis size and ``n_cores`` divisible by the
+    cores axis size.  Returns the ``simulate_batch`` pytree (minus the
+    scalar diagnostics), sharded ``P('dp', 'cores')``.
+    """
+    from dataclasses import replace
+    cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    cfg, strict = _fault_policy(cfg)
+    cfg = _cores_cfg(mp, mesh, cfg)
+    args = _cores_args(mp, meas_bits, mesh, init_regs, cfg)
+    out = _cores_executor(mesh, cfg, program_traits(mp))(*args)
+    return _check_strict(out, strict)
+
+
+def sharded_cores_stat_sums(mp, meas_bits, mesh, init_regs=None,
+                            cfg: InterpreterConfig = None, **kw):
+    """The un-normalized integer sums under
+    :func:`sharded_cores_stats` (``sweep_stat_sums`` parity:
+    ``pulse_sum [n_cores]``, ``err_shots``, ``qclk_sum [n_cores]``,
+    ``fault_shots``), computed with the core axis sharded over the
+    mesh ``'cores'`` axis and shots over ``'dp'``.  Replicated
+    outputs (``out_specs=P()``)."""
+    from dataclasses import replace
+    cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    # statistics only ever reduce n_pulses/err/qclk — don't carry the
+    # [B, C, 9*max_pulses] record state through the while_loop
+    cfg = replace(cfg, record_pulses=False)
+    cfg = _cores_cfg(mp, mesh, cfg)
+    args = _cores_args(mp, meas_bits, mesh, init_regs, cfg)
+    return _cores_stats_executor(mesh, cfg, program_traits(mp))(*args)
+
+
+def sharded_cores_stats(mp, meas_bits, mesh, init_regs=None,
+                        cfg: InterpreterConfig = None, **kw):
+    """Sharded-cores run reduced to global statistics
+    (:func:`sweep_stats` parity: mean pulse counts, error rate, mean
+    final qclk, per-code fault counts)."""
+    n_shots = np.asarray(meas_bits).shape[0]
+    out = sharded_cores_stat_sums(mp, meas_bits, mesh,
+                                  init_regs=init_regs, cfg=cfg, **kw)
     return dict(mean_pulses=out['pulse_sum'] / n_shots,
                 err_rate=out['err_shots'] / n_shots,
                 mean_qclk=out['qclk_sum'] / n_shots,

@@ -125,6 +125,14 @@ def _normalize_cfg(cfg: InterpreterConfig, n_instr_bucket: int):
             'over shot lanes inside the jit, so the shot-replication '
             'padding used to coalesce unequal shot counts would '
             'contaminate it (run simulate_batch directly instead)')
+    if cfg.cores_axis is not None:
+        raise ValueError(
+            f'cores_axis={cfg.cores_axis!r} (sharded-cores execution) '
+            'cannot serve: the service dispatches single-device '
+            'simulate_batch batches and the cores-sharded fabric rides '
+            'shard_map collectives over a live device mesh — it only '
+            'runs via parallel.sweep.sharded_cores_simulate / '
+            'parallel.run_cores_sweep')
     strict = cfg.fault_mode == 'strict'
     if cfg.fault_mode not in ('count', 'strict'):
         raise ValueError(
@@ -408,6 +416,15 @@ class ExecutionService:
                 'simulate_batch batches and the fused engine '
                 'demodulates readout windows in-kernel — it only runs '
                 'physics-closed via sim.physics.run_physics_batch')
+        if cfg is not None and cfg.cores_axis is not None:
+            raise ValueError(
+                f'cores_axis={cfg.cores_axis!r} (sharded-cores '
+                'execution) cannot serve: the service dispatches '
+                'single-device simulate_batch batches and the '
+                'cores-sharded fabric rides shard_map collectives over '
+                'a live device mesh — it only runs via '
+                'parallel.sweep.sharded_cores_simulate / '
+                'parallel.run_cores_sweep')
         self._default_cfg = cfg
         self.max_batch_programs = max_batch_programs
         self.max_queue = max_queue

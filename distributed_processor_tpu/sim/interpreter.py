@@ -329,6 +329,20 @@ class InterpreterConfig:
     # normalize the cfg to 'count' before jit so both modes share one
     # compiled executable.
     fault_mode: str = 'count'
+    # cross-chip core sharding (docs/PERF.md "ICI fabric"): the name of
+    # the shard_map mesh axis the per-core interpreter lanes are sharded
+    # over, or None (default) for single-device execution.  When set,
+    # the step body reads every producer-side word the fproc fabric and
+    # sync barrier consume through ``lax.all_gather`` over this axis —
+    # the gathered arrays equal the full-width arrays of a single-device
+    # run bit-for-bit (tiled all_gather concatenates shards in axis
+    # order, and every downstream consumer is elementwise or a
+    # same-order reduction), so sharded execution is bit-identical by
+    # construction.  Only the generic engine hosts the collectives
+    # (:func:`cores_ineligible` names everything else loudly); entry is
+    # via ``parallel.sweep.sharded_cores_simulate`` — the single-device
+    # entry points reject a set ``cores_axis`` (no mesh axis to bind).
+    cores_axis: str = None
     alu_instr_clks: int = 5
     jump_cond_clks: int = 5
     jump_fproc_clks: int = 8
@@ -691,6 +705,30 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     # entirely when the program has no fproc instructions) ---------------
     fid = g('func_id')
     req = time
+
+    # ---- cross-chip producer views (cfg.cores_axis — docs/PERF.md
+    # "ICI fabric"): everything the fabric and the sync barrier read
+    # from OTHER cores goes through one gather layer.  Sharded, each
+    # device holds C local lanes and ``all_gather(..., tiled=True)``
+    # concatenates the shards in mesh-axis order, so the gathered
+    # arrays equal the full-width arrays of a single-device run
+    # bit-for-bit; ``core0`` offsets local lane indices into the full
+    # core axis.  Unsharded the gather is the identity (CF == C,
+    # core0 == 0) and the traced computation is unchanged.
+    ax = cfg.cores_axis
+    if ax is None:
+        _gat = lambda x: x
+        core0 = jnp.int32(0)
+    else:
+        _gat = lambda x: jax.lax.all_gather(x, ax, axis=1, tiled=True)
+        core0 = jax.lax.axis_index(ax).astype(jnp.int32) * C
+    if any_fproc or has_sync:
+        P_time, P_done = _gat(time), _gat(st['done'])
+        CF = P_done.shape[1]                       # full core count
+    if any_fproc:
+        P_n_meas, P_mavail = _gat(st['n_meas']), _gat(st['meas_avail'])
+        P_bits, P_valid = _gat(meas_bits), _gat(meas_valid)
+
     if not any_fproc:
         fid_bad = f_race = f_deadlock = f_phys = jnp.zeros((), bool)
         f_ready = jnp.ones((), bool)
@@ -698,7 +736,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         f_tready = req
 
     def _by_producer(prod_oh):
-        """Select producer-core rows for each reader: [B,C'] -> [B,C]."""
+        """Select producer-core rows for each reader: [B,CF] -> [B,C]."""
         sel = lambda arr: _ohsel(arr[:, None, :], prod_oh)
         sel_m = lambda arr: jnp.sum(
             arr[:, None, :, :] * prod_oh[..., None], axis=2)
@@ -710,11 +748,11 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         measurement whose bit is still *invalid* (physics pending, not
         yet demodulated) stalls the read instead of serving it."""
         sel, sel_m = _by_producer(prod_oh)
-        mavail_p, bits_p = sel_m(st['meas_avail']), sel_m(meas_bits)
-        valid_p = sel_m(meas_valid.astype(jnp.int32))
+        mavail_p, bits_p = sel_m(P_mavail), sel_m(P_bits)
+        valid_p = sel_m(P_valid.astype(jnp.int32))
         fresh = (mavail_p > req[..., None]) & \
             (jnp.arange(cfg.max_meas)[None, None, :]
-             < sel(st['n_meas'])[..., None])
+             < sel(P_n_meas)[..., None])
         exists = jnp.any(fresh, axis=-1)
         oh_j = _onehot(jnp.argmax(fresh, axis=-1).astype(jnp.int32),
                        cfg.max_meas)
@@ -724,7 +762,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         data = jnp.where(ready, _ohsel(bits_p, oh_j), 0)
         tready = jnp.where(ready,
                            jnp.maximum(req, _ohsel(mavail_p, oh_j)), req)
-        dead = ~exists & (sel(st['done'].astype(jnp.int32)) == 1)
+        dead = ~exists & (sel(P_done.astype(jnp.int32)) == 1)
         return ready | dead, data, tready, dead, phys
 
     fid_bad = jnp.zeros((B, C), bool)
@@ -733,13 +771,13 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         pass          # trivial constants above; is_fproc never true
     elif cfg.fabric == 'sticky':
         # bit latched at read time; producer must have simulated past `req`
-        fid_bad = fid >= C
-        oh_prod = _onehot(jnp.clip(fid, 0, C - 1), C)
+        fid_bad = fid >= CF
+        oh_prod = _onehot(jnp.clip(fid, 0, CF - 1), CF)
         sel, sel_m = _by_producer(oh_prod)
-        mavail_p, bits_p = sel_m(st['meas_avail']), sel_m(meas_bits)
-        valid_p = sel_m(meas_valid.astype(jnp.int32))
-        f_time_ok = (sel(st['done'].astype(jnp.int32)) == 1) \
-            | (sel(time) >= req)
+        mavail_p, bits_p = sel_m(P_mavail), sel_m(P_bits)
+        valid_p = sel_m(P_valid.astype(jnp.int32))
+        f_time_ok = (sel(P_done.astype(jnp.int32)) == 1) \
+            | (sel(P_time) >= req)
         if pt_gate:
             # under the event gate, a producer stalled at a far-future
             # trigger would freeze its clock and deadlock the sticky
@@ -765,32 +803,34 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             (mavail_p > (req - STICKY_RACE_MARGIN)[..., None])
             & (mavail_p <= (req + STICKY_RACE_MARGIN)[..., None]), -1)
     elif cfg.fabric == 'fresh':
-        fid_bad = fid >= C
-        oh_prod = _onehot(jnp.clip(fid, 0, C - 1), C)
+        fid_bad = fid >= CF
+        oh_prod = _onehot(jnp.clip(fid, 0, CF - 1), CF)
         f_ready, f_data, f_tready, f_deadlock, f_phys = _fresh_read(oh_prod)
     else:  # 'lut' — reference: hdl/fproc_lut.sv + meas_lut.sv
-        # func_id 0: own fresh measurement
+        # func_id 0: own fresh measurement (local lane core0+i in the
+        # full core axis — an identity one-hot when unsharded)
         own_oh = jnp.broadcast_to(
-            jnp.eye(C, dtype=jnp.int32)[None], (B, C, C))
+            _onehot(core0 + jnp.arange(C, dtype=jnp.int32), CF)[None],
+            (B, C, CF))
         o_ready, o_data, o_tready, o_dead, o_phys = _fresh_read(own_oh)
         # func_id >= 1: the masked cores' latest bits form the address;
         # the read blocks until every masked input's bit is *valid*
         # (reference: meas_lut.sv LUT_WAIT until (mask & valid) == mask)
-        lmask = np.asarray(cfg.lut_mask, dtype=bool)
-        shifts = np.zeros(C, dtype=np.int32)
+        lmask = np.asarray(cfg.lut_mask, dtype=bool)        # [CF] full
+        shifts = np.zeros(len(lmask), dtype=np.int32)
         shifts[lmask] = np.arange(int(lmask.sum()))
         lmask_j = jnp.asarray(lmask)
         # causality: every masked producer has recorded >= 1 measurement
         # and its timeline passed the reader's request
-        ok = (st['n_meas'] >= 1)[:, None, :] \
-            & (st['done'][:, None, :]
-               | (time[:, None, :] >= req[:, :, None]))      # [B, C, C']
+        ok = (P_n_meas >= 1)[:, None, :] \
+            & (P_done[:, None, :]
+               | (P_time[:, None, :] >= req[:, :, None]))    # [B, C, CF]
         l_causal = jnp.all(jnp.where(lmask_j[None, None, :], ok, True), -1)
-        oh_last = _onehot(jnp.maximum(st['n_meas'] - 1, 0), cfg.max_meas)
-        avail_last = _ohsel(jnp.where(st['meas_avail'] == INT32_MAX, 0,
-                                      st['meas_avail']), oh_last)   # [B, C']
-        bit = _ohsel(meas_bits, oh_last)                            # [B, C']
-        valid_last = _ohsel(meas_valid.astype(jnp.int32), oh_last)  # [B, C']
+        oh_last = _onehot(jnp.maximum(P_n_meas - 1, 0), cfg.max_meas)
+        avail_last = _ohsel(jnp.where(P_mavail == INT32_MAX, 0,
+                                      P_mavail), oh_last)           # [B, CF]
+        bit = _ohsel(P_bits, oh_last)                               # [B, CF]
+        valid_last = _ohsel(P_valid.astype(jnp.int32), oh_last)     # [B, CF]
         l_valid = jnp.all(jnp.where(lmask_j[None, None, :],
                                     (valid_last == 1)[:, None, :], True), -1)
         l_ready = l_causal & l_valid
@@ -800,7 +840,8 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                        -1)                                          # [B, C]
         table = jnp.asarray(cfg.lut_table, jnp.int32)
         entry = _ohsel(table[None, None, :], _onehot(addr, len(table)))
-        l_data = (entry >> jnp.arange(C, dtype=jnp.int32)[None, :]) & 1
+        l_data = (entry >> (core0
+                            + jnp.arange(C, dtype=jnp.int32))[None, :]) & 1
         is_own = fid == 0
         f_ready = jnp.where(is_own, o_ready, l_ready)
         f_data = jnp.where(is_own, o_data, l_data)
@@ -823,13 +864,18 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     # ---- sync barrier (reference: ctrl.v:510-552 + qclk reset) ---------
     if has_sync:
         at_sync = live & (kind == isa.K_SYNC)
-        live_part = sync_part[None, :] & live
-        sync_ready = jnp.any(at_sync, -1) \
-            & jnp.all(~live_part | at_sync, -1)
-        release = jnp.max(jnp.where(at_sync, time, -INT32_MAX),
+        # barrier state over the FULL core axis (sync_part is already
+        # full-width; P_at/P_time/P_done are the gathered views — the
+        # sharded barrier is exactly the reference barrier evaluated on
+        # the cross-chip words)
+        P_at = _gat(at_sync)
+        live_part = sync_part[None, :] & ~P_done
+        sync_ready = jnp.any(P_at, -1) \
+            & jnp.all(~live_part | P_at, -1)
+        release = jnp.max(jnp.where(P_at, P_time, -INT32_MAX),
                           axis=-1, keepdims=True) + QCLK_RST_DELAY  # [B, 1]
         sync_adv = at_sync & sync_ready[:, None]
-        sync_err = sync_ready & jnp.any(sync_part[None, :] & st['done'], -1)
+        sync_err = sync_ready & jnp.any(sync_part[None, :] & P_done, -1)
 
     # ---- stall mask ----------------------------------------------------
     stalled = is_fproc & ~f_ready
@@ -1449,6 +1495,26 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
         if cfg.packed_ctrl else ()
     bool_keys = frozenset(k for k in pack_keys
                           if st0[k].dtype == jnp.dtype('bool'))
+    ax = cfg.cores_axis
+
+    def _all_cores(x):
+        """``all()`` over the FULL core axis of a ``[B, C]`` mask —
+        an ``all_gather`` over ``cfg.cores_axis`` when sharded (every
+        shard computes the identical [B] result), the plain local
+        reduction otherwise."""
+        if ax is not None:
+            x = jax.lax.all_gather(x, ax, axis=1, tiled=True)
+        return jnp.all(x, axis=-1)
+
+    def _more_of(st):
+        """The while condition as a carried scalar: shard_map forbids
+        collectives in a ``while_loop`` cond, so the sharded path
+        computes the (replicated) predicate in the body and the cond
+        just reads it."""
+        settled = _all_cores(st['done'])
+        if cfg.physics:
+            settled = settled | st['paused']
+        return (~jnp.all(settled)) & (st['_steps'] < cfg.max_steps)
 
     def pack(st):
         if not pack_keys:
@@ -1468,6 +1534,8 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
 
     def cond(carry):
         st = unpack(carry)
+        if ax is not None:
+            return st['_more']
         settled = jnp.all(st['done'], axis=-1)
         if cfg.physics:
             settled = settled | st['paused']
@@ -1481,8 +1549,11 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
                     meas_valid, cfg, dev, traits)
         stall_sync = st2.pop('_stall_sync')
         # quiescence detection per shot: no live core changed state
-        same = jnp.all((st2['pc'] == st['pc']) & (st2['time'] == st['time'])
-                       & (st2['done'] == st['done']), axis=-1)   # [B]
+        # (over the FULL core axis — a shard whose local lanes froze
+        # must not settle while a remote producer still runs)
+        same = _all_cores((st2['pc'] == st['pc'])
+                          & (st2['time'] == st['time'])
+                          & (st2['done'] == st['done']))         # [B]
         if cfg.physics:
             # quiescent + a core awaiting an unresolved measurement bit
             # = pause for the epoch resolver; quiescent without one is a
@@ -1512,7 +1583,7 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
         # lifts the while condition to an OR over program lanes and
         # settled programs keep receiving the body until the slowest
         # lane finishes.
-        settled_in = jnp.all(st_in['done'], axis=-1)
+        settled_in = _all_cores(st_in['done'])
         if cfg.physics:
             st_in = dict(st_in, paused=paused)
             settled_in = settled_in | paused
@@ -1527,9 +1598,15 @@ def _exec_loop(st0: dict, soa, spc, interp, sync_part, meas_bits, meas_valid,
         st = unpack(carry)
         for _ in range(max(1, cfg.steps_per_iter)):
             st = one(st)
+        if ax is not None:
+            st['_more'] = _more_of(st)
         return pack(st)
 
-    return unpack(jax.lax.while_loop(cond, body, pack(st0)))
+    if ax is not None:
+        st0 = dict(st0, _more=_more_of(st0))
+    out = unpack(jax.lax.while_loop(cond, body, pack(st0)))
+    out.pop('_more', None)
+    return out
 
 
 # AUTO straight-line cap: unrolling emits O(n_instr) specialized step
@@ -1753,6 +1830,44 @@ def fused_ineligible(mp, cfg: InterpreterConfig) -> str:
     return None
 
 
+def cores_ineligible(mp, cfg: InterpreterConfig) -> str:
+    """Why ``(mp, cfg)`` cannot run sharded over a ``'cores'`` mesh
+    axis (``cfg.cores_axis`` — docs/PERF.md "ICI fabric") — ``None``
+    when it can.
+
+    Sharded execution runs the generic engine inside ``shard_map``
+    with the fproc fabric and the sync barrier reading producer-side
+    state through ``lax.all_gather`` over the cores axis
+    (bit-identical to the single-device run by construction).  What
+    the collective step cannot host:
+
+    * physics mode — the epoch resolver pauses host-side between
+      epochs and draws global-shape noise streams; the bloch/statevec
+      device co-state is not core-separable;
+    * an explicitly forced specialized engine — straightline / block /
+      pallas / fused trace per-program bodies with no collective
+      fabric; only the generic fetch-dispatch step carries the
+      all_gather views;
+    * trace mode — the per-step trace export assembles the full core
+      axis on one host (a single-device debugging surface).
+    """
+    if cfg.physics:
+        return ('physics mode (the epoch resolver pauses host-side '
+                'between epochs and draws global-shape noise streams)')
+    if cfg.engine not in (None, 'auto', 'generic'):
+        return (f'engine={cfg.engine!r} (the specialized engines trace '
+                f'per-program bodies with no collective fabric — only '
+                f'the generic step reads through the cores-axis '
+                f'all_gather)')
+    if cfg.straightline:
+        return ('straightline=True (emitted straight-line execution '
+                'has no collective fabric)')
+    if cfg.trace:
+        return ('trace mode assembles the full-core-axis per-step '
+                'trace on one device')
+    return None
+
+
 @functools.lru_cache(maxsize=128)
 def _block_plan(blk: tuple):
     """Cached block table for a static program: ``(bid_at, bodies)``
@@ -1790,6 +1905,17 @@ def resolve_engine(mp, cfg: InterpreterConfig) -> str:
     ``'generic' | 'block' | 'straightline' | 'pallas' | 'fused'``.
     """
     eng = cfg.engine
+    if cfg.cores_axis is not None:
+        # sharded-cores execution is its own eligibility dimension: the
+        # collective fabric lives only in the generic step body, so a
+        # set cores_axis pins the resolution to 'generic' (or raises
+        # with the blocker, same ladder-naming style as the rungs)
+        reason = cores_ineligible(mp, cfg)
+        if reason:
+            raise ValueError(f'cores_axis={cfg.cores_axis!r} but the '
+                             f'program/config is ineligible for '
+                             f'sharded-cores execution: {reason}')
+        return 'generic'
     if eng is None:
         return 'straightline' if use_straightline(mp, cfg) else 'generic'
     if eng == 'generic':
@@ -3101,7 +3227,11 @@ def _run_batch(soa, spc, interp, sync_part, meas_bits, cfg: InterpreterConfig,
                n_cores: int, init_regs=None, traits=None) -> dict:
     """Execute a shot batch: meas_bits ``[B, n_cores, max_meas]``
     (injected a priori and all valid — the cocotb-style path)."""
-    _check_fabric(cfg, n_cores)
+    # under shard_map n_cores is the LOCAL shard width; the lut fabric
+    # validates against the full core axis, which sync_part (replicated,
+    # full-width) still carries
+    _check_fabric(cfg, n_cores if cfg.cores_axis is None
+                  else int(sync_part.shape[0]))
     B = meas_bits.shape[0]
     st0 = _init_state(B, n_cores, cfg, init_regs)
     st0['_steps'] = jnp.int32(0)
@@ -3241,6 +3371,14 @@ def block_trace_count() -> int:
     process (named counter ``'block_trace'`` — utils.profiling): the
     retrace contract allows at most one per (bucket, engine) pair."""
     return counter_get('block_trace')
+
+
+def cores_trace_count() -> int:
+    """How many times the sharded-cores executor has been traced in
+    this process (named counter ``'cores_trace'`` — utils.profiling):
+    the retrace contract allows at most one per mesh shape
+    (``parallel.sweep`` caches the executor per (mesh, cfg, traits))."""
+    return counter_get('cores_trace')
 
 
 def multi_trace_count() -> int:
@@ -3662,6 +3800,18 @@ def _check_strict(out: dict, strict: bool) -> dict:
     return out
 
 
+def _check_no_cores_axis(cfg: InterpreterConfig):
+    """The single-device entry points trace no ``shard_map``, so a set
+    ``cores_axis`` would reach an unbound mesh axis deep inside the
+    step body — reject it typed at the front door instead."""
+    if cfg.cores_axis is not None:
+        raise ValueError(
+            f'cores_axis={cfg.cores_axis!r} names a shard_map mesh '
+            f'axis the single-device entry points cannot bind — run '
+            f'via parallel.sweep.sharded_cores_simulate (or clear '
+            f'cores_axis for single-device execution)')
+
+
 def _pad_meas(meas_bits, max_meas: int):
     meas_bits = jnp.asarray(meas_bits, jnp.int32)
     if meas_bits.shape[-1] > max_meas:
@@ -3686,6 +3836,7 @@ def simulate(mp, meas_bits=None, init_regs=None,
     registers, qclk values, per-core error bits, and completion flags.
     """
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    _check_no_cores_axis(cfg)
     cfg, strict = _fault_policy(cfg)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     if meas_bits is None:
@@ -3738,6 +3889,7 @@ def simulate_batch(mp, meas_bits, init_regs=None,
             return simulate_batch(mp, meas_bits, init_regs, cfg=cfg,
                                   **kw)
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    _check_no_cores_axis(cfg)
     cfg, strict = _fault_policy(cfg)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)

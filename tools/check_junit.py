@@ -94,6 +94,16 @@ CHAOS_OK_SKIP = 'host advertises 1 device'
 OBS_MODULE = 'test_obs'
 OBS_OK_SKIP = 'host advertises 1 device'
 
+# the ICI-fabric suite proves the cores-sharded interpreter (one
+# program's core axis over the device mesh, sync/fproc riding
+# all_gather collectives — docs/PERF.md "ICI fabric") bit-identical to
+# the single-device generic engine; it needs >= 2 virtual CPU devices,
+# which the conftest always forces, so a skip with any reason other
+# than a genuinely single-device host means the cross-chip fabric
+# silently stopped being exercised
+ICI_MODULE = 'test_ici_fabric'
+ICI_OK_SKIP = 'host advertises 1 device'
+
 
 def _is_fault_test(tc) -> bool:
     ident = f'{tc.get("classname", "")}.{tc.get("name", "")}'.lower()
@@ -114,7 +124,7 @@ def main(path: str) -> int:
         print('FAILURE: no tests ran')
         return 1
     leaks, thread_leaks, bad_skips, dev_skips = [], [], [], []
-    chaos_skips, obs_skips = [], []
+    chaos_skips, obs_skips, ici_skips = [], [], []
     for tc in root.iter('testcase'):
         ident = f'{tc.get("classname")}.{tc.get("name")}'
         skipped = tc.find('skipped')
@@ -141,6 +151,12 @@ def main(path: str) -> int:
                 (skipped.text or '')
             if OBS_OK_SKIP not in reason:
                 obs_skips.append(ident)
+        if skipped is not None \
+                and ICI_MODULE in tc.get('classname', ''):
+            reason = (skipped.get('message') or '') + \
+                (skipped.text or '')
+            if ICI_OK_SKIP not in reason:
+                ici_skips.append(ident)
         for out in (tc.findall('system-out') + tc.findall('system-err')):
             if not out.text:
                 continue
@@ -179,8 +195,14 @@ def main(path: str) -> int:
                   f'the tracing/metrics/flight-recorder contract '
                   f'stopped being exercised (see '
                   f'docs/OBSERVABILITY.md)')
+    if ici_skips:
+        for name in ici_skips:
+            print(f'BAD SKIP: {name}: ICI-fabric tests skipped — the '
+                  f'cores-sharded interpreter (cross-chip sync/fproc '
+                  f'collectives) stopped being exercised (see '
+                  f'docs/PERF.md "ICI fabric")')
     if leaks or thread_leaks or bad_skips or dev_skips or chaos_skips \
-            or obs_skips:
+            or obs_skips or ici_skips:
         return 1
     print(f'junit OK: {n_tests} tests, no failures, no fault leaks, '
           f'no leaked service threads, no gated skips')
