@@ -317,6 +317,113 @@ def large_program_scaling(n_qubits: int, small_depth: int,
     return results
 
 
+def feedback_round_machine_program(n_data: int, rounds: int,
+                                   k_corr: int):
+    """Deep lut+fproc feedback workload for the feedback ladder: every
+    data core runs ``rounds`` of measure -> branch on the parity LUT
+    -> correction block (``k_corr`` drive pulses, the first one
+    skipped when the syndrome is clear).  Unrolled (no loops), every
+    round's trigger sits after the previous round's read — exactly
+    the shape the straight-line span must reject and the block engine
+    hosts (docs/PERF.md "Feedback on the fast engines")."""
+    from distributed_processor_tpu import isa
+    from distributed_processor_tpu.decoder import \
+        machine_program_from_cmds
+    meas = lambda t: isa.pulse_cmd(freq_word=3, cfg_word=2,
+                                   env_word=(2 << 12) | 0, cmd_time=t)
+    drv = lambda t: isa.pulse_cmd(freq_word=5, cfg_word=0,
+                                  env_word=(2 << 12) | 0, cmd_time=t)
+    cores = []
+    for _c in range(n_data):
+        cmds = []
+        for r in range(rounds):
+            t0 = 1000 * r
+            cmds.append(meas(t0 + 10))
+            cmds.append(isa.alu_cmd('jump_fproc', 'i', 0, 'eq',
+                                    jump_cmd_ptr=len(cmds) + 2,
+                                    func_id=1))
+            for i in range(k_corr):
+                cmds.append(drv(t0 + 500 + 4 * i))
+        cmds.append(isa.done_cmd())
+        cores.append(cmds)
+    return machine_program_from_cmds(cores)
+
+
+def fproc_feedback_ladder(n_data: int = 3, rounds: int = 6,
+                          k_corr: int = 12, batch: int = 256):
+    """Feedback-on-the-fast-engines row (docs/PERF.md "Feedback on
+    the fast engines"): outer-loop iteration counts and warm
+    per-batch times for generic vs block vs pallas on the deep
+    lut+fproc feedback workload — the shape the engine ladder bounced
+    to the generic rung before the timestamped fabric made LUT reads
+    dispatch-granularity-invariant.  Bit-identity across engines
+    (every stat, fault word included) is asserted BEFORE any timing;
+    iteration counts are exact while_loop trips, so the reduction
+    ratio is backend-independent; the block rung must stay within one
+    trace of the content-keyed jit cache."""
+    from distributed_processor_tpu.models.repetition import \
+        _lut_fabric_kwargs
+    from distributed_processor_tpu.sim.interpreter import (
+        block_trace_count, resolve_engine, simulate_batch)
+    mp = feedback_round_machine_program(n_data, rounds, k_corr)
+    kw = dict(mp.static_bounds(), max_meas=rounds, max_resets=2,
+              record_pulses=False, **_lut_fabric_kwargs(n_data))
+    rng = np.random.default_rng(31)
+    bits = rng.integers(0, 2,
+                        size=(batch, n_data, rounds)).astype(np.int32)
+    out = {'n_data': n_data, 'rounds': rounds, 'k_corr': k_corr,
+           'batch': batch, 'n_instr': mp.n_instr}
+    results = {}
+    n_blk0 = block_trace_count()
+    for eng in ('generic', 'block', 'pallas'):
+        extra = {'pallas_interpret': True} \
+            if eng == 'pallas' and jax.devices()[0].platform != 'tpu' \
+            else {}
+        cfg = InterpreterConfig(engine=eng, **extra, **kw)
+        try:
+            resolve_engine(mp, cfg)
+        except ValueError as e:
+            out[eng] = {'ineligible': str(e)[:200]}
+            continue
+        t0 = time.perf_counter()
+        r = simulate_batch(mp, bits, cfg=cfg)
+        steps = int(jax.block_until_ready(r['steps']))
+        t_first = time.perf_counter() - t0
+        assert not bool(r['incomplete']), f'{eng} feedback run truncated'
+        assert int(np.asarray(r['err']).sum()) == 0, \
+            f'{eng} feedback run set error bits'
+        results[eng] = r
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            rr = simulate_batch(mp, bits, cfg=cfg)
+            jax.block_until_ready(rr['err'])
+            ts.append(time.perf_counter() - t0)
+        out[eng] = {'iterations': steps,
+                    'first_call_s': round(t_first, 3),
+                    'warm_batch_s': round(sorted(ts)[1], 4)}
+    # bit-identity gate: every engine that ran agrees with generic on
+    # every stat (the fault word included) before the numbers count
+    for eng, r in results.items():
+        if eng == 'generic':
+            continue
+        for k in sorted(set(results['generic']) & set(r)):
+            if k == 'steps':
+                continue
+            assert np.array_equal(np.asarray(results['generic'][k]),
+                                  np.asarray(r[k])), \
+                f'{eng} diverged from generic on {k}'
+    out['block_retraces'] = block_trace_count() - n_blk0
+    assert out['block_retraces'] <= 1, \
+        'feedback ladder block rung retraced'
+    out['iteration_reduction'] = round(
+        out['generic']['iterations'] / out['block']['iterations'], 1)
+    out['note'] = ('lut+fproc feedback served time-indexed from the '
+                   'meas_time planes; identical bits/faults on every '
+                   'engine, iterations are while_loop trips (exact)')
+    return out
+
+
 def engine_ladder(n_qubits: int, depth: int, batch: int = 256):
     """Engine-ladder row (docs/PERF.md "The engine ladder"): outer-loop
     iteration counts and warm per-batch times for the generic
@@ -1120,7 +1227,13 @@ def _degraded_rerun(attempts):
                  # bit-identity gate are still real
                  ('BENCH_ICI_CORES', '4'),
                  ('BENCH_ICI_SHOTS', '64'),
-                 ('BENCH_ICI_REPS', '1')):
+                 ('BENCH_ICI_REPS', '1'),
+                 # fproc_feedback_ladder row: a shallow feedback
+                 # workload — the iteration reduction and bit-identity
+                 # gate are shape-independent
+                 ('BENCH_FEEDBACK_ROUNDS', '4'),
+                 ('BENCH_FEEDBACK_CORR', '12'),
+                 ('BENCH_FEEDBACK_SHOTS', '64')):
         env.setdefault(k, v)
     print('preflight failed on the accelerator backend; rerunning the '
           'bench DEGRADED on CPU (JAX_PLATFORMS=cpu)', file=sys.stderr)
@@ -1810,6 +1923,24 @@ def main():
     else:
         ladder = None
     artifact.row('engine_ladder', ladder)
+    # feedback-ladder row: generic vs block vs pallas on the deep
+    # lut+fproc feedback workload — the rung the timestamped fabric
+    # opened (bit-identity gated before timing; BENCH_FEEDBACK_SHOTS=0
+    # skips it, the degraded rerun shrinks the shape)
+    if secondaries and int(os.environ.get('BENCH_FEEDBACK_SHOTS', 256)):
+        try:
+            feedback_row = _timed_row(lambda: fproc_feedback_ladder(
+                n_data=int(os.environ.get('BENCH_FEEDBACK_QUBITS', 3)),
+                rounds=int(os.environ.get('BENCH_FEEDBACK_ROUNDS', 6)),
+                k_corr=int(os.environ.get('BENCH_FEEDBACK_CORR', 12)),
+                batch=int(os.environ.get('BENCH_FEEDBACK_SHOTS', 256))))
+        except _RowTimeout as e:
+            feedback_row = {'error': 'timeout', 'detail': str(e)}
+        except Exception as e:  # pragma: no cover - defensive
+            feedback_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+    else:
+        feedback_row = None
+    artifact.row('fproc_feedback_ladder', feedback_row)
     # exec-profile row: the per-engine (a, b) overhead decomposition
     # (tools/exec_profile.py decompose_engines) — the measured claim
     # that the pallas megastep deletes fixed per-step cost a.  Knobs

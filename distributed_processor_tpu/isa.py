@@ -461,11 +461,16 @@ def stack_soa_multi(programs: list[SoAProgram],
 
 # Kinds that END a straight-line block: anything that branches, blocks on
 # another core (fproc read / sync barrier), or otherwise needs the generic
-# engine's dynamic dispatch.  DONE is deliberately NOT here: a halted core
-# simply stops executing, so DONE rows are handled inline by the block
-# bodies — otherwise the DONE padding that equalizes per-core program
-# lengths (stack_soa) would shatter every block of a heterogeneous-length
-# program.
+# engine's dynamic dispatch.  K_ALU_FPROC / K_JUMP_FPROC here is what
+# makes the block engine sound under EVERY fproc fabric — lut included:
+# a read is always served at a boundary step by the generic fabric step
+# with gathered producer state (and, under lut, the time-indexed
+# meas_time plane), never from inside a superinstruction body
+# (sim.interpreter.block_ineligible documents the per-fabric argument).
+# DONE is deliberately NOT here: a halted core simply stops executing,
+# so DONE rows are handled inline by the block bodies — otherwise the
+# DONE padding that equalizes per-core program lengths (stack_soa)
+# would shatter every block of a heterogeneous-length program.
 BLOCK_TERMINATORS = frozenset(
     {K_JUMP_I, K_JUMP_COND, K_ALU_FPROC, K_JUMP_FPROC, K_SYNC})
 

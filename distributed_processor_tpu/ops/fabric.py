@@ -78,3 +78,44 @@ class MeasLUT:
         full = jax.lax.all_gather(jnp.asarray(bits, jnp.int32),
                                   axis_name, axis=axis, tiled=True)
         return self(full)
+
+    def timed_call(self, bit_planes, time_planes, n_meas, read_time):
+        """Time-indexed LUT read — the dispatch-granularity-invariant
+        semantics the fast engines serve (docs/PERF.md "Feedback on
+        the fast engines").
+
+        Instead of latching each masked producer's LATEST bit (whose
+        value depends on how producer instructions interleave with the
+        read), select per producer the newest bit PRODUCED strictly
+        before the read's service time: with ``bit_planes`` ``[...,
+        n_cores, n_slots]`` (per-slot measurement bits), ``time_planes``
+        same shape (per-slot production clocks, ``INT32_MAX`` where
+        unwritten), ``n_meas`` ``[..., n_cores]`` (slots recorded), and
+        ``read_time`` ``[...]``, the served slot for producer ``p`` is
+        ``max(#{m < n_meas_p : t_pm < read_time}, 1) - 1`` — count 0
+        falls back to slot 0, the first recorded bit, matching the
+        gateware's arm-then-accumulate ``LUT_WAIT``.  Strict ``<``
+        because a producer whose clock sits exactly at ``read_time``
+        can still fire a trigger there; once every producer's clock
+        passes ``read_time`` the selection is FINAL, so any dispatch
+        granularity that serves the read from these planes returns the
+        same bits.  This is the reference semantics the interpreter
+        engines implement inline (sim/interpreter.py lut serves);
+        callers with only latest-bit vectors keep using ``__call__``.
+
+        Returns ``(out_bits, slot)``: per-core LUT output bits
+        ``[..., n_cores]`` and the selected slot per producer
+        ``[..., n_cores]`` (for availability/validity lookups)."""
+        bit_planes = jnp.asarray(bit_planes, jnp.int32)
+        time_planes = jnp.asarray(time_planes, jnp.int32)
+        n_meas = jnp.asarray(n_meas, jnp.int32)
+        M = bit_planes.shape[-1]
+        rec = jnp.arange(M, dtype=jnp.int32) < n_meas[..., None]
+        early = rec & (time_planes
+                       < jnp.asarray(read_time, jnp.int32)[..., None, None])
+        cnt = jnp.sum(early.astype(jnp.int32), axis=-1)
+        slot = jnp.maximum(cnt - 1, 0)
+        sel = (jnp.arange(M, dtype=jnp.int32) == slot[..., None]) \
+            .astype(jnp.int32)
+        bits = jnp.sum(bit_planes * sel, axis=-1)
+        return self(bits), slot

@@ -282,6 +282,48 @@ def _cores_args(mp, meas_bits, mesh, init_regs, cfg):
     return soa, spc, interp, sync_part, meas_bits, init_regs
 
 
+@functools.lru_cache(maxsize=64)
+def _cores_block_executor(mesh, cfg: InterpreterConfig, prog):
+    """GSPMD block-engine executor for ``engine='block'`` under a
+    cores mesh: the block-compiled engine traces as the ordinary
+    single-device computation (``cores_axis`` cleared — block
+    boundary steps gather full-width state, so no shard-local
+    collectives are needed) and XLA partitions it over the sharded
+    inputs (:func:`_run_cores_block` places them ``P('cores')`` /
+    ``P('dp', 'cores')``).  Same trace as the local block engine, so
+    bit-identity with it is by construction; cached per
+    (mesh, cfg, prog) — the static program specializes the block
+    table, exactly like ``_run_batch_blk_jit``'s content key."""
+    from dataclasses import replace
+    lcfg = replace(cfg, cores_axis=None)
+
+    def local(spc, interp, sync_part, mb, ir):
+        counter_inc('cores_trace')
+        out = _run_batch_engine(None, spc, interp, sync_part, mb, lcfg,
+                                int(mb.shape[1]), ir, engine='block',
+                                prog=prog)
+        # drop scalar diagnostics: every remaining leaf is [B, C, ...]
+        out.pop('steps')
+        out.pop('incomplete')
+        out.pop('op_hist', None)
+        return out
+
+    return jax.jit(local,
+                   out_shardings=NamedSharding(mesh, P('dp', 'cores')))
+
+
+def _run_cores_block(mp, mesh, cfg, args):
+    """Place the prepared sharded-cores arguments for GSPMD and run
+    the block executor: per-core constants along 'cores', shot/core
+    batch planes along ('dp', 'cores'), ``sync_part`` replicated."""
+    soa, spc, interp, sync_part, mb, ir = args
+    put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+    return _cores_block_executor(mesh, cfg, _soa_static(mp))(
+        put(spc, P('cores')), put(interp, P('cores')),
+        put(sync_part, P()), put(mb, P('dp', 'cores')),
+        put(ir, P('dp', 'cores')))
+
+
 def sharded_cores_simulate(mp, meas_bits, mesh, init_regs=None,
                            cfg: InterpreterConfig = None, **kw):
     """Run ONE program with its core axis sharded over the mesh
@@ -304,7 +346,10 @@ def sharded_cores_simulate(mp, meas_bits, mesh, init_regs=None,
     cfg, strict = _fault_policy(cfg)
     cfg = _cores_cfg(mp, mesh, cfg)
     args = _cores_args(mp, meas_bits, mesh, init_regs, cfg)
-    out = _cores_executor(mesh, cfg, program_traits(mp))(*args)
+    if resolve_engine(mp, cfg) == 'block':
+        out = _run_cores_block(mp, mesh, cfg, args)
+    else:
+        out = _cores_executor(mesh, cfg, program_traits(mp))(*args)
     return _check_strict(out, strict)
 
 
@@ -323,7 +368,23 @@ def sharded_cores_stat_sums(mp, meas_bits, mesh, init_regs=None,
     cfg = replace(cfg, record_pulses=False)
     cfg = _cores_cfg(mp, mesh, cfg)
     args = _cores_args(mp, meas_bits, mesh, init_regs, cfg)
+    if resolve_engine(mp, cfg) == 'block':
+        return _cores_block_stat_reduce(_run_cores_block(mp, mesh, cfg,
+                                                         args))
     return _cores_stats_executor(mesh, cfg, program_traits(mp))(*args)
+
+
+@jax.jit
+def _cores_block_stat_reduce(out):
+    """``sharded_cores_stat_sums`` reduction for the GSPMD block path:
+    the executor's outputs are already full-width per shard-view, so
+    the sums are plain reductions (XLA inserts the cross-device
+    collectives from the output shardings)."""
+    return dict(
+        pulse_sum=jnp.sum(out['n_pulses'], axis=0),
+        err_shots=jnp.sum(jnp.any(out['err'] != 0, axis=1)),
+        qclk_sum=jnp.sum(out['qclk'], axis=0),
+        fault_shots=fault_shot_counts(out['fault']))
 
 
 def sharded_cores_stats(mp, meas_bits, mesh, init_regs=None,

@@ -273,6 +273,11 @@ class ExecutionService:
         'pallas' / 'generic') for batches that end up with a single
         program: those gain nothing from the multi path, so they may
         ride :func:`simulate_batch` and the full engine ladder instead.
+        Feedback programs (LUT-fabric fproc reads) dispatch on the
+        fast rungs too — the timestamped fabric made their reads
+        dispatch-granularity-invariant, so block/pallas serve them
+        bit-identically (docs/PERF.md "Feedback on the fast
+        engines"); tests/test_fproc_fast.py pins the dispatch.
         ('fused' is rejected at construction: the service dispatches
         injected-bits batches, and the fused measure-in-megastep engine
         only runs physics-closed.)

@@ -85,8 +85,31 @@ def base_fproc(rng) -> tuple:
                                            meas_elem=0)
 
 
+def base_lut(rng) -> tuple:
+    # data cores measure (meas_elem=0: every pulse is a readout); the
+    # last core branches on the parity LUT over them — the timestamped
+    # feedback fabric the fast engines serve (docs/PERF.md "Feedback
+    # on the fast engines")
+    n_prod = int(rng.integers(2, 4))
+    prods = [[_pulse(10 + 5 * c), isa.done_cmd()] for c in range(n_prod)]
+    reader = [isa.idle(100),
+              isa.alu_cmd('jump_fproc', 'i', 1, 'eq', jump_cmd_ptr=3,
+                          func_id=1),
+              isa.jump_i(4),
+              _pulse(400),
+              isa.done_cmd()]
+    C = n_prod + 1
+    table = tuple(((1 << C) - 1) if bin(a).count('1') & 1 else 0
+                  for a in range(1 << n_prod))
+    cfg = InterpreterConfig(max_steps=256, meas_elem=0, fabric='lut',
+                            lut_mask=(True,) * n_prod + (False,),
+                            lut_table=table)
+    return prods + [reader], cfg
+
+
 BASE_BUILDERS = (('linear', base_linear), ('loop', base_loop),
-                 ('sync', base_sync), ('fproc', base_fproc))
+                 ('sync', base_sync), ('fproc', base_fproc),
+                 ('lut', base_lut))
 
 
 # ---------------------------------------------------------------------------
@@ -169,12 +192,18 @@ def mut_drop_sync_partner(rng, cmds, cfg):
 
 
 def mut_starve_fproc(rng, cmds, cfg):
-    """Drop the producer's measurement: a fresh-fabric reader starves."""
-    if cfg.fabric != 'fresh':
+    """Drop the producer's measurement: a fresh-fabric reader starves —
+    and on the LUT fabric a masked producer that finishes without ever
+    measuring starves every table read the same way (the per-slot
+    timestamp planes stay INT32_MAX, so no slot is ever selectable)."""
+    if cfg.fabric not in ('fresh', 'lut'):
         return None
     out = [list(x) for x in cmds]
     done = isa.done_cmd()
-    out[0] = [w for w in out[0] if w == done] or [done]
+    starved = [0] if cfg.fabric == 'fresh' \
+        else [c for c, m in enumerate(cfg.lut_mask) if m]
+    for c in starved:
+        out[c] = [w for w in out[c] if w == done] or [done]
     return Mutant('', out, cfg,
                   frozenset({'fproc_starved', 'budget_exhausted'}))
 
@@ -456,6 +485,69 @@ def check_fused_consistency(seed: int = 0, n: int = 40,
         if a != b:
             failures.append((m.name, {'generic': sorted(a),
                                       'fused': sorted(b)}))
+    return {'checked': checked, 'skipped': skipped, 'failures': failures}
+
+
+def check_feedback_consistency(seed: int = 0, n: int = 24,
+                               shots: int = 4) -> dict:
+    """Cross-check ``generic`` vs ``block`` vs ``pallas`` (interpret
+    mode) on lut+fproc FEEDBACK mutants, timing-independent fault
+    codes only.
+
+    The timestamped fabric makes LUT reads a pure function of the
+    measurement/timestamp planes and the read service time, which is
+    what admitted feedback programs to the fast engines (docs/PERF.md
+    "Feedback on the fast engines") — so on every valid mutant of the
+    lut base the engines must agree on the codes that do not depend on
+    engine step accounting (``_TIMING_INDEPENDENT``; budget/deadlock/
+    starvation are judged per engine by :func:`check_mutant` instead).
+    Measurement bits are (seed, case)-deterministic random draws so
+    the syndrome actually varies.  Mutants an engine is ineligible for
+    and decode/validator rejections are skipped, not failed.  Returns
+    ``{'checked', 'skipped', 'failures'}``; nonempty ``failures`` is a
+    harness failure.
+    """
+    checked = skipped = 0
+    failures = []
+    k = made = 0
+    while made < n:
+        mn, mf = MUTATORS[k % len(MUTATORS)]
+        rng = np.random.default_rng((seed, 5000 + k))
+        cmds, cfg = base_lut(rng)
+        m = mf(rng, cmds, cfg)
+        k += 1
+        if m is None:
+            continue
+        made += 1
+        m.name = f'lut+{mn}#{k - 1}'
+        try:
+            mp = machine_program_from_cmds(m.cmds)
+            validate_program(mp, m.cfg)
+        except (ValueError, OverflowError, ProgramValidationError):
+            skipped += 1
+            continue
+        mb = np.random.default_rng((seed, 6000 + k)).integers(
+            0, 2, (shots, mp.n_cores, m.cfg.max_meas)).astype(np.int32)
+        names = {}
+        try:
+            for eng in ('generic', 'block', 'pallas'):
+                extra = {'pallas_interpret': True} if eng == 'pallas' \
+                    else {}
+                out = simulate_batch(
+                    mp, mb, cfg=replace(m.cfg, engine=eng, **extra))
+                names[eng] = _fault_names(out['fault'])
+        except ValueError as e:
+            if 'ineligible' in str(e):
+                skipped += 1
+                continue
+            failures.append((m.name, f'raised: {e}'))
+            continue
+        checked += 1
+        strict = {eng: nm & _TIMING_INDEPENDENT
+                  for eng, nm in names.items()}
+        if len(set(strict.values())) > 1:
+            failures.append((m.name,
+                             {e: sorted(s) for e, s in strict.items()}))
     return {'checked': checked, 'skipped': skipped, 'failures': failures}
 
 

@@ -596,6 +596,12 @@ def _init_state(batch: int, n_cores: int, cfg: InterpreterConfig,
         **({'op_hist': z(B, C, isa.N_KINDS)}
            if cfg.opcode_histogram else {}),
         meas_avail=jnp.full((B, C, M), INT32_MAX, jnp.int32),
+        # lut fabric: per-slot PRODUCTION clock (the trigger time), the
+        # plane that makes LUT reads time-indexed and therefore
+        # dispatch-granularity-invariant (docs/PERF.md "Feedback on the
+        # fast engines"); meas_avail above is the *distribution* clock
+        **({'meas_time': jnp.full((B, C, M), INT32_MAX, jnp.int32)}
+           if cfg.fabric == 'lut' else {}),
         **({'trace_pc': z(B, C, T), 'trace_time': z(B, C, T),
             'trace_off': z(B, C, T)}
            if cfg.trace else {}),
@@ -728,6 +734,8 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     if any_fproc:
         P_n_meas, P_mavail = _gat(st['n_meas']), _gat(st['meas_avail'])
         P_bits, P_valid = _gat(meas_bits), _gat(meas_valid)
+        if cfg.fabric == 'lut':
+            P_mtime = _gat(st['meas_time'])
 
     if not any_fproc:
         fid_bad = f_race = f_deadlock = f_phys = jnp.zeros((), bool)
@@ -813,8 +821,8 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             _onehot(core0 + jnp.arange(C, dtype=jnp.int32), CF)[None],
             (B, C, CF))
         o_ready, o_data, o_tready, o_dead, o_phys = _fresh_read(own_oh)
-        # func_id >= 1: the masked cores' latest bits form the address;
-        # the read blocks until every masked input's bit is *valid*
+        # func_id >= 1: the masked cores' bits form the address; the
+        # read blocks until every masked input's bit is *valid*
         # (reference: meas_lut.sv LUT_WAIT until (mask & valid) == mask)
         lmask = np.asarray(cfg.lut_mask, dtype=bool)        # [CF] full
         shifts = np.zeros(len(lmask), dtype=np.int32)
@@ -826,18 +834,39 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
             & (P_done[:, None, :]
                | (P_time[:, None, :] >= req[:, :, None]))    # [B, C, CF]
         l_causal = jnp.all(jnp.where(lmask_j[None, None, :], ok, True), -1)
-        oh_last = _onehot(jnp.maximum(P_n_meas - 1, 0), cfg.max_meas)
-        avail_last = _ohsel(jnp.where(P_mavail == INT32_MAX, 0,
-                                      P_mavail), oh_last)           # [B, CF]
-        bit = _ohsel(P_bits, oh_last)                               # [B, CF]
-        valid_last = _ohsel(P_valid.astype(jnp.int32), oh_last)     # [B, CF]
+        # TIME-INDEXED slot select (the property that makes every
+        # dispatch granularity serve the same bit — docs/PERF.md
+        # "Feedback on the fast engines"): per masked producer, the
+        # newest bit PRODUCED strictly before the reader's request.
+        # Strict (<) because a producer whose clock sits exactly at
+        # ``req`` can still fire a trigger at ``req``; once its clock
+        # passes ``req`` the set {m : meas_time[m] < req} is final, so
+        # the count is identical whether the read is served per-step
+        # or replayed later from final planes.  Count 0 (armed before
+        # any production) falls back to slot 0 — the first recorded
+        # bit, fixed once written, guaranteed to exist by causality —
+        # matching the gateware's arm-then-accumulate LUT_WAIT.
+        rec = jnp.arange(cfg.max_meas)[None, None, :] \
+            < P_n_meas[:, :, None]                           # [B, CF, M]
+        early = rec[:, None, :, :] \
+            & (P_mtime[:, None, :, :] < req[:, :, None, None])
+        cnt = jnp.sum(early.astype(jnp.int32), -1)           # [B, C, CF]
+        oh_sel = _onehot(jnp.maximum(cnt - 1, 0), cfg.max_meas)
+        bit = jnp.sum(P_bits[:, None, :, :] * oh_sel, -1)    # [B, C, CF]
+        avail_sel = jnp.sum(jnp.where(P_mavail == INT32_MAX, 0,
+                                      P_mavail)[:, None, :, :] * oh_sel, -1)
+        valid_sel = jnp.sum(
+            P_valid.astype(jnp.int32)[:, None, :, :] * oh_sel, -1)
         l_valid = jnp.all(jnp.where(lmask_j[None, None, :],
-                                    (valid_last == 1)[:, None, :], True), -1)
+                                    valid_sel == 1, True), -1)
         l_ready = l_causal & l_valid
-        t_lut = jnp.max(jnp.where(lmask_j[None, :], avail_last, 0),
-                        axis=-1)                                    # [B]
-        addr = jnp.sum(bit[:, None, :] * lmask_j * (1 << jnp.asarray(shifts)),
-                       -1)                                          # [B, C]
+        # distribution time: the last SELECTED slot's avail over the
+        # mask — per reader now that slots are request-indexed
+        t_lut = jnp.max(jnp.where(lmask_j[None, None, :], avail_sel, 0),
+                        axis=-1)                             # [B, C]
+        addr = jnp.sum(bit * lmask_j[None, None, :]
+                       * (1 << jnp.asarray(shifts))[None, None, :],
+                       -1)                                   # [B, C]
         table = jnp.asarray(cfg.lut_table, jnp.int32)
         entry = _ohsel(table[None, None, :], _onehot(addr, len(table)))
         l_data = (entry >> (core0
@@ -845,8 +874,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
         is_own = fid == 0
         f_ready = jnp.where(is_own, o_ready, l_ready)
         f_data = jnp.where(is_own, o_data, l_data)
-        f_tready = jnp.where(is_own, o_tready,
-                             jnp.maximum(req, t_lut[:, None]))
+        f_tready = jnp.where(is_own, o_tready, jnp.maximum(req, t_lut))
         f_deadlock = is_own & o_dead
         f_phys = jnp.where(is_own, o_phys, l_causal & ~l_valid)
     f_ready = f_ready | fid_bad
@@ -985,6 +1013,13 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
     meas_avail = jnp.where(
         (oh_mslot == 1) & is_meas_pulse[..., None],
         (trig + dur + cfg.meas_latency)[..., None], st['meas_avail'])
+    if 'meas_time' in st:
+        # production clock = trigger time, written exactly once per
+        # slot (CW-horizon below rewrites meas_avail only — the bit's
+        # production instant does not move with its distribution)
+        meas_time = jnp.where(
+            (oh_mslot == 1) & is_meas_pulse[..., None],
+            trig[..., None], st['meas_time'])
     n_meas = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
 
     # ---- physics co-state: device model + meas records -----------------
@@ -1462,6 +1497,7 @@ def _step(st: dict, step_i, soa, spc, interp, sync_part, meas_bits,
                 _stall_sync=stall_sync, pp=pp, n_pulses=n_pulses,
                 n_resets=n_resets, rst_time=rst_time,
                 n_meas=n_meas, meas_avail=meas_avail,
+                **({'meas_time': meas_time} if 'meas_time' in st else {}),
                 **rec_update, **phys_updates, **hist, **tr)
 
 
@@ -1658,17 +1694,25 @@ def straightline_ineligible(mp, cfg: InterpreterConfig) -> str:
     if cfg.physics and cfg.device == 'statevec':
         return 'statevec device (event-ordering gate needs the ' \
                'generic engine)'
+    soa_np = _soa_from_static(_soa_static(mp)) \
+        if cfg.fabric == 'lut' else None
     return _sl_ineligible_fields(np.asarray(mp.soa.kind),
                                  np.asarray(mp.soa.jump_addr),
-                                 np.asarray(mp.soa.func_id), cfg)
+                                 np.asarray(mp.soa.func_id), cfg,
+                                 soa_np)
 
 
 def _sl_ineligible_fields(kind, jump_addr, func_id,
-                          cfg: InterpreterConfig) -> str:
+                          cfg: InterpreterConfig, soa_np=None) -> str:
     """The straight-line SHAPE checks of :func:`straightline_ineligible`
     on packed field arrays — shared with the pallas dispatch, which
     re-derives span-vs-block mode from the jit-static program
-    (:func:`_pallas_mode`) so the two decisions cannot drift."""
+    (:func:`_pallas_mode`) so the two decisions cannot drift.
+
+    ``soa_np``: the full packed ``[C, N, F]`` field array, needed only
+    for the lut-fabric fproc admission (:func:`_lut_span_reject`'s
+    trigger-ordering dataflow); ``None`` conservatively rejects that
+    combination."""
     C, N = kind.shape
     if np.any(kind == isa.K_SYNC):
         return 'SYNC barrier'
@@ -1679,12 +1723,60 @@ def _sl_ineligible_fields(kind, jump_addr, func_id,
         return 'backward jump (loop)'
     fmask = (kind == isa.K_ALU_FPROC) | (kind == isa.K_JUMP_FPROC)
     if np.any(fmask):
-        if cfg.fabric != 'sticky':
+        if cfg.fabric == 'sticky':
+            if np.any(fmask & (func_id != np.arange(C)[:, None])):
+                return 'cross-core fproc read'
+        elif cfg.fabric == 'lut':
+            reason = _lut_span_reject(soa_np, fmask, func_id, cfg)
+            if reason:
+                return reason
+        else:
             return f'fabric {cfg.fabric!r} with fproc reads'
-        if np.any(fmask & (func_id != np.arange(C)[:, None])):
-            return 'cross-core fproc read'
     if np.any(kind[:, -1] != isa.K_DONE):
         return 'program not DONE-terminated'
+    return None
+
+
+def _lut_span_reject(soa_np, fmask, func_id,
+                     cfg: InterpreterConfig) -> str:
+    """Why LUT-fabric fproc reads cannot be served IN-SPAN
+    (straightline / pallas-span / fused) — ``None`` when they can.
+
+    The span serves a LUT read from the carry planes at the read's
+    instruction index with no producer synchronization.  That is
+    bit-identical to the generic per-step serve (a time-indexed count
+    select over the planes, :func:`_step`) exactly when the planes are
+    already FINAL at the read's index: the span's ascending index loop
+    applies every earlier index to every core first, so the condition
+    is that every masked core's **possibly-measurement** trigger sits
+    at a strictly earlier instruction index than every fproc read.
+    Drive triggers never touch the measurement planes, so only
+    possibly-measurement triggers (cfg-nibble possible-values
+    analysis, :func:`_possibly_meas_mask`) constrain the ordering —
+    a syndrome round's feedback *corrections* after the read are fine.
+    Own-fresh reads (``func_id == 0``) keep per-step stall semantics
+    and stay span-ineligible; the block engine hosts them.
+    """
+    if soa_np is None:
+        return "fabric 'lut' with fproc reads"
+    if np.any(fmask & (func_id == 0)):
+        return ("own-fresh fproc read (func_id=0) under fabric 'lut' "
+                "(per-step stall semantics — block engine hosts it)")
+    if cfg.lut_mask is None or cfg.lut_table is None:
+        return "fabric 'lut' with fproc reads but no lut_mask/lut_table"
+    C = fmask.shape[0]
+    lmask = np.asarray(cfg.lut_mask, dtype=bool)
+    if lmask.shape[0] != C:
+        return (f'lut_mask length {lmask.shape[0]} != n_cores {C}')
+    pm = _possibly_meas_mask(soa_np, cfg)
+    if pm is None:
+        return "fabric 'lut' with fproc reads in a looping program"
+    min_read = int(np.min(np.nonzero(fmask)[1]))
+    if np.any(pm[lmask, min_read:]):
+        return ("fabric 'lut': a masked core's possibly-measurement "
+                "trigger at or after an fproc read index (measurement "
+                "planes not final at the span serve; the block engine "
+                "hosts this shape)")
     return None
 
 
@@ -1710,31 +1802,29 @@ def block_ineligible(mp, cfg: InterpreterConfig) -> str:
     Block mode keeps loops, forward/backward jumps, SYNC, cross-core
     fproc reads, and non-DONE termination (the generic boundary step
     handles all of them), so almost everything straightline rejects is
-    fine here.  What it cannot keep:
+    fine here.  Every fabric is eligible: sticky and fresh reads are
+    interleaving-final (once a producer's clock passes the request,
+    nothing it still executes can change the served value —
+    ``MEAS_LATENCY`` > ``STICKY_RACE_MARGIN``), and LUT reads are
+    TIME-INDEXED (per masked producer, the newest bit whose production
+    clock precedes the request — ``meas_time`` plane, docs/PERF.md
+    "Feedback on the fast engines"), a pure function of the planes and
+    the request time, so block-granular producer progress serves
+    bit-identical data by construction.  fproc kinds are block
+    TERMINATORS (:data:`isa.BLOCK_TERMINATORS`), so every read is
+    served by the generic boundary :func:`_step` with gathered fabric
+    state.  What block mode cannot keep:
 
     * trace mode — per-instruction-step trace writes are indexed by the
       step counter, which block mode collapses to iterations;
     * the statevec event-ordering gate — pulse triggers must be globally
-      serialized per instruction step;
-    * the LUT fabric with fproc reads — a LUT read consumes the LATEST
-      bit of every masked producer, so the served value depends on how
-      producer instructions interleave with the read; only per-step
-      dispatch reproduces the reference ordering.  (Sticky and fresh
-      reads are interleaving-final: once a producer's clock passes the
-      request, nothing it still executes can change the served value —
-      ``MEAS_LATENCY`` > ``STICKY_RACE_MARGIN`` — so block-granular
-      producer progress serves bit-identical data.)
+      serialized per instruction step.
     """
-    kind = np.asarray(mp.soa.kind)
     if cfg.trace:
         return 'trace mode records per-instruction-step state'
     if cfg.physics and cfg.device == 'statevec':
         return 'statevec device (event-ordering gate needs the ' \
                'generic engine)'
-    fmask = (kind == isa.K_ALU_FPROC) | (kind == isa.K_JUMP_FPROC)
-    if cfg.fabric == 'lut' and np.any(fmask):
-        return "fabric 'lut' with fproc reads (LUT reads latch the " \
-               "LATEST producer bits — interleaving-sensitive)"
     return None
 
 
@@ -1814,12 +1904,13 @@ def fused_ineligible(mp, cfg: InterpreterConfig) -> str:
                'static length'
     if cfg.trace:
         return 'trace mode records per-step state'
+    soa_np = _soa_from_static(_soa_static(mp))
     reason = _sl_ineligible_fields(np.asarray(mp.soa.kind),
                                    np.asarray(mp.soa.jump_addr),
-                                   np.asarray(mp.soa.func_id), cfg)
+                                   np.asarray(mp.soa.func_id), cfg,
+                                   soa_np)
     if reason:
         return reason
-    soa_np = _soa_from_static(_soa_static(mp))
     mb, _ = _static_meas_bounds(soa_np, cfg)
     if mb is None:
         return 'measurement count not statically boundable'
@@ -1837,28 +1928,38 @@ def cores_ineligible(mp, cfg: InterpreterConfig) -> str:
 
     Sharded execution runs the generic engine inside ``shard_map``
     with the fproc fabric and the sync barrier reading producer-side
-    state through ``lax.all_gather`` over the cores axis
-    (bit-identical to the single-device run by construction).  What
-    the collective step cannot host:
+    state through ``lax.all_gather`` over the cores axis —
+    or, for ``engine='block'``, the block engine under GSPMD: the
+    same single-device trace jitted against cores-sharded inputs, XLA
+    inserting the fabric collectives at the boundary-step gathers
+    (``parallel.sweep`` hosts the executor; bit-identical because the
+    trace IS the single-device block engine).  Both are bit-identical
+    to the single-device run by construction.  What the collective
+    step cannot host:
 
     * physics mode — the epoch resolver pauses host-side between
       epochs and draws global-shape noise streams; the bloch/statevec
       device co-state is not core-separable;
-    * an explicitly forced specialized engine — straightline / block /
-      pallas / fused trace per-program bodies with no collective
-      fabric; only the generic fetch-dispatch step carries the
-      all_gather views;
+    * an explicitly forced PER-SHOT-SPECIALIZED engine — straightline
+      / pallas / fused trace per-program span bodies with no
+      collective fabric (the block engine's boundary ``_step`` is the
+      generic fabric step, so it shards; the span kernels do not);
     * trace mode — the per-step trace export assembles the full core
       axis on one host (a single-device debugging surface).
     """
     if cfg.physics:
         return ('physics mode (the epoch resolver pauses host-side '
                 'between epochs and draws global-shape noise streams)')
-    if cfg.engine not in (None, 'auto', 'generic'):
-        return (f'engine={cfg.engine!r} (the specialized engines trace '
-                f'per-program bodies with no collective fabric — only '
-                f'the generic step reads through the cores-axis '
-                f'all_gather)')
+    if cfg.engine == 'block':
+        reason = block_ineligible(mp, cfg)
+        if reason:
+            return (f"engine='block' under cores_axis but the program "
+                    f'is block-ineligible: {reason}')
+    elif cfg.engine not in (None, 'auto', 'generic'):
+        return (f'engine={cfg.engine!r} (the span-specialized engines '
+                f'trace per-program bodies with no collective fabric — '
+                f'the generic step and the block engine read through '
+                f'the cores-axis gathers)')
     if cfg.straightline:
         return ('straightline=True (emitted straight-line execution '
                 'has no collective fabric)')
@@ -1906,16 +2007,21 @@ def resolve_engine(mp, cfg: InterpreterConfig) -> str:
     """
     eng = cfg.engine
     if cfg.cores_axis is not None:
-        # sharded-cores execution is its own eligibility dimension: the
-        # collective fabric lives only in the generic step body, so a
-        # set cores_axis pins the resolution to 'generic' (or raises
-        # with the blocker, same ladder-naming style as the rungs)
+        # sharded-cores execution is its own eligibility dimension:
+        # the collective fabric lives in the generic step body, which
+        # also serves the block engine's boundary steps — so a set
+        # cores_axis resolves to 'generic', or to 'block' when forced
+        # (GSPMD executor, parallel.sweep), or raises with the
+        # blocker, same ladder-naming style as the rungs.  'auto'
+        # stays on 'generic': the sharded block path pays a gather
+        # per boundary step either way, and the generic step is the
+        # measured baseline (docs/PERF.md "ICI fabric").
         reason = cores_ineligible(mp, cfg)
         if reason:
             raise ValueError(f'cores_axis={cfg.cores_axis!r} but the '
                              f'program/config is ineligible for '
                              f'sharded-cores execution: {reason}')
-        return 'generic'
+        return 'block' if eng == 'block' else 'generic'
     if eng is None:
         return 'straightline' if use_straightline(mp, cfg) else 'generic'
     if eng == 'generic':
@@ -2060,8 +2166,70 @@ def _sl_apply_instr(st: dict, stalled, i: int, N: int, f: dict, spc,
               == np.arange(isa.N_REGS)[None, :]).astype(np.int32)
         return jnp.sum(regs * jnp.asarray(oh)[None], axis=-1)
 
-    # ---- fproc: own-core sticky read (eligibility guarantees) ---
-    if has(m_fproc):
+    # ---- fproc: own-core sticky read, or time-indexed LUT read ---
+    if has(m_fproc) and cfg.fabric == 'lut':
+        # span-lut serve (eligibility: _sl_ineligible_fields requires
+        # every masked core's possibly-measurement triggers at indices
+        # strictly BEFORE every fproc read, so at this index the
+        # bit/timestamp planes are FINAL): the time-indexed count
+        # select over final planes — newest bit per masked producer
+        # with production clock strictly below the request — returns
+        # exactly what the generic per-step serve returns after its
+        # causality stall, with no stall needed (the stall delays the
+        # serve, never the served value).  Fused mode passes its
+        # carry-resident bit/valid planes as the meas args, so the
+        # in-kernel chain joins here unchanged.
+        req = time
+        lmask = np.asarray(cfg.lut_mask, dtype=bool)        # [C]
+        shifts = np.zeros(len(lmask), dtype=np.int32)
+        shifts[lmask] = np.arange(int(lmask.sum()))
+        lmask_j = jnp.asarray(lmask)
+        rec = jnp.arange(cfg.max_meas)[None, None, :] \
+            < st['n_meas'][:, :, None]                       # [B, C, M]
+        early = rec[:, None, :, :] \
+            & (st['meas_time'][:, None, :, :] < req[:, :, None, None])
+        cnt = jnp.sum(early.astype(jnp.int32), -1)           # [B, C, C]
+        oh_sel = _onehot(jnp.maximum(cnt - 1, 0), cfg.max_meas)
+        bit = jnp.sum(meas_bits[:, None, :, :] * oh_sel, -1)
+        avail_sel = jnp.sum(
+            jnp.where(st['meas_avail'] == INT32_MAX, 0,
+                      st['meas_avail'])[:, None, :, :] * oh_sel, -1)
+        valid_sel = jnp.sum(
+            meas_valid.astype(jnp.int32)[:, None, :, :] * oh_sel, -1)
+        l_valid = jnp.all(jnp.where(lmask_j[None, None, :],
+                                    valid_sel == 1, True), -1)
+        t_lut = jnp.max(jnp.where(lmask_j[None, None, :], avail_sel, 0),
+                        axis=-1)                             # [B, C]
+        addr = jnp.sum(bit * lmask_j[None, None, :]
+                       * (1 << jnp.asarray(shifts))[None, None, :], -1)
+        table = jnp.asarray(cfg.lut_table, jnp.int32)
+        entry = _ohsel(table[None, None, :], _onehot(addr, len(table)))
+        f_data = (entry >> jnp.arange(C, dtype=jnp.int32)[None, :]) & 1
+        f_race = jnp.zeros((B, C), bool)
+        f_tready = jnp.maximum(req, t_lut)
+        # a masked producer that retired with NO recorded measurement
+        # starves every reader: the generic engine quiesces and marks
+        # exactly this err/fault pair (_exec_loop / _exec_blocks), with
+        # the reader's pc/time frozen at the read — replicate that
+        # terminal here (the lane leaves `active`, so nothing below
+        # advances it)
+        starved = jnp.any(lmask_j[None, None, :]
+                          & (st['n_meas'][:, None, :] == 0), -1)
+        starve_i = active & j(m_fproc) & starved
+        st['err'] = st['err'] | jnp.where(starve_i,
+                                          ERR_FPROC_DEADLOCK, 0)
+        st['fault'] = st['fault'] | jnp.where(starve_i,
+                                              FAULT_FPROC_STARVED, 0)
+        st['done'] = st['done'] | starve_i
+        active = active & ~starve_i
+        # an invalid SELECTED slot stalls the lane (physics: the epoch
+        # resolver validates it and the next pass resumes) — mirrors
+        # the generic serve's f_phys = l_causal & ~l_valid
+        stall_i = active & j(m_fproc) & ~l_valid
+        stalled = stalled | stall_i
+        active = active & ~stall_i
+    elif has(m_fproc):
+        # own-core sticky read (eligibility guarantees)
         req = time
         mavail, bitsq = st['meas_avail'], meas_bits
         m_cnt = jnp.sum((mavail <= req[..., None]).astype(jnp.int32),
@@ -2073,6 +2241,7 @@ def _sl_apply_instr(st: dict, stalled, i: int, N: int, f: dict, spc,
         f_race = jnp.any(
             (mavail > (req - STICKY_RACE_MARGIN)[..., None])
             & (mavail <= (req + STICKY_RACE_MARGIN)[..., None]), -1)
+        f_tready = time
         f_ready = latest_valid
         stall_i = active & j(m_fproc) & ~f_ready
         stalled = stalled | stall_i
@@ -2185,6 +2354,13 @@ def _sl_apply_instr(st: dict, stalled, i: int, N: int, f: dict, spc,
             err_i = err_i | jnp.where(
                 is_meas_pulse & (env_len == 0xfff), ERR_CW_MEAS, 0)
         st['meas_avail'] = meas_avail
+        if 'meas_time' in st:
+            # production clock (lut fabric): the trigger time, written
+            # once per slot — the CW rewrite above moves only the
+            # distribution clock (meas_avail)
+            st['meas_time'] = jnp.where(
+                (oh_mslot == 1) & is_meas_pulse[..., None],
+                trig[..., None], st['meas_time'])
         st['n_meas'] = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
 
         # ---- physics co-state (parity / bloch; statevec is
@@ -2289,8 +2465,10 @@ def _sl_apply_instr(st: dict, stalled, i: int, N: int, f: dict, spc,
         time_next = jnp.where(j(m_jmpi | m_jcond),
                               time + cfg.jump_cond_clks, time_next)
     if has(m_fproc):
+        # f_tready: the serve time — `time` for the sticky own-core
+        # read, max(request, LUT distribution time) for the lut fabric
         time_next = jnp.where(j(m_fproc),
-                              time + cfg.jump_fproc_clks, time_next)
+                              f_tready + cfg.jump_fproc_clks, time_next)
     st['time'] = jnp.where(active, time_next, time)
     if has(m_incq):
         st['offset'] = jnp.where(active & j(m_incq), time - alu_res,
@@ -2461,6 +2639,13 @@ def _blk_apply_row(st: dict, act, f: dict, spc, interp,
             err_i = err_i | jnp.where(
                 is_meas_pulse & (env_len == 0xfff), ERR_CW_MEAS, 0)
         st['meas_avail'] = meas_avail
+        if 'meas_time' in st:
+            # production clock (lut fabric): the trigger time, written
+            # once per slot — the CW rewrite above moves only the
+            # distribution clock (meas_avail)
+            st['meas_time'] = jnp.where(
+                (oh_mslot == 1) & is_meas_pulse[..., None],
+                trig[..., None], st['meas_time'])
         st['n_meas'] = st['n_meas'] + is_meas_pulse.astype(jnp.int32)
 
         # physics co-state: the SAME helper as _step and the
@@ -2555,7 +2740,8 @@ def _pallas_mode(prog: tuple, cfg: InterpreterConfig) -> str:
     soa_np = _soa_from_static(prog)
     span = _sl_ineligible_fields(soa_np[..., _F['kind']],
                                  soa_np[..., _F['jump_addr']],
-                                 soa_np[..., _F['func_id']], cfg) is None
+                                 soa_np[..., _F['func_id']], cfg,
+                                 soa_np) is None
     return 'span' if span else 'block'
 
 
@@ -2604,22 +2790,17 @@ def _static_pc_width(soa_np):
     return _bl(hi)
 
 
-def _static_meas_bounds(soa_np, cfg: InterpreterConfig):
-    """``(meas_bound, reset_bound)``: per-core worst-case counts of
-    measurement pulses and phase resets one SPAN execution can retire.
-
-    ``reset_bound`` is the static reset-instruction count (each span
-    index retires at most once).  ``meas_bound`` needs dataflow: a
-    trigger is a measurement iff the LATCHED cfg field selects
-    ``cfg.meas_elem``, so we run a forward possible-values analysis of
-    the cfg nibble (init 0; a reg-sourced cfg write is TOP) over the
-    forward-only span CFG.  Returns ``meas_bound=None`` when a
-    backward edge makes the single ascending pass invalid."""
+def _possibly_meas_mask(soa_np, cfg: InterpreterConfig):
+    """``[C, N]`` bool: True where the index is a ``K_PULSE_TRIG``
+    whose LATCHED cfg nibble can select ``cfg.meas_elem`` — a forward
+    possible-values analysis of the cfg nibble (init 0; a reg-sourced
+    cfg write is TOP) over the forward-only span CFG.  A False trigger
+    is PROVABLY a drive pulse: it never touches the measurement
+    planes.  Returns ``None`` when a backward edge makes the single
+    ascending pass invalid."""
     kind = soa_np[..., _F['kind']]
     C, N = kind.shape
-    n_rst = int(max((int(np.sum(kind[c] == isa.K_PULSE_RESET))
-                     for c in range(C)), default=0))
-    bound = 0
+    out = np.zeros((C, N), dtype=bool)
     for c in range(C):
         k = kind[c]
         wen = soa_np[c, :, _F['p_wen']]
@@ -2633,7 +2814,6 @@ def _static_meas_bounds(soa_np, cfg: InterpreterConfig):
             if 0 <= t < N:
                 jump_preds[t].append(int(i))
         outs = [frozenset()] * N   # None = TOP (any nibble)
-        cap = 0
         for i in range(N):
             s, top = (frozenset((0,)), False) if i == 0 \
                 else (frozenset(), False)
@@ -2642,7 +2822,7 @@ def _static_meas_bounds(soa_np, cfg: InterpreterConfig):
                 srcs.append(outs[i - 1])
             for jp in jump_preds[i]:
                 if jp >= i:
-                    return None, n_rst        # backward edge
+                    return None                  # backward edge
                 srcs.append(outs[jp])
             for o in srcs:
                 if o is None:
@@ -2657,8 +2837,26 @@ def _static_meas_bounds(soa_np, cfg: InterpreterConfig):
             if int(k[i]) == isa.K_PULSE_TRIG and (
                     own is None
                     or any((v & 3) == cfg.meas_elem for v in own)):
-                cap += 1
-        bound = max(bound, cap)
+                out[c, i] = True
+    return out
+
+
+def _static_meas_bounds(soa_np, cfg: InterpreterConfig):
+    """``(meas_bound, reset_bound)``: per-core worst-case counts of
+    measurement pulses and phase resets one SPAN execution can retire.
+
+    ``reset_bound`` is the static reset-instruction count (each span
+    index retires at most once).  ``meas_bound`` is the per-core count
+    of possibly-measurement triggers (:func:`_possibly_meas_mask`),
+    ``None`` when a backward edge makes the analysis invalid."""
+    kind = soa_np[..., _F['kind']]
+    C = kind.shape[0]
+    n_rst = int(max((int(np.sum(kind[c] == isa.K_PULSE_RESET))
+                     for c in range(C)), default=0))
+    pm = _possibly_meas_mask(soa_np, cfg)
+    if pm is None:
+        return None, n_rst
+    bound = int(max((int(pm[c].sum()) for c in range(C)), default=0))
     return bound, n_rst
 
 
@@ -2809,6 +3007,13 @@ def carry_packspec(mp, cfg: InterpreterConfig, trim_regs: bool = True,
         st['meas_avail'] = PL(
             trim=mtrim, fill=int(INT32_MAX), widths=w_t,
             sentinel=int(INT32_MAX) if w_t is not None else None)
+        if cfg.fabric == 'lut':
+            # production-clock plane (time-indexed LUT reads): the
+            # same trim/width/sentinel envelope as meas_avail, since
+            # avail = trig + dur + latency >= trig bounds the trigger
+            st['meas_time'] = PL(
+                trim=mtrim, fill=int(INT32_MAX), widths=w_t,
+                sentinel=int(INT32_MAX) if w_t is not None else None)
         st['rst_time'] = PL(trim=tuple(range(rk)) if rk < R else None,
                             widths=w_t)
         if cfg.opcode_histogram:

@@ -116,6 +116,7 @@ class OracleCore:
         self.pulses = []          # emitted pulse dicts
         self.resets = []          # phase-reset times (global)
         self.meas_avail = []      # global times at which bit n becomes valid
+        self.meas_trig = []       # global times at which bit n was PRODUCED
 
     @property
     def qclk(self) -> int:
@@ -181,17 +182,27 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
                 p = cores[i]
                 if not p.meas_avail or not (p.done or p.time >= req):
                     return False, 0, 0
-            # blocks until every masked input's latest bit is valid
-            # (meas_lut.sv LUT_WAIT); addr from the latest measurements
+            # blocks until every masked input holds a valid bit
+            # (meas_lut.sv LUT_WAIT); the served slot is TIME-INDEXED:
+            # per producer, the newest bit PRODUCED strictly before the
+            # read's required time (a producer at time == req can still
+            # fire at trig == req, so the strict compare is what makes
+            # the count final once causality clears).  A reader armed
+            # before any production (count 0) takes slot 0 — the first
+            # recorded bit, fixed once written — matching the
+            # gateware's arm-then-accumulate LUT_WAIT behavior.
             addr = 0
+            slots = []
             for rank, i in enumerate(masked):
-                m = len(cores[i].meas_avail) - 1
+                cnt = sum(1 for t in cores[i].meas_trig if t < req)
+                m = max(cnt, 1) - 1
+                slots.append((i, m))
                 if m >= meas_bits.shape[1]:
                     bit = 0               # zero-pad (see module doc)
                 else:
                     bit = int(meas_bits[i, m])
                 addr |= bit << rank
-            t_lut = max(cores[i].meas_avail[-1] for i in masked)
+            t_lut = max(cores[i].meas_avail[m] for i, m in slots)
             return True, (int(lut_table[addr]) >> c) & 1, max(req, t_lut)
         if func_id >= n_cores:
             core.err.append('fproc_id')
@@ -261,6 +272,7 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
                                          gtime=trig, elem=elem, dur=dur))
                     if elem == meas_elem:
                         c.meas_avail.append(_i32(trig + dur + meas_latency))
+                        c.meas_trig.append(_i32(trig))
                     c.time = _i32(trig + cfg.pulse_load_clks)
                 else:
                     c.time = _i32(c.time + cfg.pulse_regwrite_clks)
@@ -343,4 +355,5 @@ def run_oracle(mp, meas_bits=None, fpga_config=None, fabric: str = 'sticky',
         'done': np.array([c.done for c in cores]),
         'err': [c.err for c in cores],
         'meas_avail': [c.meas_avail for c in cores],
+        'meas_time': [c.meas_trig for c in cores],
     }

@@ -487,8 +487,10 @@ def test_block_ineligibility_raises():
         simulate_batch(mp, np.zeros((4, 1, 2), int),
                        cfg=InterpreterConfig(engine='block', trace=True,
                                              **base))
-    # the LUT fabric latches the LATEST producer bits: with fproc reads
-    # present the program must stay on per-step dispatch
+    # the LUT fabric is BLOCK-ELIGIBLE since the timestamped fproc
+    # fabric (meas_time plane): reads are time-indexed — a pure
+    # function of the planes and the request clock — so the block
+    # boundary step serves them dispatch-granularity-invariantly
     fmp = machine_program_from_cmds([[
         isa.pulse_cmd(cmd_time=100, cfg_word=0, env_word=4096),
         isa.alu_cmd('alu_fproc', 'i', 0, 'eq', write_reg_addr=0,
@@ -497,12 +499,15 @@ def test_block_ineligibility_raises():
     ]])
     lut_cfg = InterpreterConfig(fabric='lut', lut_mask=(True,),
                                 lut_table=(0, 1), **base)
-    assert 'lut' in block_ineligible(fmp, lut_cfg)
+    assert block_ineligible(fmp, lut_cfg) is None
     from dataclasses import replace
-    assert resolve_engine(fmp, replace(lut_cfg, engine='auto')) \
-        == 'generic'
-    with pytest.raises(ValueError, match='lut'):
-        resolve_engine(fmp, replace(lut_cfg, engine='block'))
+    assert resolve_engine(fmp, replace(lut_cfg, engine='block')) \
+        == 'block'
+    # the own-fresh read (func_id=0) under lut keeps per-step stall
+    # semantics: SPAN-ineligible (block hosts it), named as such
+    from distributed_processor_tpu.sim.interpreter import \
+        straightline_ineligible
+    assert 'func_id=0' in straightline_ineligible(fmp, lut_cfg)
 
 
 # ---------------------------------------------------------------------------

@@ -346,12 +346,24 @@ def test_cores_axis_blockers_named():
     base = InterpreterConfig(cores_axis='cores', **kw)
     assert cores_ineligible(mp, base) is None
     assert resolve_engine(mp, base) == 'generic'
+    # engine='block' is cores-ELIGIBLE since the timestamped fproc
+    # fabric: the GSPMD block executor shards the boundary-step
+    # gathers (docs/PERF.md "Feedback on the fast engines"); 'auto'
+    # stays on the generic collective step
+    blk = InterpreterConfig(cores_axis='cores',
+                            **dict(kw, engine='block'))
+    assert cores_ineligible(mp, blk) is None
+    assert resolve_engine(mp, blk) == 'block'
+    assert resolve_engine(
+        mp, InterpreterConfig(cores_axis='cores',
+                              **dict(kw, engine='auto'))) == 'generic'
     for bad, needle in [
-            (dict(engine='block'), 'ineligible'),
+            (dict(engine='pallas'), 'ineligible'),
             (dict(engine='fused'), 'ineligible'),
             (dict(straightline=True), 'ineligible'),
             (dict(trace=True), 'ineligible'),
-            (dict(physics=True), 'epoch resolver')]:
+            (dict(physics=True), 'epoch resolver'),
+            (dict(engine='block', trace=True), 'block-ineligible')]:
         cfg = InterpreterConfig(cores_axis='cores', **dict(kw, **bad))
         reason = cores_ineligible(mp, cfg)
         assert reason, f'{bad} must be cores-ineligible'

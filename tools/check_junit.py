@@ -67,6 +67,13 @@ NO_SKIP_MODULES = {
         'with no hardware dependency — a skip means the cross-process '
         'observability contract (docs/OBSERVABILITY.md "Fleet '
         'observability") stopped being exercised',
+    'test_fproc_fast':
+        'timestamped lut+fproc fabric tests run the fast engines on '
+        'CPU (pallas via interpret mode) and the cores mesh on the '
+        "conftest-forced 8-device host, with no hardware dependency — "
+        'a skip means the feedback bit-identity contract '
+        '(docs/PERF.md "Feedback on the fast engines") stopped being '
+        'exercised',
 }
 
 # the multi-device serve suite may skip ONLY on a genuinely

@@ -2,8 +2,9 @@
 """Fault-injection fuzz driver: no injected defect may be SILENT.
 
 Mutates valid machine programs (bit flips, truncated DONE, dropped
-sync partners, starved fproc, starved budgets, one-slot record
-budgets — see ``sim/faultinject.py``) and asserts every mutant is
+sync partners, starved fproc readers — fresh AND lut-feedback
+fabrics — starved budgets, one-slot record budgets; see
+``sim/faultinject.py``) and asserts every mutant is
 rejected at decode, rejected by the static validator, trapped with a
 correct ``fault_shots`` code by every engine that runs it, or provably
 benign.  Also cross-checks the vmapped multi-program executable and
@@ -11,7 +12,10 @@ the dp=2 mesh-sharded sweep against per-program runs, the fused
 measure-in-megastep engine against the generic engine on
 physics-closed (sigma=0) runs for timing-independent fault codes, and
 the serve-tier differential auditor (``audit_sample=1``) for
-false-positive integrity violations across engine pairs.
+false-positive integrity violations across engine pairs, and the
+generic / block / pallas(interpret) engines against each other on
+lut+fproc feedback mutants (timestamped-fabric invariance,
+docs/PERF.md "Feedback on the fast engines").
 
 Deterministic in ``--seed``: a failing case name (``base+mutator#k``)
 reproduces exactly.  Exit nonzero on any failure — wired into the
@@ -83,6 +87,17 @@ def main(argv=None) -> int:
     for name, detail in fr['failures']:
         print(f'FAILURE: {name}: {detail}')
     failed |= bool(fr['failures'])
+
+    # generic vs block vs pallas(interpret) on lut+fproc feedback
+    # mutants: the timestamped fabric admitted feedback to the fast
+    # engines, so timing-independent fault codes must agree
+    br = fi.check_feedback_consistency(seed=args.seed,
+                                       n=12 if args.quick else 48)
+    print(f'feedback cross-check: {br["checked"]} checked, '
+          f'{br["skipped"]} skipped, {len(br["failures"])} failures')
+    for name, detail in br['failures']:
+        print(f'FAILURE: {name}: {detail}')
+    failed |= bool(br['failures'])
 
     if not args.no_mesh:
         bad = fi.check_mesh_consistency(seed=args.seed,
