@@ -424,6 +424,130 @@ def fproc_feedback_ladder(n_data: int = 3, rounds: int = 6,
     return out
 
 
+def qec_streaming(n_data: int = 3, rounds: int = 32, batch: int = 256,
+                  engine: str = 'auto', chunks: int = 12,
+                  chunk_rounds: int = 8):
+    """Streaming-QEC row (docs/PERF.md "Streaming QEC"): one
+    device-resident R-round scan + in-loop decode
+    (``simulate_rounds``) vs R sequential single-round dispatches on
+    the repetition-code round program, then the same workload served
+    as a streaming traffic class (``StreamSession`` round chunks
+    through an ExecutionService) for rounds/s and per-round tail
+    latency.  Bit-identity — every stat, fault words included, plus
+    in-loop decode vs host decode of the stacked history — is
+    asserted BEFORE any timing; the dispatch-amortization factor
+    (sequential time / scan time, both warm, host-synced per round on
+    the sequential side exactly as a per-round serving loop would
+    pay) is the row's headline and must reach 5x at R>=32 on CPU
+    (BENCH_QEC_MIN_AMORT overrides, 0 disables the gate)."""
+    from dataclasses import replace
+    from distributed_processor_tpu.models.qec import (
+        qec_config, qec_multiround_machine_program,
+        repetition_decode_spec)
+    from distributed_processor_tpu.ops.decode import decode_history
+    from distributed_processor_tpu.serve import ExecutionService
+    from distributed_processor_tpu.sim.interpreter import (
+        resolve_engine, rounds_trace_count, simulate_batch,
+        simulate_rounds)
+    mp = qec_multiround_machine_program(n_data=n_data, rounds=1)
+    cfg = qec_config(n_data, record_pulses=False, engine=engine)
+    dec = repetition_decode_spec(n_data)
+    rng = np.random.default_rng(47)
+    mb = rng.integers(
+        0, 2, (rounds, batch, mp.n_cores, cfg.max_meas)).astype(np.int32)
+    rcfg = replace(cfg, rounds=rounds)
+    out = {'n_data': n_data, 'rounds': rounds, 'batch': batch,
+           'engine': resolve_engine(mp, cfg), 'n_instr': mp.n_instr}
+    # bit-identity gate, before ANY timing: the R-round scan equals R
+    # sequential single-round dispatches on every stat (fault words
+    # included), and the in-loop decode equals the host decode of the
+    # stacked syndrome history
+    scan = {k: np.asarray(v) for k, v in
+            simulate_rounds(mp, mb, cfg=rcfg, decode=dec).items()}
+    seq = [simulate_batch(mp, mb[r], cfg=cfg) for r in range(rounds)]
+    for k in sorted(set(scan) - {'syndrome_hist', 'decoded'}):
+        stacked = np.stack([np.asarray(s[k]) for s in seq])
+        assert stacked.shape == scan[k].shape and \
+            np.array_equal(stacked, scan[k]), \
+            f'rounds scan diverged from sequential dispatches on {k!r}'
+    hist = np.transpose(mb[:, :, :n_data, dec.slot], (1, 0, 2))
+    assert np.array_equal(scan['syndrome_hist'], hist), \
+        'syndrome history does not match the injected meas planes'
+    assert np.array_equal(scan['decoded'],
+                          np.asarray(decode_history(hist, dec.scheme))), \
+        'in-loop decode diverged from host decode of the history'
+    out['bit_identity'] = (f'scan == {rounds} sequential dispatches on '
+                           f'every stat incl fault words; in-loop '
+                           f'decode == host decode')
+
+    # dispatch amortization, both paths warm: the sequential side
+    # host-syncs every round (what a per-round serving loop pays), the
+    # scan side is one dispatch for all R rounds + the decode
+    def t_scan():
+        t0 = time.perf_counter()
+        r = simulate_rounds(mp, mb, cfg=rcfg, decode=dec)
+        jax.block_until_ready(r['decoded'])
+        return time.perf_counter() - t0
+
+    def t_seq():
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            jax.block_until_ready(
+                simulate_batch(mp, mb[r], cfg=cfg)['err'])
+        return time.perf_counter() - t0
+
+    n_tr0 = rounds_trace_count()
+    scan_s = sorted(t_scan() for _ in range(3))[1]
+    seq_s = sorted(t_seq() for _ in range(3))[1]
+    out['scan_retraces'] = rounds_trace_count() - n_tr0
+    assert out['scan_retraces'] == 0, 'warm rounds scan retraced'
+    out['scan_s'] = round(scan_s, 4)
+    out['sequential_s'] = round(seq_s, 4)
+    out['rounds_per_s'] = round(rounds / scan_s, 1)
+    out['amortization'] = round(seq_s / scan_s, 1)
+    min_amort = float(os.environ.get('BENCH_QEC_MIN_AMORT', 5.0))
+    if min_amort and rounds >= 32:
+        assert out['amortization'] >= min_amort, \
+            (f'dispatch amortization {out["amortization"]}x below the '
+             f'{min_amort}x floor at R={rounds}')
+
+    # streaming traffic class: chunked rounds through a StreamSession
+    # over a single-device service — per-round latency distribution
+    # and served rounds/s with the whole serving stack in the loop
+    svc = ExecutionService()
+    try:
+        sess = svc.open_stream(mp, cfg=cfg, decode=dec)
+        shape = (chunk_rounds, batch, mp.n_cores, cfg.max_meas)
+        # warm the chunk-shaped executable before the timed chunks
+        sess.submit_rounds(rng.integers(0, 2, shape).astype(np.int32))
+        next(sess.results(timeout=600))
+        lat = []
+        t_all = time.perf_counter()
+        for _ in range(chunks):
+            cmb = rng.integers(0, 2, shape).astype(np.int32)
+            t0 = time.perf_counter()
+            sess.submit_rounds(cmb).result(timeout=600)
+            lat.append((time.perf_counter() - t0) / chunk_rounds)
+        wall = time.perf_counter() - t_all
+        summary = sess.close(timeout=600)
+        assert summary['failed_chunks'] == 0
+        assert summary['decoded'].shape == (batch, n_data)
+    finally:
+        svc.shutdown()
+    lat_ms = np.asarray(lat) * 1e3
+    out['stream'] = {
+        'chunks': chunks, 'chunk_rounds': chunk_rounds,
+        'rounds_per_s': round(chunks * chunk_rounds / wall, 1),
+        'round_p50_ms': round(float(np.percentile(lat_ms, 50)), 3),
+        'round_p99_ms': round(float(np.percentile(lat_ms, 99)), 3),
+    }
+    out['note'] = ('amortization = R host-synced single-round '
+                   'dispatches vs one R-round scan+decode dispatch, '
+                   'both warm; stream numbers pay the full serving '
+                   'stack per chunk')
+    return out
+
+
 def engine_ladder(n_qubits: int, depth: int, batch: int = 256):
     """Engine-ladder row (docs/PERF.md "The engine ladder"): outer-loop
     iteration counts and warm per-batch times for the generic
@@ -1102,16 +1226,68 @@ def _preflight(timeouts=(30.0, 60.0, 120.0)):
     (a dead axon tunnel blocks forever inside backend init, which would
     otherwise stall the whole bench run silently).
 
-    Retries with backoff before giving up: a transient tunnel blip on
-    the first probe must not zero an entire round's perf artifact.  The
-    error JSON is emitted only after EVERY attempt fails, and carries
-    the full per-attempt record (outcome, elapsed, error, and the probe
-    STAGE that was in flight — device_init / allocate / compute) so a
-    tunnel that dies during backend bring-up is distinguishable from
-    one that enumerates devices but hangs the first real dispatch.
-    Returns the attempt record on success for the detail dict.
+    Two layers of protection.  The attempt loop
+    (:func:`_preflight_attempts`) retries with backoff and per-attempt
+    probe timeouts; the error JSON is emitted only after EVERY attempt
+    fails, with the full per-attempt record (outcome, elapsed, error,
+    and the probe STAGE in flight — device_init / allocate / compute).
+    Above it, a HARD watchdog (``BENCH_PREFLIGHT_TIMEOUT`` seconds,
+    default the attempt budget + 60) bounds the whole preflight: the
+    per-attempt timeouts cannot catch a hang OUTSIDE the probe thread
+    (backend plugin import, thread creation under a wedged runtime —
+    ``BENCH_PREFLIGHT_HANG=1`` provokes it in tests), so on expiry the
+    watchdog abandons the attempt loop, records a synthetic
+    ``stage='watchdog'`` attempt, and degrades to the CPU self-rerun
+    (exit 0, ``"degraded": true``) exactly like an ordinary preflight
+    failure.  Returns the attempt record on success for the detail
+    dict.
     """
     import threading
+    budget = float(os.environ.get('BENCH_PREFLIGHT_TIMEOUT',
+                                  sum(timeouts) + 60.0))
+    done = threading.Event()
+    box = []                    # [attempts] when the loop finished
+    worker = threading.Thread(
+        target=lambda: (box.append(_preflight_attempts(timeouts)),
+                        done.set()),
+        daemon=True)
+    worker.start()
+    if done.wait(budget) and box:
+        attempts = box[0]
+        if attempts and attempts[-1].get('ok'):
+            return attempts
+    else:
+        attempts = [{'attempt': 0, 'ok': False, 'stage': 'watchdog',
+                     'elapsed_s': round(budget, 3),
+                     'error': (f'preflight exceeded the hard watchdog '
+                               f'BENCH_PREFLIGHT_TIMEOUT={budget:g}s '
+                               f'(hung outside the probe thread)')}]
+        print(f'preflight watchdog fired after {budget:g}s',
+              file=sys.stderr)
+    if not os.environ.get('BENCH_DEGRADED'):
+        _degraded_rerun(attempts)   # execs a CPU child; exits 0 on success
+    print(json.dumps({
+        'metric': 'shots/sec/chip, 8q active-reset+RB, physics-closed '
+                  '(synth+demod+discriminate in-loop)',
+        'value': 0, 'unit': 'shots/s', 'vs_baseline': 0,
+        'detail': {'error': attempts[-1]['error'],
+                   'preflight_attempts': attempts},
+    }), flush=True)
+    os._exit(2)
+
+
+def _preflight_attempts(timeouts):
+    """The preflight attempt loop (see :func:`_preflight`): probes the
+    backend with per-attempt timeouts and backoff.  Always returns the
+    full attempt record — the LAST entry's ``ok`` says whether the
+    backend came up; the caller owns the failure path (degraded rerun
+    or error JSON)."""
+    import threading
+    if os.environ.get('BENCH_PREFLIGHT_HANG'):
+        # test hook: a hang the per-attempt machinery CANNOT see (the
+        # wedge is before any probe thread exists) — only the outer
+        # watchdog catches this
+        threading.Event().wait()
     attempts = []
     for n, timeout_s in enumerate(timeouts, start=1):
         done = threading.Event()
@@ -1163,16 +1339,7 @@ def _preflight(timeouts=(30.0, 60.0, 120.0)):
                 f'(hung in probe stage {stage[0]!r} — tunnel down?)')})
         print(f'preflight attempt {n}/{len(timeouts)} failed: '
               f'{attempts[-1]["error"]}', file=sys.stderr)
-    if not os.environ.get('BENCH_DEGRADED'):
-        _degraded_rerun(attempts)       # execs a CPU child; exits 0 on success
-    print(json.dumps({
-        'metric': 'shots/sec/chip, 8q active-reset+RB, physics-closed '
-                  '(synth+demod+discriminate in-loop)',
-        'value': 0, 'unit': 'shots/s', 'vs_baseline': 0,
-        'detail': {'error': attempts[-1]['error'],
-                   'preflight_attempts': attempts},
-    }), flush=True)
-    os._exit(2)
+    return attempts
 
 
 def _degraded_rerun(attempts):
@@ -1187,8 +1354,9 @@ def _degraded_rerun(attempts):
     error JSON + exit 2) when it does not."""
     import subprocess
     env = dict(os.environ, JAX_PLATFORMS='cpu', BENCH_DEGRADED='1')
-    # the forced-failure test hook must not fail the CPU child too
+    # the forced-failure/hang test hooks must not fail the CPU child too
     env.pop('BENCH_PREFLIGHT_FAIL', None)
+    env.pop('BENCH_PREFLIGHT_HANG', None)
     # CPU-sized defaults (only where the caller didn't pin a value):
     # the accelerator shapes are hours on a CPU
     for k, v in (('BENCH_SHOTS', '2048'), ('BENCH_BATCH', '1024'),
@@ -1233,7 +1401,13 @@ def _degraded_rerun(attempts):
                  # gate are shape-independent
                  ('BENCH_FEEDBACK_ROUNDS', '4'),
                  ('BENCH_FEEDBACK_CORR', '12'),
-                 ('BENCH_FEEDBACK_SHOTS', '64')):
+                 ('BENCH_FEEDBACK_SHOTS', '64'),
+                 # qec_streaming row at CPU size: R stays 32 so the
+                 # amortization floor is measured for real, the batch
+                 # and chunk counts shrink
+                 ('BENCH_QEC_SHOTS', '64'),
+                 ('BENCH_QEC_ROUNDS', '32'),
+                 ('BENCH_QEC_CHUNKS', '6')):
         env.setdefault(k, v)
     print('preflight failed on the accelerator backend; rerunning the '
           'bench DEGRADED on CPU (JAX_PLATFORMS=cpu)', file=sys.stderr)
@@ -2123,6 +2297,29 @@ def main():
         ici_row = None
     artifact.row('ici_fabric', ici_row)
 
+    # streaming-QEC row: one device-resident R-round scan + in-loop
+    # decode vs R sequential dispatches (bit-identity gated before
+    # timing, amortization floor asserted at R>=32), plus the
+    # StreamSession serving numbers (BENCH_QEC_* knobs;
+    # BENCH_QEC_SHOTS=0 skips it)
+    if secondaries and int(os.environ.get('BENCH_QEC_SHOTS', 256)):
+        try:
+            qec_row = _timed_row(lambda: qec_streaming(
+                n_data=int(os.environ.get('BENCH_QEC_DATA', 3)),
+                rounds=int(os.environ.get('BENCH_QEC_ROUNDS', 32)),
+                batch=int(os.environ.get('BENCH_QEC_SHOTS', 256)),
+                engine=os.environ.get('BENCH_QEC_ENGINE', 'auto'),
+                chunks=int(os.environ.get('BENCH_QEC_CHUNKS', 12)),
+                chunk_rounds=int(
+                    os.environ.get('BENCH_QEC_CHUNK_ROUNDS', 8))))
+        except _RowTimeout as e:
+            qec_row = {'error': 'timeout', 'detail': str(e)}
+        except Exception as e:  # pragma: no cover - defensive
+            qec_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+    else:
+        qec_row = None
+    artifact.row('qec_streaming', qec_row)
+
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
     result = {
@@ -2178,6 +2375,7 @@ def main():
             'fleet_observability_overhead': fleet_obs_row,
             'integrity_overhead': integrity_row,
             'ici_fabric': ici_row,
+            'qec_streaming': qec_row,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

@@ -586,6 +586,80 @@ def cmd_warmup(args):
                       'jax_cache': args.jax_cache}))
 
 
+def cmd_qec_stream(args):
+    """Streaming-QEC driver (docs/SERVING.md "Streaming sessions"):
+    run R rounds of the repetition (or surface-cycle-shaped) QEC
+    workload either as round chunks through a ``StreamSession`` — each
+    chunk ONE device-resident scan dispatch with the decoder in the
+    loop (``--stream``, the default) — or as R sequential single-round
+    dispatches with a host-side decode (``--per-round``), printing the
+    decoded corrections summary and wall time as JSON so the two modes
+    are directly comparable."""
+    import time
+    from dataclasses import replace
+    from .models.qec import (qec_config, qec_multiround_machine_program,
+                             repetition_decode_spec,
+                             surface_cycle_config,
+                             surface_cycle_machine_program,
+                             surface_decode_spec)
+    from .ops.decode import decode_history
+    from .sim.interpreter import simulate_batch
+    if args.surface:
+        mp = surface_cycle_machine_program(args.distance)
+        cfg = surface_cycle_config(args.distance)
+        dec = surface_decode_spec(args.distance)
+    else:
+        mp = qec_multiround_machine_program(n_data=args.distance,
+                                            rounds=1)
+        cfg = qec_config(args.distance)
+        dec = repetition_decode_spec(args.distance)
+    cfg = replace(cfg, record_pulses=False,
+                  **({'engine': args.engine} if args.engine else {}))
+    rng = np.random.default_rng(args.key)
+    mb = rng.integers(0, 2, (args.rounds, args.shots, mp.n_cores,
+                             cfg.max_meas)).astype(np.int32)
+    t0 = time.perf_counter()
+    if args.per_round:
+        for r in range(args.rounds):
+            np.asarray(simulate_batch(mp, mb[r], cfg=cfg)['err'])
+        hist = np.transpose(mb[:, :, list(dec.cores), dec.slot],
+                            (1, 0, 2))
+        decoded = np.asarray(decode_history(hist, dec.scheme))
+        mode = (f'{args.rounds} per-round dispatches + host decode '
+                f'(--per-round)')
+        chunks = args.rounds
+    else:
+        from .serve import ExecutionService
+        svc = ExecutionService()
+        try:
+            with svc.open_stream(mp, cfg=cfg, decode=dec) as sess:
+                for i in range(0, args.rounds, args.chunk):
+                    sess.submit_rounds(mb[i:i + args.chunk])
+                summary = sess.close(timeout=600)
+        finally:
+            svc.shutdown()
+        decoded = summary['decoded']
+        chunks = summary['chunks']
+        mode = (f'streaming session: {chunks} chunk dispatches of '
+                f'<= {args.chunk} rounds, decoder in the loop '
+                f'(--stream)')
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        'mode': mode,
+        'scheme': dec.scheme,
+        'distance': args.distance,
+        'rounds': args.rounds,
+        'shots': args.shots,
+        'dispatches': chunks,
+        'engine': cfg.engine,
+        'wall_s': round(dt, 3),
+        'rounds_per_s': round(args.rounds / dt, 1),
+        'corrected_shots': int((decoded.sum(axis=-1) > 0).sum()),
+        'mean_correction_weight':
+            round(float(decoded.sum(axis=-1).mean()), 4),
+    }, indent=2))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog='dproc-tpu',
                                  description=__doc__.split('\n')[0])
@@ -888,6 +962,31 @@ def main(argv=None):
                    help='persistent XLA compilation cache dir to '
                         'populate (point the server at the same dir)')
     p.set_defaults(fn=cmd_warmup)
+
+    p = sub.add_parser('qec-stream',
+                       help='R-round QEC with the decoder in the loop: '
+                            'one streaming scan dispatch per chunk vs '
+                            'R per-round dispatches')
+    p.add_argument('--rounds', type=int, default=32)
+    p.add_argument('--distance', type=int, default=3,
+                   help='code distance (data qubits for the repetition '
+                        'workload)')
+    p.add_argument('--engine', choices=['generic', 'block',
+                                        'straightline', 'pallas'],
+                   help='pin the interpreter engine (default: auto)')
+    p.add_argument('--shots', type=int, default=256)
+    p.add_argument('--chunk', type=int, default=8,
+                   help='rounds per streaming chunk (one dispatch each)')
+    p.add_argument('--per-round', action='store_true',
+                   help='dispatch every round separately and decode on '
+                        'the host (the baseline --stream amortizes)')
+    p.add_argument('--surface', action='store_true',
+                   help='surface-code-cycle-shaped workload (ancilla '
+                        'syndrome cores + chain matching) instead of '
+                        'the repetition rounds')
+    p.add_argument('--key', type=int, default=7,
+                   help='seed for the injected measurement planes')
+    p.set_defaults(fn=cmd_qec_stream)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')
     p.add_argument('program')

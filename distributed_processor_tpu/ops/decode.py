@@ -1,0 +1,176 @@
+"""In-loop QEC decoders over syndrome histories.
+
+Pure-``jnp`` decoders the rounds-scan entry point
+(:func:`~..sim.interpreter.simulate_rounds`) invokes INSIDE the same
+jit as the R-round execution scan, so R rounds of syndrome extraction
+plus the logical decode are one dispatch (docs/PERF.md "Streaming
+QEC").  Everything here is shape-polymorphic over leading batch axes
+and engine-invariant by construction: the inputs are integer bit
+planes and every op is an elementwise/reduction composition with no
+data-dependent control flow.
+
+Two schemes, matching the two workload layouts in ``models/qec.py``:
+
+* ``'majority'`` — repetition-code rounds where every DATA core
+  measures its own qubit each round: a per-qubit majority vote over
+  the round axis denoises the readout stream, then the pattern
+  majority picks the correction (the vectorized equivalent of the
+  ``majority_lut`` table the fproc fabric applies per round).
+* ``'matching'`` — surface-code-cycle-shaped rounds where ANCILLA
+  cores measure the syndrome: a per-ancilla majority over rounds
+  denoises measurement errors, then an exact minimum-weight matching
+  on the repetition chain (the "union-find-lite" decoder — on a chain
+  graph the union-find and MWPM decoders coincide and have a closed
+  form) produces the data-qubit correction.
+
+The NumPy ``*_np`` twins are the host-side oracles: brute-force
+min-weight search for the chain decoder and the literal LUT-table
+walk for the majority decoder, pitted against the ``jnp`` decoders by
+the seeded fuzz in tests/test_qec_stream.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax.numpy as jnp
+
+DECODE_SCHEMES = ('majority', 'matching')
+
+
+@dataclass(frozen=True)
+class DecodeSpec:
+    """Static description of the in-loop decode: which cores' injected
+    measurement bits form the syndrome history and how to decode it.
+    Frozen/hashable so it rides the jit cache key as a static argument
+    (same contract as :class:`~..sim.interpreter.InterpreterConfig`).
+
+    ``scheme``: one of :data:`DECODE_SCHEMES`.
+    ``cores``: tuple of core indices whose bits are the history
+    (data cores for 'majority', ancilla cores for 'matching').
+    ``slot``: which per-round measurement slot to read (the round
+    programs in ``models/qec.py`` measure once per round -> slot 0).
+    """
+    scheme: str
+    cores: tuple
+    slot: int = 0
+
+    def __post_init__(self):
+        if self.scheme not in DECODE_SCHEMES:
+            raise ValueError(f'decode scheme must be one of '
+                             f'{DECODE_SCHEMES}; got {self.scheme!r}')
+        if not self.cores:
+            raise ValueError('DecodeSpec.cores must name >= 1 core')
+        object.__setattr__(self, 'cores',
+                           tuple(int(c) for c in self.cores))
+
+
+def as_decode_spec(decode) -> DecodeSpec:
+    """Coerce a :class:`DecodeSpec`, ``(scheme, cores, slot)`` tuple,
+    or mapping into a validated :class:`DecodeSpec`."""
+    if decode is None:
+        raise ValueError('decode is None')
+    if isinstance(decode, DecodeSpec):
+        return decode
+    if isinstance(decode, dict):
+        return DecodeSpec(**decode)
+    return DecodeSpec(*decode)
+
+
+def majority_vote(hist):
+    """Per-position majority over the round axis: ``hist``
+    ``[..., R, K]`` -> ``[..., K]``.  Strict majority (``2*count > R``,
+    ties -> 0), the same convention as
+    :func:`~..models.repetition.majority_lut`."""
+    hist = jnp.asarray(hist, jnp.int32)
+    return (2 * jnp.sum(hist, axis=-2) > hist.shape[-2]) \
+        .astype(jnp.int32)
+
+
+def bit_majority_correction(bits):
+    """Pattern-majority correction: ``bits`` ``[..., K]`` ->
+    ``[..., K]`` with bit i set iff position i disagrees with the
+    majority of the pattern — the vectorized ``majority_lut`` entry."""
+    bits = jnp.asarray(bits, jnp.int32)
+    maj = (2 * jnp.sum(bits, axis=-1, keepdims=True)
+           > bits.shape[-1]).astype(jnp.int32)
+    return (bits != maj).astype(jnp.int32)
+
+
+def chain_matching(synd):
+    """Exact minimum-weight matching on the repetition chain:
+    ``synd`` ``[..., A]`` (ancilla i checks data qubits i and i+1) ->
+    correction ``[..., A+1]``.
+
+    Any error pattern ``e`` on the chain with ``s_i = e_i ^ e_{i+1}``
+    is determined by its first bit: ``e_{i+1} = e_0 ^ (s_0^...^s_i)``.
+    So there are exactly TWO syndrome-consistent candidates — the
+    prefix-parity pattern anchored at ``e_0 = 0`` and its complement —
+    and min-weight decoding picks the lighter one (ties -> the
+    ``e_0 = 0`` branch, the same anchor :func:`chain_matching_np`'s
+    enumeration order tie-breaks to).  This closed form IS the
+    union-find/MWPM
+    decoder on a chain, with no iteration to port into the jit."""
+    synd = jnp.asarray(synd, jnp.int32)
+    prefix = jnp.cumsum(synd, axis=-1) % 2
+    e0 = jnp.concatenate(
+        [jnp.zeros(synd.shape[:-1] + (1,), jnp.int32), prefix], axis=-1)
+    e1 = 1 - e0
+    lighter0 = jnp.sum(e0, axis=-1, keepdims=True) \
+        <= jnp.sum(e1, axis=-1, keepdims=True)
+    return jnp.where(lighter0, e0, e1).astype(jnp.int32)
+
+
+def decode_history(hist, scheme: str):
+    """Decode a syndrome history ``[..., R, K]`` under ``scheme``.
+
+    ``'majority'``: per-qubit round-majority then pattern-majority
+    correction -> ``[..., K]`` (K data qubits).
+    ``'matching'``: per-ancilla round-majority then chain matching ->
+    ``[..., K+1]`` (K ancillas check K+1 data qubits).
+    """
+    if scheme == 'majority':
+        return bit_majority_correction(majority_vote(hist))
+    if scheme == 'matching':
+        return chain_matching(majority_vote(hist))
+    raise ValueError(f'decode scheme must be one of {DECODE_SCHEMES}; '
+                     f'got {scheme!r}')
+
+
+# ---------------------------------------------------------------------------
+# NumPy oracles (host-side; the fuzz reference + LUT table builders)
+# ---------------------------------------------------------------------------
+
+def chain_matching_np(synd) -> np.ndarray:
+    """Brute-force oracle for :func:`chain_matching` on ONE syndrome
+    ``[A]``: search all ``2^(A+1)`` error patterns for the minimum
+    weight one consistent with the syndrome.  Patterns are enumerated
+    with data qubit 0 in the high bit, so the first min-weight hit —
+    the tie-break — is the candidate with qubit 0 clear, the same
+    anchor the closed form picks.  Exponential on purpose — it shares
+    no structure with the closed form it checks."""
+    synd = np.asarray(synd, np.int32)
+    n = synd.shape[-1] + 1
+    best, best_w = None, n + 1
+    for pattern in range(1 << n):
+        e = np.array([(pattern >> (n - 1 - i)) & 1 for i in range(n)],
+                     np.int32)
+        if np.array_equal(e[:-1] ^ e[1:], synd):
+            w = int(e.sum())
+            if w < best_w:
+                best, best_w = e, w
+    return best
+
+
+def majority_correction_np(bits) -> np.ndarray:
+    """LUT-walk oracle for :func:`bit_majority_correction` on ONE
+    pattern ``[K]``: index the literal
+    :func:`~..models.repetition.majority_lut` table — the exact entry
+    the fproc fabric serves per round."""
+    from ..models.repetition import majority_lut
+    bits = np.asarray(bits, np.int32)
+    k = bits.shape[-1]
+    addr = int(sum(int(b) << i for i, b in enumerate(bits)))
+    entry = majority_lut(k)[addr]
+    return np.array([(entry >> i) & 1 for i in range(k)], np.int32)

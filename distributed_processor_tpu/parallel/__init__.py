@@ -3,7 +3,8 @@ from .driver import run_physics_sweep, run_multi_sweep, run_cores_sweep
 from .sweep import (sharded_simulate, sweep_stats, sweep_stat_sums,
                     sharded_demod, sharded_physics_stats,
                     sharded_physics_stat_sums, sharded_multi_stats,
-                    sharded_cores_simulate, sharded_cores_stat_sums,
+                    sharded_cores_simulate, sharded_cores_rounds,
+                    sharded_cores_stat_sums,
                     sharded_cores_stats, run_spanned)
 from .param_sweep import (swept_pulse_machine_program, grid_init_regs,
                           sweep_cfg, AMP_REG, FREQ_REG)

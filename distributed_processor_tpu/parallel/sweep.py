@@ -39,7 +39,7 @@ from ..sim.interpreter import (InterpreterConfig, _program_constants,
                                _soa_static, resolve_engine, carry_packspec,
                                use_packed_carry, fault_shot_counts,
                                program_traits, _fault_policy,
-                               _check_strict)
+                               _check_strict, _check_single_round)
 from ..utils.profiling import counter_inc
 
 
@@ -128,6 +128,7 @@ def sweep_stat_sums(mp, meas_bits, mesh, init_regs=None,
     """
     from dataclasses import replace
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    _check_single_round(cfg)
     # statistics only ever reduce n_pulses/err/qclk — don't carry the
     # [B, C, 9*max_pulses] record state through the while_loop
     cfg = replace(cfg, record_pulses=False)
@@ -343,6 +344,7 @@ def sharded_cores_simulate(mp, meas_bits, mesh, init_regs=None,
     """
     from dataclasses import replace
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    _check_single_round(cfg)
     cfg, strict = _fault_policy(cfg)
     cfg = _cores_cfg(mp, mesh, cfg)
     args = _cores_args(mp, meas_bits, mesh, init_regs, cfg)
@@ -363,6 +365,7 @@ def sharded_cores_stat_sums(mp, meas_bits, mesh, init_regs=None,
     outputs (``out_specs=P()``)."""
     from dataclasses import replace
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    _check_single_round(cfg)
     # statistics only ever reduce n_pulses/err/qclk — don't carry the
     # [B, C, 9*max_pulses] record state through the while_loop
     cfg = replace(cfg, record_pulses=False)
@@ -372,6 +375,113 @@ def sharded_cores_stat_sums(mp, meas_bits, mesh, init_regs=None,
         return _cores_block_stat_reduce(_run_cores_block(mp, mesh, cfg,
                                                          args))
     return _cores_stats_executor(mesh, cfg, program_traits(mp))(*args)
+
+
+# sharded-cores rounds scan: the streaming-QEC round axis (leading,
+# replicated — every shard scans the same round schedule over its own
+# shot/core tile) composes with the ('dp', 'cores') layout
+_CORES_ROUNDS_SPECS = (P('cores'), P('cores'), P('cores'), P(),
+                       P(None, 'dp', 'cores'), P('dp', 'cores'))
+
+
+@functools.lru_cache(maxsize=64)
+def _cores_rounds_executor(mesh, cfg: InterpreterConfig, traits):
+    """R-round scan around the sharded-cores local: each scan step is
+    exactly the :func:`_cores_executor` local body (bit-identity per
+    round by construction), with the round axis carried by the scan so
+    R rounds on the mesh are still ONE dispatch."""
+
+    def local(soa, spc, interp, sync_part, mb, ir):
+        counter_inc('cores_trace')
+
+        def body(carry, mbr):
+            out = _run_batch(soa, spc, interp, sync_part, mbr, cfg,
+                             int(soa.shape[0]), ir, traits)
+            out.pop('steps')
+            out.pop('incomplete')
+            out.pop('op_hist', None)
+            return carry, out
+
+        _, st = jax.lax.scan(body, jnp.int32(0), mb)
+        return st
+
+    fn = shard_map(local, mesh=mesh, in_specs=_CORES_ROUNDS_SPECS,
+                   out_specs=P(None, 'dp', 'cores'), check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _cores_rounds_block_executor(mesh, cfg: InterpreterConfig, prog):
+    """GSPMD block-engine rounds executor: the scan body is the same
+    single-device block trace :func:`_cores_block_executor` runs, and
+    XLA partitions each step over the sharded inputs."""
+    from dataclasses import replace
+    lcfg = replace(cfg, cores_axis=None, rounds=1)
+
+    def local(spc, interp, sync_part, mb, ir):
+        counter_inc('cores_trace')
+
+        def body(carry, mbr):
+            out = _run_batch_engine(None, spc, interp, sync_part, mbr,
+                                    lcfg, int(mbr.shape[1]), ir,
+                                    engine='block', prog=prog)
+            out.pop('steps')
+            out.pop('incomplete')
+            out.pop('op_hist', None)
+            return carry, out
+
+        _, st = jax.lax.scan(body, jnp.int32(0), mb)
+        return st
+
+    return jax.jit(
+        local, out_shardings=NamedSharding(mesh, P(None, 'dp', 'cores')))
+
+
+def sharded_cores_rounds(mp, meas_bits, mesh, init_regs=None,
+                         cfg: InterpreterConfig = None, **kw):
+    """R rounds of :func:`sharded_cores_simulate` in ONE dispatch:
+    ``meas_bits`` is ``[rounds, n_shots, n_cores, n_meas]`` and a
+    ``lax.scan`` over the leading round axis runs the sharded-cores
+    body once per round — the mesh composition of
+    :func:`~..sim.interpreter.simulate_rounds` (docs/PERF.md
+    "Streaming QEC"), for codes too wide for one device.  Each round
+    starts from a fresh init state with that round's injected bits;
+    ``init_regs`` is shared across rounds.  Returns the
+    :func:`sharded_cores_simulate` pytree with a leading round axis on
+    every leaf, sharded ``P(None, 'dp', 'cores')``."""
+    from dataclasses import replace
+    cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    cfg, strict = _fault_policy(cfg)
+    meas_bits = jnp.asarray(meas_bits, jnp.int32)
+    if meas_bits.ndim != 4 or meas_bits.shape[2] != mp.n_cores:
+        raise ValueError(
+            f'meas_bits must be [rounds, n_shots, n_cores='
+            f'{mp.n_cores}, n_meas]; got {tuple(meas_bits.shape)}')
+    R = int(meas_bits.shape[0])
+    if cfg.rounds != 1 and cfg.rounds != R:
+        raise ValueError(
+            f'cfg.rounds={cfg.rounds} contradicts the meas_bits round '
+            f'axis {R}')
+    cfg = replace(cfg, rounds=R)
+    cfg = _cores_cfg(mp, mesh, cfg)
+    soa, spc, interp, sync_part = _program_constants(mp, cfg)
+    meas_bits = _pad_meas(meas_bits, cfg.max_meas)
+    n_shots = meas_bits.shape[1]
+    n_dp = mesh.shape['dp']
+    if n_shots % n_dp:
+        raise ValueError(f'{n_shots} shots not divisible by dp={n_dp}')
+    init_regs = _shotwise_init_regs(init_regs, n_shots, mp.n_cores)
+    if resolve_engine(mp, cfg) == 'block':
+        put = lambda x, spec: jax.device_put(x, NamedSharding(mesh,
+                                                              spec))
+        out = _cores_rounds_block_executor(mesh, cfg, _soa_static(mp))(
+            put(spc, P('cores')), put(interp, P('cores')),
+            put(sync_part, P()), put(meas_bits, P(None, 'dp', 'cores')),
+            put(init_regs, P('dp', 'cores')))
+    else:
+        out = _cores_rounds_executor(mesh, cfg, program_traits(mp))(
+            soa, spc, interp, sync_part, meas_bits, init_regs)
+    return _check_strict(out, strict)
 
 
 @jax.jit

@@ -41,6 +41,7 @@ from .router import (ROUTER_THREAD_PREFIX, FleetRouter,
 from .service import (CANARY_THREAD_PREFIX, DISPATCH_THREAD_PREFIX,
                       SUPERVISE_THREAD_PREFIX, WARMUP_THREAD_PREFIX,
                       ExecutionService)
+from .stream import StreamKey, StreamSession
 from .supervise import (HEALTH_LIVE, HEALTH_PROBING,
                         HEALTH_QUARANTINED, CircuitBreaker, RetryPolicy)
 from .transport import (WIRE_THREAD_PREFIX, ReplicaClient,
@@ -81,6 +82,8 @@ __all__ = [
     'ServiceClosedError',
     'ShutdownError',
     'SoakReport',
+    'StreamKey',
+    'StreamSession',
     'WARMUP_THREAD_PREFIX',
     'WIRE_THREAD_PREFIX',
     'WireCorruptionError',

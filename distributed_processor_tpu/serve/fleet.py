@@ -280,6 +280,18 @@ class Fleet:
     def submit_source(self, *args, **kw):
         return self.router.submit_source(*args, **kw)
 
+    # streaming sessions (docs/SERVING.md "Streaming sessions"):
+    # process-backed streams route exactly like the router's — the
+    # fleet adds supervised respawn of a session's home replica
+    def open_stream(self, *args, **kw):
+        return self.router.open_stream(*args, **kw)
+
+    def submit_rounds(self, *args, **kw):
+        return self.router.submit_rounds(*args, **kw)
+
+    def close_stream(self, sid: int) -> bool:
+        return self.router.close_stream(sid)
+
     def replica_ids(self) -> list:
         return [s.rid for s in self._replicas]
 

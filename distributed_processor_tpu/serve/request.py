@@ -248,6 +248,16 @@ class Request:
     ``last_error`` records the most recent infrastructure failure so
     retry-budget exhaustion surfaces the ORIGINAL error, not a generic
     "gave up".
+
+    Streaming round chunks (docs/SERVING.md "Streaming sessions")
+    reuse this lifecycle unchanged: ``rounds`` is the chunk's round
+    count (None for ordinary one-shot submissions — the dispatcher
+    branches on it), ``meas_bits`` is then ``[rounds, n_shots,
+    n_cores, n_meas]``, ``decode`` the optional static
+    :class:`~...ops.decode.DecodeSpec`, and ``sid`` the owning
+    session id.  Retry/steal/cancel semantics — including the attempt
+    token — are inherited, which is exactly what makes stream chunks
+    survive a chaos kill without lost or duplicated rounds.
     """
     mp: object
     meas_bits: object
@@ -263,6 +273,9 @@ class Request:
     migrations: int = 0
     claim_token: int = 0
     last_error: BaseException = None
+    rounds: int = None
+    decode: object = None
+    sid: int = None
 
     def expired(self, now: float) -> bool:
         """Whether the deadline has passed as of ``now`` (False when no

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import collections
 import heapq
+import itertools
 import threading
 import time
 
@@ -63,6 +64,7 @@ from .. import isa
 from .batcher import bucket_key
 from .request import (CancelledError, DeadlineError, RequestHandle,
                       ServiceClosedError, ShutdownError)
+from .stream import StreamSession
 from .supervise import CircuitBreaker, RetryPolicy
 from .transport import ReplicaClient, ReplicaLostError
 
@@ -209,6 +211,14 @@ class FleetRouter:
         self._gossip_stale = 0
         self._breaker_trips = 0
         self._readmissions = 0
+        # streaming sessions (docs/SERVING.md "Streaming sessions"):
+        # the router keeps its OWN session registry — chunks reach the
+        # replica as detached rounds submissions, and stickiness comes
+        # from the ('stream', sid) home key, so a replica death steals
+        # the whole session to a new home without replica-side state
+        self._stream_seq = itertools.count()
+        self._stream_sessions: set = set()
+        self._stream_rounds = 0
         self._gossip_thread = threading.Thread(
             target=self._gossip_loop,
             name=f'{ROUTER_THREAD_PREFIX}-gossip-{self.name}',
@@ -325,6 +335,82 @@ class FleetRouter:
                        pad_to=pad_to)
         # no machine program yet, so no bucket: least-loaded placement
         return self._enqueue('submit_source', payload, None)
+
+    # -- streaming (docs/SERVING.md "Streaming sessions") ----------------
+
+    def open_stream(self, mp, *, cfg=None, decode=None,
+                    round_deadline_ms: float = None, priority: int = 0,
+                    fault_mode: str = None) -> StreamSession:
+        """Open a fleet-served streaming session: every round chunk is
+        one ``submit_rounds`` wire frame and every result one
+        incremental resolve frame, so the stream rides the ordinary
+        replica protocol unchanged.  The session's home REPLICA is
+        sticky via its ``('stream', sid)`` placement key; chunks reach
+        the replica as detached rounds submissions (the replica holds
+        no session state), so a chaos-killed home simply moves the
+        session — in-flight chunks are recovered by the shadow ledger
+        and the attempt tokens keep results exactly-once."""
+        with self._lock:
+            if self._closing:
+                raise ServiceClosedError(
+                    f'fleet router {self.name!r} is shut down')
+            sid = next(self._stream_seq)
+            self._stream_sessions.add(sid)
+        profiling.counter_inc('fleet.stream.sessions_opened')
+        self.flight_recorder.record('stream_open', sid=sid,
+                                    router=self.name)
+        return StreamSession(self, mp, sid, cfg=cfg, decode=decode,
+                             round_deadline_ms=round_deadline_ms,
+                             priority=priority, fault_mode=fault_mode)
+
+    def submit_rounds(self, mp, meas_bits, *, init_regs=None, cfg=None,
+                      decode=None, priority: int = 0,
+                      deadline_ms: float = None,
+                      round_deadline_ms: float = None,
+                      fault_mode: str = None,
+                      stream: int = None) -> RequestHandle:
+        """Route one R-round chunk (``meas_bits`` ``[rounds, n_shots,
+        n_cores, n_meas]``) to the stream's home replica — or
+        least-loaded placement for a detached (``stream=None``)
+        chunk."""
+        meas_bits = np.asarray(meas_bits, np.int32)
+        if meas_bits.ndim != 4:
+            raise ValueError(
+                f'submit_rounds meas_bits must be [rounds, n_shots, '
+                f'n_cores, n_meas]; got shape {meas_bits.shape}')
+        key = None
+        if stream is not None:
+            with self._lock:
+                if stream not in self._stream_sessions:
+                    raise ValueError(
+                        f'stream {stream} is not open on router '
+                        f'{self.name!r} (closed or never opened)')
+            key = ('stream', int(stream))
+        payload = dict(mp=mp, meas_bits=meas_bits, init_regs=init_regs,
+                       cfg=cfg if cfg is not None else self._default_cfg,
+                       decode=decode, priority=priority,
+                       deadline_ms=deadline_ms,
+                       round_deadline_ms=round_deadline_ms,
+                       fault_mode=fault_mode)
+        if self._integrity:
+            payload['_crc'] = program_digest(mp)
+        handle = self._enqueue('submit_rounds', payload, key)
+        with self._lock:
+            self._stream_rounds += int(meas_bits.shape[0])
+        profiling.counter_inc('fleet.stream.rounds_submitted',
+                              int(meas_bits.shape[0]))
+        return handle
+
+    def close_stream(self, sid: int) -> bool:
+        """Deregister a streaming session and drop its home pin.
+        Idempotent; returns whether the session was open."""
+        with self._lock:
+            present = sid in self._stream_sessions
+            self._stream_sessions.discard(sid)
+            self._home.pop(('stream', sid), None)
+        if present:
+            profiling.counter_inc('fleet.stream.sessions_closed')
+        return present
 
     def _affinity_key(self, mp, cfg):
         """The bucket-affinity identity: the same unbound BucketSpec
@@ -1071,6 +1157,10 @@ class FleetRouter:
                 'breaker_trips': self._breaker_trips,
                 'readmissions': self._readmissions,
                 'home_buckets': len(self._home),
+                'streaming': {
+                    'open_sessions': len(self._stream_sessions),
+                    'rounds_submitted': self._stream_rounds,
+                },
                 'slo_breaches': self._slo_breaches,
                 'slo': {stage: dict(ev)
                         for stage, ev in sorted(self._slo_last.items())},

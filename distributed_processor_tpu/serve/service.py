@@ -68,12 +68,13 @@ from .. import isa
 from ..decoder import machine_program_from_cmds, stack_machine_programs
 from ..integrity import IntegrityError, diff_stats
 from ..obs import FlightRecorder, Histogram, Tracer, write_chrome_trace
+from ..ops.decode import as_decode_spec
 from ..sim.interpreter import (ENGINES, InterpreterConfig, FaultError,
                                aot_batch_cached, aot_compile_batch,
                                demux_multi_batch, fault_shot_counts,
                                is_infrastructure_error, program_traits,
                                resolve_engine, simulate_batch,
-                               simulate_multi_batch)
+                               simulate_multi_batch, simulate_rounds)
 from ..utils import profiling
 from .batcher import Coalescer, bucket_key
 from .bucketspec import BucketSpec
@@ -81,6 +82,7 @@ from .catalog import BucketCatalog
 from .request import (CancelledError, DeadlineError, ExecutorLostError,
                       OverloadError, QueueFullError, Request,
                       RequestHandle, ServiceClosedError, ShutdownError)
+from .stream import StreamKey, StreamSession
 from .supervise import (HEALTH_LIVE, HEALTH_PROBING, HEALTH_QUARANTINED,
                         CircuitBreaker, RetryPolicy)
 
@@ -141,6 +143,51 @@ def _normalize_cfg(cfg: InterpreterConfig, n_instr_bucket: int):
     if strict or cfg.straightline is None or cfg.engine is not None:
         cfg = replace(cfg, fault_mode='count', straightline=False,
                       engine=None)
+    return cfg, strict
+
+
+def _normalize_stream_cfg(cfg: InterpreterConfig, n_instr_bucket: int):
+    """One stream-chunk cfg -> (dispatch cfg, strict flag).
+
+    Streaming chunks never coalesce across programs — each dispatch is
+    one session's ``simulate_rounds`` scan — so unlike
+    :func:`_normalize_cfg` the engine selector SURVIVES (a stream may
+    ride the content-keyed block/pallas rungs; only the physics-closed
+    'fused' engine is rejected, exactly as every injected-bits entry
+    rejects it).  ``rounds`` is normalized to 1 here — the ROUTING key
+    must not fragment per chunk length; each chunk's dispatch cfg
+    rebinds ``rounds`` to its own round count.  ``record_pulses`` is
+    forced off: an R-round pulse record is R times the largest leaf in
+    the result frame, which defeats incremental streaming (run
+    ``simulate_rounds`` directly for record-level debugging)."""
+    if cfg is None:
+        cfg = InterpreterConfig(max_steps=2 * n_instr_bucket + 64,
+                                max_pulses=n_instr_bucket + 2)
+    if cfg.engine == 'fused':
+        raise ValueError(
+            "engine='fused' demodulates measurement windows in-kernel; "
+            'streaming sessions dispatch injected-bits rounds scans — '
+            'physics-closed execution only runs via '
+            'sim.physics.run_physics_batch')
+    if cfg.opcode_histogram:
+        raise ValueError(
+            'opcode_histogram=True cannot stream: op_hist is summed '
+            'over shot lanes inside the jit (run simulate_rounds '
+            'directly instead)')
+    if cfg.cores_axis is not None:
+        raise ValueError(
+            f'cores_axis={cfg.cores_axis!r} (sharded-cores execution) '
+            'cannot serve: the service dispatches single-device '
+            'scans — mesh-wide rounds run via '
+            'parallel.sweep.sharded_cores_rounds')
+    strict = cfg.fault_mode == 'strict'
+    if cfg.fault_mode not in ('count', 'strict'):
+        raise ValueError(
+            f"fault_mode must be 'count' or 'strict'; got "
+            f"{cfg.fault_mode!r}")
+    if strict or cfg.record_pulses or cfg.rounds != 1:
+        cfg = replace(cfg, fault_mode='count', record_pulses=False,
+                      rounds=1)
     return cfg, strict
 
 
@@ -405,7 +452,8 @@ class ExecutionService:
                  flight_dump_dir: str = None,
                  audit_sample: float = 0.0,
                  audit_mode: str = 'flag',
-                 scrub_interval_s: float = None):
+                 scrub_interval_s: float = None,
+                 session_ttl_s: float = None):
         if max_batch_programs < 1:
             raise ValueError('max_batch_programs must be >= 1')
         if max_queue < 1:
@@ -465,6 +513,8 @@ class ExecutionService:
         if scrub_interval_s is not None and scrub_interval_s <= 0:
             raise ValueError('scrub_interval_s must be positive or '
                              'None')
+        if session_ttl_s is not None and session_ttl_s <= 0:
+            raise ValueError('session_ttl_s must be positive or None')
         # observability: per-request tracing (sampled) + flight
         # recorder — created before the executors so the first
         # dispatch can already emit into them
@@ -539,6 +589,22 @@ class ExecutionService:
         self._ewma_prog_s = None
         self._canary_mp = None         # lazily-built tiny probe program
         self._canary_ref = None        # first canary result: bit reference
+        # -- streaming traffic class (docs/SERVING.md "Streaming
+        # sessions"; guarded by _cv's lock).  _sessions maps an open
+        # sid -> last-activity instant (the TTL sweep's input);
+        # _stream_keys caches each session's sticky routing key;
+        # _stream_live holds (handle, rounds) pairs so stats() can
+        # count rounds in flight without walking every queue
+        self._session_ttl_s = session_ttl_s
+        self._stream_seq = itertools.count()
+        self._sessions = {}
+        self._stream_keys = {}
+        self._stream_live = []
+        self._stream_rounds_submitted = 0
+        self._stream_rounds_served = 0
+        self._stream_round_misses = 0
+        self._stream_sessions_opened = 0
+        self._stream_sessions_expired = 0
         # -- integrity fabric (docs/ROBUSTNESS.md "Integrity") -----------
         # audit_sample=1/N re-executes every Nth completed batch on a
         # different engine (and device when the pool has one) before
@@ -735,6 +801,196 @@ class ExecutionService:
             profiling.counter_inc('serve.submitted')
             self._cv.notify_all()
         return req.handle
+
+    # -- streaming traffic class (docs/SERVING.md "Streaming sessions") --
+
+    def open_stream(self, mp, *, cfg: InterpreterConfig = None,
+                    decode=None, round_deadline_ms: float = None,
+                    priority: int = 0,
+                    fault_mode: str = None) -> StreamSession:
+        """Open a long-lived streaming session for ``mp``: returns a
+        :class:`~.stream.StreamSession` whose ``submit_rounds`` chunks
+        dispatch as device-resident R-round scans
+        (:func:`~..sim.interpreter.simulate_rounds`) with ``decode``
+        (a :class:`~..ops.decode.DecodeSpec`) run in-loop.  All chunks
+        of a session share one sticky routing key, so the session
+        lives on a home executor with a warm scan executable;
+        ``round_deadline_ms`` arms each chunk with ``rounds x`` that
+        budget, honored at scan-chunk boundaries."""
+        with self._cv:
+            if self._closing:
+                raise ServiceClosedError(
+                    f'service {self.name!r} is shut down')
+            sid = next(self._stream_seq)
+            self._sessions[sid] = time.monotonic()
+            self._stream_sessions_opened += 1
+        profiling.counter_inc('serve.stream.sessions_opened')
+        self.flight_recorder.record('stream_open', sid=sid)
+        return StreamSession(self, mp, sid, cfg=cfg, decode=decode,
+                             round_deadline_ms=round_deadline_ms,
+                             priority=priority, fault_mode=fault_mode)
+
+    def close_stream(self, sid: int) -> bool:
+        """Deregister an open session (idempotent; the TTL sweep and
+        the session's own ``close`` both land here).  Outstanding
+        chunk handles are unaffected — they are ordinary requests and
+        complete or fail on their own lifecycle."""
+        with self._cv:
+            known = self._sessions.pop(sid, None) is not None
+            self._stream_keys.pop(sid, None)
+        if known:
+            profiling.counter_inc('serve.stream.sessions_closed')
+        return known
+
+    def submit_rounds(self, mp, meas_bits, *, init_regs=None,
+                      cfg: InterpreterConfig = None, decode=None,
+                      priority: int = 0, deadline_ms: float = None,
+                      round_deadline_ms: float = None,
+                      fault_mode: str = None, stream: int = None,
+                      _handle: RequestHandle = None):
+        """Queue one R-round streaming chunk; returns its
+        :class:`RequestHandle` immediately.  ``meas_bits`` is
+        ``[rounds, n_shots, n_cores, n_meas]``; the dispatcher runs
+        the whole chunk as ONE :func:`~..sim.interpreter.
+        simulate_rounds` scan (with ``decode`` in-loop), so the result
+        is the rounds pytree — leading round axis per leaf.
+
+        ``stream`` binds the chunk to an open session (sticky home
+        executor, TTL accounting); None submits a detached one-shot
+        chunk under its own fresh sid.  ``round_deadline_ms`` arms a
+        ``rounds x round_deadline_ms`` chunk deadline (mutually
+        exclusive with ``deadline_ms``); a chunk expiring counts every
+        round it carried as a round-deadline miss.  Retry, steal,
+        priority and overload semantics are exactly :meth:`submit`'s.
+        """
+        meas_bits = np.asarray(meas_bits, np.int32)
+        if meas_bits.ndim != 4 or meas_bits.shape[2] != mp.n_cores:
+            raise ValueError(
+                f'meas_bits must be [rounds, n_shots, n_cores='
+                f'{mp.n_cores}, n_meas]; got {tuple(meas_bits.shape)}')
+        rounds, n_shots = int(meas_bits.shape[0]), int(meas_bits.shape[1])
+        if rounds < 1:
+            raise ValueError('meas_bits must carry >= 1 round')
+        if n_shots < 1:
+            raise ValueError('meas_bits must carry >= 1 shot')
+        if deadline_ms is not None and round_deadline_ms is not None:
+            raise ValueError(
+                'pass deadline_ms or round_deadline_ms, not both')
+        cfg = cfg if cfg is not None else self._default_cfg
+        if fault_mode is not None:
+            base = cfg if cfg is not None else InterpreterConfig(
+                max_steps=2 * isa.shape_bucket(mp.n_instr) + 64,
+                max_pulses=isa.shape_bucket(mp.n_instr) + 2)
+            cfg = replace(base, fault_mode=fault_mode)
+        cfg, strict = _normalize_stream_cfg(
+            cfg, isa.shape_bucket(mp.n_instr))
+        if decode is not None:
+            decode = as_decode_spec(decode)
+            bad = [c for c in decode.cores
+                   if not 0 <= c < mp.n_cores]
+            if bad:
+                raise ValueError(
+                    f'decode.cores {bad} out of range for n_cores='
+                    f'{mp.n_cores}')
+        if meas_bits.shape[-1] != cfg.max_meas:
+            if meas_bits.shape[-1] > cfg.max_meas:
+                meas_bits = meas_bits[..., :cfg.max_meas]
+            else:
+                meas_bits = np.pad(meas_bits, [
+                    (0, 0), (0, 0), (0, 0),
+                    (0, cfg.max_meas - meas_bits.shape[-1])])
+        if init_regs is not None:
+            init_regs = np.asarray(init_regs, np.int32)
+            if init_regs.ndim == 2:
+                init_regs = np.broadcast_to(
+                    init_regs[None],
+                    (n_shots,) + init_regs.shape).copy()
+            if init_regs.ndim != 3 or init_regs.shape != (
+                    n_shots, mp.n_cores, isa.N_REGS):
+                raise ValueError(
+                    f'init_regs must be [n_cores, {isa.N_REGS}] or '
+                    f'[n_shots={n_shots}, n_cores={mp.n_cores}, '
+                    f'{isa.N_REGS}]; got {tuple(init_regs.shape)}')
+        if round_deadline_ms is not None:
+            deadline_ms = rounds * round_deadline_ms
+        deadline = None if deadline_ms is None \
+            else time.monotonic() + deadline_ms / 1e3
+        # the chunk's dispatch cfg rebinds rounds; the ROUTING key
+        # keeps the rounds=1 normalized cfg so every chunk of the
+        # session shares one sticky key regardless of chunk length
+        rcfg = replace(cfg, rounds=rounds)
+        with self._cv:
+            if self._closing:
+                raise ServiceClosedError(
+                    f'service {self.name!r} is shut down')
+            if stream is None:
+                sid = next(self._stream_seq)
+            else:
+                sid = stream
+                if sid not in self._sessions:
+                    raise ValueError(f'stream {sid} is not open '
+                                     f'(expired or closed)')
+                self._sessions[sid] = time.monotonic()
+            key = self._stream_keys.get(sid)
+            if key is None:
+                key = StreamKey(sid=sid, n_cores=mp.n_cores,
+                                n_instr_bucket=isa.shape_bucket(
+                                    mp.n_instr), cfg=cfg)
+                self._stream_keys[sid] = key
+            if self._depth_locked() >= self.max_queue:
+                self._rejected += 1
+                profiling.counter_inc('serve.rejected')
+                raise QueueFullError(
+                    f'queue full ({self.max_queue} requests pending)')
+            self._admit_overload_locked(priority, deadline)
+            hkw = {} if _handle is None else {'handle': _handle}
+            req = Request(mp=mp, meas_bits=meas_bits,
+                          init_regs=init_regs, cfg=rcfg, strict=strict,
+                          n_shots=n_shots, priority=priority,
+                          deadline=deadline, seq=next(self._seq),
+                          rounds=rounds, decode=decode, sid=sid, **hkw)
+            ctx = req.handle._trace if _handle is not None \
+                else self._tracer.maybe_start()
+            if ctx is not None:
+                req.handle._trace = ctx
+                ctx.instant('submit', t=req.submit_t, seq=req.seq,
+                            bucket=key.label(), priority=priority,
+                            rounds=rounds)
+            tgt = self._route_locked(key)
+            if tgt is None:
+                self._parked.append((time.monotonic(), key, req))
+                if ctx is not None:
+                    ctx.instant('park', reason='no-live-executor')
+            else:
+                tgt.q.push(key, req)
+            self._submitted += 1
+            self._stream_rounds_submitted += rounds
+            self._stream_live.append((req.handle, rounds))
+            profiling.counter_inc('serve.submitted')
+            profiling.counter_inc('serve.stream.rounds_submitted',
+                                  rounds)
+            self._cv.notify_all()
+        return req.handle
+
+    def _expire_sessions_locked(self, now: float) -> None:
+        """TTL sweep (supervisor tick): an open session idle past
+        ``session_ttl_s`` is deregistered — ``session_expired`` flight
+        event, ``serve.stream.sessions_expired`` counter — so an
+        abandoned producer cannot pin its home-executor affinity
+        forever.  Outstanding chunks complete normally; the session
+        object's next ``submit_rounds`` is rejected."""
+        if self._session_ttl_s is None or not self._sessions:
+            return
+        dead = [sid for sid, t in self._sessions.items()
+                if now - t > self._session_ttl_s]
+        for sid in dead:
+            del self._sessions[sid]
+            self._stream_keys.pop(sid, None)
+            self._stream_sessions_expired += 1
+            profiling.counter_inc('serve.stream.sessions_expired')
+            self.flight_recorder.record(
+                'session_expired', sid=sid,
+                ttl_s=self._session_ttl_s)
 
     # -- the compile front door ------------------------------------------
 
@@ -1002,6 +1258,15 @@ class ExecutionService:
         if expired:
             self._expired += len(expired)
             profiling.counter_inc('serve.expired', len(expired))
+            # a streaming chunk expiring misses EVERY round it carried
+            # (per-round deadlines are honored at scan-chunk
+            # boundaries — the whole chunk is the deadline unit)
+            missed = sum(r.rounds for r in expired
+                         if r.rounds is not None)
+            if missed:
+                self._stream_round_misses += missed
+                profiling.counter_inc(
+                    'serve.stream.round_deadline_misses', missed)
 
     # -- supervision -----------------------------------------------------
 
@@ -1090,6 +1355,7 @@ class ExecutionService:
                     return
                 now = time.monotonic()
                 self._pump_parked_locked(now)
+                self._expire_sessions_locked(now)
                 for ex in self._executors:
                     if not ex.thread.is_alive() and not self._closing:
                         self._on_executor_death_locked(ex, now)
@@ -1430,7 +1696,10 @@ class ExecutionService:
         except Exception as exc:      # noqa: BLE001 - fail the batch, live on
             self._on_batch_failure(ex, key, batch, exc)
             return
-        if self._audit_every:
+        # streaming chunks are excluded from the differential audit:
+        # its re-execution path is single-round simulate_batch, which
+        # cannot consume the [R, B, C, M] rounds layout
+        if self._audit_every and batch[0].rounds is None:
             with self._cv:
                 self._audit_tick += 1
                 do_audit = self._audit_tick % self._audit_every == 0
@@ -1444,7 +1713,7 @@ class ExecutionService:
                     self._on_batch_failure(ex, key, batch, bad)
                     return
         t_run = time.monotonic()
-        completed = failed = 0
+        completed = failed = served_rounds = 0
         for req, res in zip(batch, results):
             # every completion presents the attempt token: if this
             # dispatch was declared hung and the request retried
@@ -1458,6 +1727,8 @@ class ExecutionService:
                     continue
             if req.handle._fulfill(res, token=req.claim_token):
                 completed += 1
+                if req.rounds is not None:
+                    served_rounds += req.rounds
         now = time.monotonic()
         if self._tracer.enabled:
             for req in batch:
@@ -1475,6 +1746,20 @@ class ExecutionService:
             ex.occupancy[len(batch)] += 1
             self._completed += completed
             self._failed += failed
+            if served_rounds:
+                self._stream_rounds_served += served_rounds
+            # round-deadline misses at the scan-chunk boundary: a
+            # chunk that completed PAST its deadline still served its
+            # bits, but every round it carried missed its budget
+            late = sum(req.rounds for req in batch
+                       if req.rounds is not None
+                       and req.deadline is not None
+                       and now > req.deadline)
+            if late:
+                self._stream_round_misses += late
+            for req in batch:
+                if req.sid is not None and req.sid in self._sessions:
+                    self._sessions[req.sid] = now
             ex.breaker.record_success()
             per_prog = (now - t0) / len(batch)
             self._ewma_prog_s = per_prog if self._ewma_prog_s is None \
@@ -1488,6 +1773,12 @@ class ExecutionService:
         profiling.counter_inc('serve.programs_dispatched', len(batch))
         profiling.counter_inc('serve.batch_ms',
                               int((now - t0) * 1e3))
+        if served_rounds:
+            profiling.counter_inc('serve.stream.rounds_served',
+                                  served_rounds)
+        if late:
+            profiling.counter_inc('serve.stream.round_deadline_misses',
+                                  late)
 
     def _on_batch_failure(self, ex: _DeviceExecutor, key, batch, exc):
         """A batch raised out of ``_run_batch``.  Program-class errors
@@ -1663,6 +1954,8 @@ class ExecutionService:
         """Execute one coalesced batch on ``ex``'s device; returns
         per-request stats dicts in batch order (host numpy, padding
         trimmed)."""
+        if batch[0].rounds is not None:
+            return self._run_stream_batch(ex, key, batch)
         if len(batch) == 1 and self.singleton_engine is not None:
             req = batch[0]
             scfg = replace(cfg, engine=self.singleton_engine)
@@ -1727,6 +2020,33 @@ class ExecutionService:
         self._record_bucket_ms(key, cold, time.monotonic() - t0)
         return [demux_multi_batch(host, i, n_shots=r.n_shots)
                 for i, r in enumerate(batch)]
+
+    def _run_stream_batch(self, ex: _DeviceExecutor, key, batch):
+        """Execute streaming round chunks: one
+        :func:`~..sim.interpreter.simulate_rounds` scan per request
+        (chunks of one session coalescing under their shared sticky
+        key still execute sequentially — each carries its own round
+        count, and the scan IS the batching).  The chunk cfg rides the
+        REQUEST (``rounds`` rebound per chunk), not the routing key."""
+        results = []
+        for req in batch:
+            rcfg = req.cfg
+            eng = resolve_engine(req.mp, rcfg)
+            self._count_engine_locked(ex, eng)
+            cold = self._classify_compile(
+                ex, key, ('stream', eng, req.rounds, req.n_shots,
+                          req.init_regs is None, req.decode))
+            if self._tracer.enabled:
+                self._trace_dispatch([req], ex, key.label(),
+                                     'cold' if cold else 'warm', eng,
+                                     1)
+            t0 = time.monotonic()
+            out = simulate_rounds(req.mp, req.meas_bits, req.init_regs,
+                                  cfg=rcfg, jax_device=ex.device,
+                                  decode=req.decode)
+            results.append(jax.tree.map(np.asarray, out))
+            self._record_bucket_ms(key, cold, time.monotonic() - t0)
+        return results
 
     def _count_engine_locked(self, ex: _DeviceExecutor, eng: str):
         """Record which ladder rung a dispatch actually ran on (the
@@ -1916,6 +2236,12 @@ class ExecutionService:
         with self._cv:
             lat = np.asarray(self._latency_h.values(), np.float64)
             occ = dict(sorted(self._occupancy.items()))
+            # prune resolved stream chunks lazily: stats() is the only
+            # reader of rounds-in-flight, so the live list never grows
+            # past the outstanding chunk count between snapshots
+            self._stream_live = [(h, r) for h, r in self._stream_live
+                                 if not h.done()]
+            rounds_in_flight = sum(r for _, r in self._stream_live)
             devices = [{
                 'device': ex.label(),
                 'index': ex.idx,
@@ -1995,6 +2321,15 @@ class ExecutionService:
                     'scrubber_runs': self._scrubber_runs,
                     'scrubber_fail': self._scrubber_fail,
                     'quarantines': self._integrity_quarantines,
+                },
+                'streaming': {
+                    'open_sessions': len(self._sessions),
+                    'rounds_in_flight': rounds_in_flight,
+                    'rounds_submitted': self._stream_rounds_submitted,
+                    'rounds_served': self._stream_rounds_served,
+                    'round_deadline_misses': self._stream_round_misses,
+                    'sessions_opened': self._stream_sessions_opened,
+                    'sessions_expired': self._stream_sessions_expired,
                 },
                 'est_wait_ms': None if est_s is None
                 else float(est_s * 1e3),
@@ -2095,6 +2430,10 @@ class ExecutionService:
             if not self._closing:
                 self._closing = True
                 self._drain = drain
+                # streaming sessions close with the service; their
+                # outstanding chunks drain or fail with the rest
+                self._sessions.clear()
+                self._stream_keys.clear()
                 if not drain:
                     exc = ShutdownError(
                         f'service {self.name!r} shut down without '

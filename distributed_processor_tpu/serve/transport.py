@@ -80,8 +80,9 @@ WIRE_THREAD_PREFIX = 'dproc-serve-wire'
 _HDR = struct.Struct('>II')   # (payload length, payload CRC32)
 _MAX_FRAME = 1 << 29          # 512 MiB: desync/corruption guard
 
-OPS = ('submit', 'submit_source', 'stats', 'ping', 'gossip',
-       'fleet-metrics', 'flight', 'shutdown')
+OPS = ('submit', 'submit_source', 'submit_rounds', 'close_stream',
+       'stats', 'ping', 'gossip', 'fleet-metrics', 'flight',
+       'shutdown')
 
 
 class ReplicaLostError(RuntimeError):
@@ -240,7 +241,7 @@ class ReplicaServer:
 
     def _dispatch(self, conn, wlock, req_id, op, payload) -> None:
         try:
-            if op in ('submit', 'submit_source'):
+            if op in ('submit', 'submit_source', 'submit_rounds'):
                 t_recv = time.monotonic()
                 # `_trace` = the router's sampling decision for this
                 # request: open a forced replica-side context so the
@@ -270,11 +271,23 @@ class ReplicaServer:
                 if trace_id is not None:
                     kw['_handle'] = self._svc.traced_handle(
                         int(trace_id))
-                handle = self._svc.submit(**kw) if op == 'submit' \
-                    else self._svc.submit_source(**kw)
+                if op == 'submit':
+                    handle = self._svc.submit(**kw)
+                elif op == 'submit_rounds':
+                    # stream chunk: same resolve-time reply path, so
+                    # every chunk's result ships as one incremental
+                    # frame (docs/SERVING.md "Streaming sessions")
+                    handle = self._svc.submit_rounds(**kw)
+                else:
+                    handle = self._svc.submit_source(**kw)
                 self._pool.submit(self._send_on_resolve, conn, wlock,
                                   req_id, handle, t_recv,
                                   want_crc is not None)
+                return
+            if op == 'close_stream':
+                self._reply(conn, wlock, req_id, True, {
+                    'closed': self._svc.close_stream(
+                        int(payload['sid']))})
                 return
             if op == 'stats':
                 self._reply(conn, wlock, req_id, True,

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 from .. import isa
 from ..elements import PHASE_BITS
 from ..hwconfig import FPGAConfig
+from ..ops.decode import decode_history
 from ..utils.profiling import counter_get, counter_inc
 from .device import DEVICE_KINDS, STATEVEC_MAX_CORES
 from .oracle import (INIT_TIME, QCLK_RST_DELAY, MEAS_LATENCY,
@@ -343,6 +344,15 @@ class InterpreterConfig:
     # via ``parallel.sweep.sharded_cores_simulate`` — the single-device
     # entry points reject a set ``cores_axis`` (no mesh axis to bind).
     cores_axis: str = None
+    # streaming-QEC round count (docs/PERF.md "Streaming QEC"): how
+    # many syndrome rounds one dispatch executes via the rounds scan.
+    # Only :func:`simulate_rounds` binds rounds > 1 (it runs the
+    # program once per round inside a ``lax.scan``, each round from a
+    # fresh init state with that round's injected bits); the
+    # single-round entry points reject rounds != 1 loudly so a
+    # streaming config can never silently serve one round.  Static —
+    # part of the jit cache key and the serve tier's bucket identity.
+    rounds: int = 1
     alu_instr_clks: int = 5
     jump_cond_clks: int = 5
     jump_fproc_clks: int = 8
@@ -3901,6 +3911,7 @@ def simulate_multi_batch(mps, meas_bits, init_regs=None,
             'straight-line, block, and pallas executors key their '
             'caches on program content, the per-sequence compile this '
             'path amortizes away')
+    _check_single_round(cfg)
     if cfg.straightline is None or cfg.engine is not None:
         # normalize 'auto'/'generic' to the one legacy cache key
         cfg = replace(cfg, straightline=False, engine=None)
@@ -4017,6 +4028,17 @@ def _check_no_cores_axis(cfg: InterpreterConfig):
             f'cores_axis for single-device execution)')
 
 
+def _check_single_round(cfg: InterpreterConfig):
+    """The single-round entry points execute exactly one round per
+    dispatch; a streaming config (``rounds > 1``) reaching them would
+    silently serve one round of an R-round request — reject typed."""
+    if cfg.rounds != 1:
+        raise ValueError(
+            f'cfg.rounds={cfg.rounds} is a streaming round count; the '
+            f'single-round entry points execute one round per dispatch '
+            f'— run via simulate_rounds (or clear rounds)')
+
+
 def _pad_meas(meas_bits, max_meas: int):
     meas_bits = jnp.asarray(meas_bits, jnp.int32)
     if meas_bits.shape[-1] > max_meas:
@@ -4042,6 +4064,7 @@ def simulate(mp, meas_bits=None, init_regs=None,
     """
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
     _check_no_cores_axis(cfg)
+    _check_single_round(cfg)
     cfg, strict = _fault_policy(cfg)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     if meas_bits is None:
@@ -4095,6 +4118,7 @@ def simulate_batch(mp, meas_bits, init_regs=None,
                                   **kw)
     cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
     _check_no_cores_axis(cfg)
+    _check_single_round(cfg)
     cfg, strict = _fault_policy(cfg)
     soa, spc, interp, sync_part = _program_constants(mp, cfg)
     meas_bits = _pad_meas(meas_bits, cfg.max_meas)
@@ -4130,3 +4154,133 @@ def simulate_batch(mp, meas_bits, init_regs=None,
     return _check_strict(
         _run_batch_jit(soa, spc, interp, sync_part, meas_bits, cfg,
                        mp.n_cores, init_regs, program_traits(mp)), strict)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('cfg', 'n_cores', 'traits', 'engine',
+                                    'prog', 'pack', 'decode'))
+def _run_rounds_jit(soa, spc, interp, sync_part, meas_bits, cfg, n_cores,
+                    init_regs, traits=None, engine='generic', prog=None,
+                    pack=None, decode=None):
+    """R-round device-resident scan: one ``lax.scan`` over the leading
+    round axis of ``meas_bits`` ``[R, B, C, M]``, each iteration the
+    SAME engine body a single-round dispatch runs
+    (:func:`_run_batch_engine` — bit-identity per round is by
+    construction), each round from a fresh init state with that
+    round's injected bits.  Outputs stack with a leading round axis
+    (``steps``/``incomplete`` become ``[R]``); with ``decode`` set
+    (a :class:`~..ops.decode.DecodeSpec`), the syndrome history is
+    extracted and decoded INSIDE the same jit, so R rounds + the
+    logical decode are one dispatch."""
+    counter_inc('rounds_trace')
+
+    def body(carry, mb):
+        out = _run_batch_engine(soa, spc, interp, sync_part, mb, cfg,
+                                n_cores, init_regs, traits=traits,
+                                engine=engine, prog=prog, pack=pack)
+        return carry, out
+
+    _, st = jax.lax.scan(body, jnp.int32(0), meas_bits)
+    if decode is not None:
+        cores_idx = jnp.asarray(decode.cores, jnp.int32)
+        hist = jnp.transpose(
+            meas_bits[:, :, cores_idx, decode.slot], (1, 0, 2))
+        st['syndrome_hist'] = hist
+        st['decoded'] = decode_history(hist, decode.scheme)
+    return st
+
+
+def rounds_trace_count() -> int:
+    """How many times the rounds-scan executor has been traced in this
+    process (named counter ``'rounds_trace'`` — utils.profiling): the
+    retrace contract allows at most one per (bucket, engine, rounds)
+    triple."""
+    return counter_get('rounds_trace')
+
+
+def simulate_rounds(mp, meas_bits, init_regs=None,
+                    cfg: InterpreterConfig = None, jax_device=None,
+                    decode=None, **kw) -> dict:
+    """Execute R syndrome rounds of one program in ONE dispatch
+    (docs/PERF.md "Streaming QEC"): ``meas_bits`` is ``[rounds,
+    n_shots, n_cores, n_meas]`` and a ``lax.scan`` over the round axis
+    runs the resolved engine's batch body once per round — each round
+    from a fresh init state with that round's injected bits, exactly
+    what R sequential :func:`simulate_batch` dispatches compute, minus
+    R-1 dispatch floors (the amortization the ``qec_streaming`` bench
+    row measures).  Composes with the engine ladder: ``cfg.engine``
+    picks generic/straightline/block/pallas per the usual eligibility
+    rules ('fused' is rejected like every injected-bits entry).
+
+    Returns the :func:`simulate_batch` pytree with a leading round
+    axis on every leaf (``steps`` and ``incomplete`` become
+    ``[rounds]``).  ``decode`` (a :class:`~..ops.decode.DecodeSpec`,
+    tuple, or dict — see :func:`~..ops.decode.as_decode_spec`) adds
+    ``syndrome_hist`` ``[n_shots, rounds, K]`` (the named cores'
+    injected bits at the named slot) and ``decoded`` (the
+    scheme-decoded correction) computed inside the same jit.
+
+    ``cfg.rounds`` may pre-declare the round count (the serve tier's
+    bucket identity does); it must then match the meas_bits round
+    axis.  ``init_regs`` is shared across rounds (``[n_cores, 16]`` or
+    ``[n_shots, n_cores, 16]``)."""
+    if jax_device is not None:
+        with jax.default_device(jax_device):
+            return simulate_rounds(mp, meas_bits, init_regs, cfg=cfg,
+                                   decode=decode, **kw)
+    cfg = replace(cfg, **kw) if cfg else InterpreterConfig(**kw)
+    _check_no_cores_axis(cfg)
+    cfg, strict = _fault_policy(cfg)
+    meas_bits = jnp.asarray(meas_bits, jnp.int32)
+    if meas_bits.ndim != 4 or meas_bits.shape[2] != mp.n_cores:
+        raise ValueError(
+            f'meas_bits must be [rounds, n_shots, n_cores='
+            f'{mp.n_cores}, n_meas]; got {tuple(meas_bits.shape)}')
+    R = int(meas_bits.shape[0])
+    if R < 1:
+        raise ValueError('meas_bits must carry >= 1 round')
+    if cfg.rounds != 1 and cfg.rounds != R:
+        raise ValueError(
+            f'cfg.rounds={cfg.rounds} contradicts the meas_bits round '
+            f'axis {R}')
+    cfg = replace(cfg, rounds=R)
+    if decode is not None:
+        from ..ops.decode import as_decode_spec
+        decode = as_decode_spec(decode)
+        bad = [c for c in decode.cores if not 0 <= c < mp.n_cores]
+        if bad:
+            raise ValueError(
+                f'decode.cores {bad} out of range for n_cores='
+                f'{mp.n_cores}')
+        if not 0 <= decode.slot < cfg.max_meas:
+            raise ValueError(
+                f'decode.slot={decode.slot} out of range for '
+                f'max_meas={cfg.max_meas}')
+    soa, spc, interp, sync_part = _program_constants(mp, cfg)
+    meas_bits = _pad_meas(meas_bits, cfg.max_meas)
+    trim_regs = init_regs is None
+    init_regs = jnp.zeros((mp.n_cores, isa.N_REGS), jnp.int32) \
+        if init_regs is None else jnp.asarray(init_regs, jnp.int32)
+    if init_regs.ndim == 2:
+        init_regs = jnp.broadcast_to(
+            init_regs[None],
+            (meas_bits.shape[1],) + tuple(init_regs.shape))
+    eng = resolve_engine(mp, cfg)
+    if eng == 'fused':
+        raise ValueError(
+            "engine='fused' demodulates measurement windows in-kernel; "
+            'the injected-bits entry points have no window — run via '
+            'sim.physics.run_physics_batch')
+    traits = prog = pack = None
+    if eng == 'generic':
+        traits = program_traits(mp)
+    else:
+        prog = _soa_static(mp)
+        soa = None
+        if eng == 'pallas' and use_packed_carry(cfg):
+            pack = carry_packspec(mp, cfg, trim_regs=trim_regs)
+    return _check_strict(
+        _run_rounds_jit(soa, spc, interp, sync_part, meas_bits, cfg,
+                        mp.n_cores, init_regs, traits=traits,
+                        engine=eng, prog=prog, pack=pack,
+                        decode=decode), strict)

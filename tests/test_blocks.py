@@ -16,6 +16,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import jax
 import numpy as np
@@ -632,3 +633,29 @@ def test_bench_degraded_fallback(tmp_path):
     assert doc['degraded'] is True
     assert doc['result']['degraded'] is True
     assert 'headline' in doc and 'preflight' in doc
+
+
+def test_bench_preflight_hard_watchdog():
+    """A hang OUTSIDE the probe thread (backend plugin import, thread
+    creation under a wedged runtime — BENCH_PREFLIGHT_HANG provokes
+    it) is bounded by the BENCH_PREFLIGHT_TIMEOUT hard watchdog: the
+    bench exits typed (rc 2 with a stage='watchdog' attempt in the
+    error JSON) instead of stalling forever; BENCH_DEGRADED=1 mimics
+    the already-degraded child so no second CPU rerun spawns."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               BENCH_PREFLIGHT_HANG='1', BENCH_PREFLIGHT_TIMEOUT='2',
+               BENCH_DEGRADED='1')
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, '-c', 'import bench; bench._preflight()'],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2, (r.returncode, r.stderr[-2000:])
+    assert time.monotonic() - t0 < 60.0, 'watchdog did not bound the hang'
+    line = [l for l in r.stdout.splitlines() if l.startswith('{')][-1]
+    res = json.loads(line)
+    attempts = res['detail']['preflight_attempts']
+    assert attempts[0]['stage'] == 'watchdog'
+    assert 'BENCH_PREFLIGHT_TIMEOUT' in attempts[0]['error']
+    assert res['value'] == 0
+    assert 'watchdog fired' in r.stderr

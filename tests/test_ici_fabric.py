@@ -34,6 +34,7 @@ from distributed_processor_tpu.models.repetition import (
 from distributed_processor_tpu.ops.fabric import MeasLUT
 from distributed_processor_tpu.parallel import (make_cores_mesh, make_mesh,
                                                 run_cores_sweep,
+                                                sharded_cores_rounds,
                                                 sharded_cores_simulate,
                                                 sharded_cores_stat_sums)
 from distributed_processor_tpu.parallel.param_sweep import \
@@ -43,7 +44,7 @@ from distributed_processor_tpu.pipeline import compile_to_machine
 from distributed_processor_tpu.sim.interpreter import (
     InterpreterConfig, _program_constants, _run_batch_engine,
     cores_ineligible, cores_trace_count, program_traits, resolve_engine,
-    simulate, simulate_batch)
+    simulate, simulate_batch, simulate_rounds)
 
 _N_DEV = len(jax.devices())
 
@@ -149,6 +150,28 @@ def test_lut_repetition_sharded_bit_identity():
     # corrections change per-shot pulse counts
     assert len(np.unique(np.asarray(single['n_pulses']))) > 1, \
         'repetition fixture fired no corrections — LUT path unexercised'
+
+
+@pytest.mark.qec
+def test_lut_repetition_rounds_sharded_bit_identity():
+    """R syndrome rounds in ONE mesh dispatch (sharded_cores_rounds —
+    the mesh composition of simulate_rounds, docs/PERF.md "Streaming
+    QEC") equal the single-device rounds scan per stat, on the generic
+    cores executor AND the GSPMD block executor — codes too wide for
+    one device stream rounds with the same bit-identity contract."""
+    mp, kw = _rep_setup()
+    mesh = _fitting_mesh(mp.n_cores)
+    assert mesh is not None and int(mesh.shape['cores']) == mp.n_cores
+    rounds, n_dp = 3, int(mesh.shape['dp'])
+    mb = np.random.default_rng(17).integers(
+        0, 2, (rounds, 4 * n_dp, mp.n_cores, 4), dtype=np.int32)
+    single = simulate_rounds(
+        mp, mb, cfg=InterpreterConfig(engine='generic', **kw))
+    for eng in ('generic', 'block'):
+        sharded = sharded_cores_rounds(
+            mp, mb, mesh, cfg=InterpreterConfig(engine=eng, **kw))
+        _assert_identical(single, sharded,
+                          msg=f'lut-repetition rounds[{eng}]: ')
 
 
 def test_sharded_stat_sums_match_host_reference():

@@ -435,7 +435,7 @@ _STATS_KEYS = {
     'shed', 'overload_rejected', 'breaker_trips', 'readmissions',
     'executor_deaths', 'hangs', 'canary', 'est_wait_ms', 'compile',
     'source', 'devices', 'compile_cache', 'latency_p50_ms',
-    'latency_p99_ms', 'latency_samples', 'integrity',
+    'latency_p99_ms', 'latency_samples', 'integrity', 'streaming',
 }
 _WARMUP_KEYS = {'aot_compiled', 'replayed', 'in_progress'}
 _HEALTH_KEYS = {'live', 'quarantined', 'probing'}
@@ -453,6 +453,10 @@ _DEVICE_KEYS = {
 _INTEGRITY_KEYS = {'audit_sample', 'audit_mode', 'audits',
                    'mismatches', 'scrubber_runs', 'scrubber_fail',
                    'quarantines'}
+_STREAMING_KEYS = {'open_sessions', 'rounds_in_flight',
+                   'rounds_submitted', 'rounds_served',
+                   'round_deadline_misses', 'sessions_opened',
+                   'sessions_expired'}
 # serve.* counters the service maintains in the global registry
 _SERVE_COUNTERS = {
     'serve.submitted', 'serve.dispatches',
@@ -476,6 +480,7 @@ def test_stats_key_manifest_is_byte_compatible():
     assert set(snap['compile']) == _COMPILE_KEYS
     assert set(snap['source']) == _SOURCE_KEYS
     assert set(snap['integrity']) == _INTEGRITY_KEYS
+    assert set(snap['streaming']) == _STREAMING_KEYS
     for dev in snap['devices']:
         assert set(dev) == _DEVICE_KEYS
     for label, row in snap['compile']['per_bucket'].items():
@@ -500,6 +505,43 @@ def test_serve_counter_names_preserved():
             f'counter {name!r} did not advance under a served request'
     # the service's latency histogram also feeds the fleet-wide one
     assert profiling.registry().histogram('serve.latency_ms').count >= 1
+
+
+# serve.stream.* counters, separate from _SERVE_COUNTERS: only a
+# streaming session advances them, so the ordinary-submit test above
+# must not require them
+_STREAM_COUNTERS = {
+    'serve.stream.sessions_opened', 'serve.stream.sessions_closed',
+    'serve.stream.rounds_submitted', 'serve.stream.rounds_served',
+}
+
+
+def test_stream_counter_names_preserved():
+    from distributed_processor_tpu.models.qec import (
+        qec_config, qec_multiround_machine_program)
+    rng = np.random.default_rng(9)
+    mp = qec_multiround_machine_program(n_data=3, rounds=1)
+    cfg = qec_config(3, record_pulses=False)
+    before = {k: profiling.counter_get(k) for k in _STREAM_COUNTERS}
+    with ExecutionService() as svc:
+        with svc.open_stream(mp, cfg=cfg) as sess:
+            sess.submit_rounds(rng.integers(
+                0, 2, (4, 3, mp.n_cores, cfg.max_meas)).astype(np.int32))
+            list(sess.results(timeout=60))
+        # rounds_served is written by the dispatcher just after the
+        # handles resolve; give it its scheduling slice
+        deadline = time.monotonic() + 10.0
+        while svc.stats()['streaming']['rounds_served'] < 4 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        snap = svc.stats()
+    assert set(snap['streaming']) == _STREAMING_KEYS
+    assert snap['streaming']['rounds_submitted'] == 4
+    assert snap['streaming']['rounds_served'] == 4
+    after = profiling.counters()
+    for name in _STREAM_COUNTERS:
+        assert after.get(name, 0) > before[name], \
+            f'counter {name!r} did not advance under a streamed session'
 
 
 def test_compile_cache_counters_on_registry():

@@ -1,0 +1,543 @@
+"""Streaming multi-round QEC (docs/PERF.md "Streaming QEC",
+docs/SERVING.md "Streaming sessions").
+
+The contract, pinned here:
+
+* **Rounds-scan bit-identity**: R rounds in ONE
+  ``simulate_rounds`` dispatch equal R sequential ``simulate_batch``
+  dispatches per stat, on every engine rung the scan composes with
+  (generic / straightline / block / pallas-interpret) — the
+  amortization the ``qec_streaming`` bench row measures is free of
+  semantic drift by construction.
+* **Decoder correctness**: the pure-``jnp`` in-loop decoders
+  (``'majority'`` LUT-walk, ``'matching'`` union-find-lite chain
+  matching) are fuzzed against brute-force NumPy oracles that share
+  no structure with them — exhaustive min-weight search and the
+  literal ``majority_lut`` table — on >= 200 seeded cases with zero
+  disagreements, and are engine-invariant through the scan.
+* **Streaming sessions**: chunks ride the ordinary request lifecycle
+  (deadlines honored at scan-chunk boundaries, retry under the
+  attempt-token machinery, TTL expiry), results arrive in submission
+  order as incremental frames, and a chaos kill of the dispatch path
+  retries the chunk with no lost or duplicated round results.
+
+This module is listed in tools/check_junit.py NO_SKIP_MODULES: it
+runs on pure CPU with injected measurement planes and has no
+legitimate skip condition.
+"""
+
+import time
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_processor_tpu.models.qec import (
+    chain_lut, qec_config, qec_multiround_machine_program,
+    qec_round_machine_program, repetition_decode_spec,
+    surface_cycle_config, surface_cycle_machine_program,
+    surface_decode_spec)
+from distributed_processor_tpu.ops.decode import (
+    DecodeSpec, as_decode_spec, bit_majority_correction, chain_matching,
+    chain_matching_np, decode_history, majority_correction_np,
+    majority_vote)
+from distributed_processor_tpu.serve import (ChaosMonkey, ChaosPlan,
+                                             DeadlineError,
+                                             ExecutionService,
+                                             RetryPolicy, StreamKey)
+from distributed_processor_tpu.serve.service import _normalize_stream_cfg
+from distributed_processor_tpu.sim.interpreter import (InterpreterConfig,
+                                                       simulate_batch,
+                                                       simulate_rounds)
+
+pytestmark = pytest.mark.qec
+
+
+def _rep(n_data=3, **cfg_kw):
+    """Repetition-code streaming workload: the single-round unit
+    program the scan repeats, its LUT-fabric config, and the
+    majority decode spec."""
+    mp = qec_round_machine_program(n_data)
+    cfg = qec_config(n_data, record_pulses=False, **cfg_kw)
+    return mp, cfg, repetition_decode_spec(n_data)
+
+
+def _planes(rng, rounds, shots, mp, cfg):
+    return rng.integers(0, 2, (rounds, shots, mp.n_cores, cfg.max_meas),
+                        dtype=np.int32)
+
+
+def _assert_same(got, want, label='', ignore=()):
+    """Bit-identity per stat; ``ignore`` drops engine bookkeeping
+    ('steps' is the dispatch loop's own counter and legitimately
+    differs across engine rungs — same carve-out as test_ici_fabric)."""
+    assert set(got) - set(ignore) == set(want) - set(ignore), \
+        f'{label}: keys {set(got) ^ set(want)} diverged'
+    for k in sorted(set(want) - set(ignore)):
+        np.testing.assert_array_equal(
+            np.asarray(got[k]), np.asarray(want[k]),
+            err_msg=f'{label}: stat {k!r} diverged')
+
+
+def _stack_rounds(per_round):
+    """R per-round simulate_batch pytrees -> one pytree with a leading
+    round axis per leaf (the shape simulate_rounds returns)."""
+    return {k: np.stack([np.asarray(r[k]) for r in per_round])
+            for k in per_round[0]}
+
+
+# ---------------------------------------------------------------------------
+# decoder fuzz vs the brute-force oracles (>= 200 seeded cases total)
+# ---------------------------------------------------------------------------
+
+def test_majority_decoder_fuzz_vs_lut_oracle():
+    """120 seeded histories, K in 1..5, R in 1..6: the jnp majority
+    decoder must agree with the literal ``majority_lut`` table walk on
+    every case, and the round-majority with the strict-majority
+    convention (ties -> 0)."""
+    rng = np.random.default_rng(0xC0DE)
+    cases = 0
+    for _ in range(120):
+        k = int(rng.integers(1, 6))
+        r = int(rng.integers(1, 7))
+        hist = rng.integers(0, 2, (r, k), dtype=np.int32)
+        voted = np.asarray(majority_vote(hist))
+        want_vote = (2 * hist.sum(axis=0) > r).astype(np.int32)
+        np.testing.assert_array_equal(voted, want_vote)
+        got = np.asarray(decode_history(hist, 'majority'))
+        np.testing.assert_array_equal(
+            got, np.asarray(bit_majority_correction(voted)))
+        want = majority_correction_np(want_vote)
+        np.testing.assert_array_equal(
+            got, want, err_msg=f'case {cases}: hist={hist.tolist()}')
+        cases += 1
+    assert cases == 120
+
+
+def test_matching_decoder_fuzz_vs_bruteforce_oracle():
+    """120 seeded syndrome histories, A (ancillas) in 1..5, R in 1..6:
+    the closed-form chain matching must reproduce the exhaustive
+    min-weight search — syndrome-consistency, weight, AND the
+    tie-break anchor (qubit 0 clear) — on every case."""
+    rng = np.random.default_rng(0xDEC0DE)
+    cases = 0
+    for _ in range(120):
+        a = int(rng.integers(1, 6))
+        r = int(rng.integers(1, 7))
+        hist = rng.integers(0, 2, (r, a), dtype=np.int32)
+        synd = (2 * hist.sum(axis=0) > r).astype(np.int32)
+        got = np.asarray(decode_history(hist, 'matching'))
+        np.testing.assert_array_equal(
+            got, np.asarray(chain_matching(synd)))
+        # the decoded pattern must actually satisfy the syndrome
+        np.testing.assert_array_equal(got[:-1] ^ got[1:], synd)
+        want = chain_matching_np(synd)
+        np.testing.assert_array_equal(
+            got, want, err_msg=f'case {cases}: synd={synd.tolist()}')
+        cases += 1
+    assert cases == 120
+
+
+def test_decode_history_batched_matches_per_case():
+    """The decoders are shape-polymorphic over leading batch axes: a
+    stacked [B, R, K] decode equals B independent [R, K] decodes (the
+    form the in-loop decode uses under the scan)."""
+    rng = np.random.default_rng(11)
+    for scheme in ('majority', 'matching'):
+        hists = rng.integers(0, 2, (16, 5, 3), dtype=np.int32)
+        batched = np.asarray(decode_history(hists, scheme))
+        for b in range(hists.shape[0]):
+            np.testing.assert_array_equal(
+                batched[b], np.asarray(decode_history(hists[b], scheme)),
+                err_msg=f'{scheme}: row {b}')
+
+
+def test_decode_spec_validation():
+    with pytest.raises(ValueError, match='scheme'):
+        DecodeSpec('bogus', (0,))
+    with pytest.raises(ValueError, match='cores'):
+        DecodeSpec('majority', ())
+    with pytest.raises(ValueError):
+        as_decode_spec(None)
+    with pytest.raises(ValueError):
+        decode_history(np.zeros((2, 3), np.int32), 'bogus')
+    # tuple / dict / passthrough coercions agree
+    spec = DecodeSpec('matching', (3, 4), 0)
+    assert as_decode_spec(spec) is spec
+    assert as_decode_spec(('matching', (3, 4), 0)) == spec
+    assert as_decode_spec(
+        {'scheme': 'matching', 'cores': (3, 4)}) == spec
+
+
+# ---------------------------------------------------------------------------
+# rounds scan: bit-identity vs sequential dispatches, per engine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('engine', ['generic', 'straightline', 'block',
+                                    'pallas'])
+def test_rounds_scan_bit_identical_to_sequential(engine):
+    """R rounds in ONE scan dispatch == R sequential simulate_batch
+    dispatches, per stat, on every engine rung the scan composes with
+    (the fast engines ride the PR 17 timestamped fabric).  This is the
+    bit-identity gate the qec_streaming bench row re-checks before
+    timing."""
+    mp, cfg, _ = _rep(3)
+    kw = {'engine': engine}
+    if engine == 'pallas':
+        kw['pallas_interpret'] = True
+    cfg = replace(cfg, **kw)
+    rng = np.random.default_rng(5)
+    mb = _planes(rng, 4, 5, mp, cfg)
+    scan = simulate_rounds(mp, mb, cfg=cfg)
+    seq = _stack_rounds([simulate_batch(mp, mb[r], cfg=cfg)
+                         for r in range(mb.shape[0])])
+    _assert_same(scan, seq, f'engine={engine}')
+
+
+def test_rounds_scan_decode_engine_invariant():
+    """The in-loop decode rides the same scan on every engine: full
+    pytrees (syndrome_hist and decoded included) are equal across
+    generic/block, the history is exactly the injected planes at the
+    decode cores/slot, and the decoded correction equals the host-side
+    decode of that history."""
+    mp, cfg, dec = _rep(3)
+    rng = np.random.default_rng(6)
+    mb = _planes(rng, 5, 4, mp, cfg)
+    outs = {eng: jax.tree.map(
+                np.asarray,
+                simulate_rounds(mp, mb, cfg=replace(cfg, engine=eng),
+                                decode=dec))
+            for eng in ('generic', 'block')}
+    _assert_same(outs['block'], outs['generic'], 'block vs generic',
+                 ignore=('steps',))
+    hist = outs['generic']['syndrome_hist']
+    np.testing.assert_array_equal(
+        hist, np.transpose(mb[:, :, list(dec.cores), dec.slot],
+                           (1, 0, 2)))
+    np.testing.assert_array_equal(
+        outs['generic']['decoded'],
+        np.asarray(decode_history(hist, dec.scheme)))
+
+
+def test_multiround_emitter_clean_and_engine_invariant():
+    """The R-round unrolled emitter (one instruction stream, chain of
+    R CFG diamonds) runs clean on generic AND the content-keyed block
+    engine, bit-identically — the dispatch-granularity invariance of
+    the timestamped LUT fabric carries over to the unrolled form."""
+    rounds, n_data = 3, 3
+    mp = qec_multiround_machine_program(n_data, rounds=rounds)
+    cfg = qec_config(n_data, rounds=rounds, record_pulses=False)
+    rng = np.random.default_rng(7)
+    bits = rng.integers(0, 2, (6, n_data, cfg.max_meas), dtype=np.int32)
+    outs = {eng: jax.tree.map(
+                np.asarray,
+                simulate_batch(mp, bits, cfg=replace(cfg, engine=eng)))
+            for eng in ('generic', 'block')}
+    _assert_same(outs['block'], outs['generic'], 'block vs generic',
+                 ignore=('steps',))
+    assert not np.any(outs['generic']['fault'])
+    assert not np.any(outs['generic']['incomplete'])
+
+
+def test_surface_cycle_chain_lut_decode():
+    """Distance-3 surface-code-cycle-shaped rounds: the fabric LUT is
+    the exact min-weight chain matching (built by the brute-force
+    oracle), the scan's syndrome history reads the ancilla cores, and
+    the in-loop 'matching' decode agrees with the LUT entry at the
+    round-majority syndrome address."""
+    d = 3
+    assert chain_lut(d) == (0, 1, 4, 2)
+    mp = surface_cycle_machine_program(d)
+    assert mp.n_cores == 2 * d - 1
+    cfg = surface_cycle_config(d, record_pulses=False)
+    dec = surface_decode_spec(d)
+    rng = np.random.default_rng(8)
+    rounds, shots = 4, 6
+    mb = _planes(rng, rounds, shots, mp, cfg)
+    out = jax.tree.map(np.asarray,
+                       simulate_rounds(mp, mb, cfg=cfg, decode=dec))
+    assert out['syndrome_hist'].shape == (shots, rounds, d - 1)
+    assert out['decoded'].shape == (shots, d)
+    assert not np.any(out['fault'])
+    voted = np.asarray(majority_vote(out['syndrome_hist']))
+    lut = chain_lut(d)
+    for b in range(shots):
+        addr = int(sum(int(v) << i for i, v in enumerate(voted[b])))
+        want = np.array([(lut[addr] >> i) & 1 for i in range(d)],
+                        np.int32)
+        np.testing.assert_array_equal(out['decoded'][b], want,
+                                      err_msg=f'shot {b}')
+
+
+def test_rounds_entry_rejections():
+    """Typed rejections on both sides of the streaming boundary: the
+    single-round entry points refuse a streaming cfg, and the rounds
+    entry refuses malformed planes, contradictory round counts, the
+    physics-closed fused engine, and out-of-range decode specs."""
+    mp, cfg, dec = _rep(3)
+    rng = np.random.default_rng(9)
+    mb = _planes(rng, 2, 3, mp, cfg)
+    with pytest.raises(ValueError, match='single-round'):
+        simulate_batch(mp, mb[0], cfg=replace(cfg, rounds=4))
+    with pytest.raises(ValueError, match='rounds, n_shots'):
+        simulate_rounds(mp, mb[0], cfg=cfg)
+    with pytest.raises(ValueError, match='contradicts'):
+        simulate_rounds(mp, mb, cfg=replace(cfg, rounds=3))
+    with pytest.raises(ValueError, match='fused'):
+        simulate_rounds(mp, mb, cfg=replace(cfg, engine='fused'))
+    with pytest.raises(ValueError, match='out of range'):
+        simulate_rounds(mp, mb, cfg=cfg,
+                        decode=DecodeSpec('majority', (0, 99)))
+    with pytest.raises(ValueError, match='slot'):
+        simulate_rounds(mp, mb, cfg=cfg,
+                        decode=DecodeSpec('majority', (0,),
+                                          slot=cfg.max_meas))
+
+
+def test_normalize_stream_cfg_policy():
+    """The stream normalizer differs from the coalescing one on
+    purpose: the engine selector SURVIVES (each chunk is one session's
+    scan, content-keyed rungs are eligible), while fused / op_hist /
+    cores_axis reject typed, record_pulses is forced off, and the
+    routing cfg pins rounds=1 so chunk lengths never fragment the
+    session key."""
+    base = InterpreterConfig(max_steps=80, max_pulses=10, max_meas=2)
+    with pytest.raises(ValueError, match='fused'):
+        _normalize_stream_cfg(replace(base, engine='fused'), 8)
+    with pytest.raises(ValueError, match='op_hist'):
+        _normalize_stream_cfg(replace(base, opcode_histogram=True), 8)
+    with pytest.raises(ValueError, match='cores_axis'):
+        _normalize_stream_cfg(replace(base, cores_axis='cores'), 8)
+    with pytest.raises(ValueError, match='fault_mode'):
+        _normalize_stream_cfg(replace(base, fault_mode='bogus'), 8)
+    norm, strict = _normalize_stream_cfg(
+        replace(base, engine='block', record_pulses=True, rounds=8,
+                fault_mode='strict'), 8)
+    assert norm.engine == 'block'
+    assert not norm.record_pulses
+    assert norm.rounds == 1
+    assert norm.fault_mode == 'count' and strict
+    key = StreamKey(sid=3, n_cores=2, n_instr_bucket=8, cfg=norm)
+    assert key.label() == 'stream3c2i8'
+
+
+# ---------------------------------------------------------------------------
+# streaming sessions over the execution service
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+def test_stream_session_end_to_end():
+    """Open a session, stream 3 chunks of differing round counts:
+    results arrive in submission order as incremental frames, each
+    bit-identical to its solo simulate_rounds scan; close() drains,
+    returns the full-history decode over the concatenated syndrome,
+    and deregisters (further submits reject typed).  The frozen
+    streaming stats block tracks rounds and session counts."""
+    mp, cfg, dec = _rep(3)
+    rng = np.random.default_rng(21)
+    chunks = [_planes(rng, r, 4, mp, cfg) for r in (2, 3, 4)]
+    with ExecutionService(max_wait_ms=2.0) as svc:
+        sess = svc.open_stream(mp, cfg=cfg, decode=dec)
+        for mb in chunks:
+            sess.submit_rounds(mb)
+        results = list(sess.results(timeout=300.0))
+        assert len(results) == len(chunks)
+        for i, (mb, got) in enumerate(zip(chunks, results)):
+            want = jax.tree.map(
+                np.asarray, simulate_rounds(mp, mb, cfg=cfg, decode=dec))
+            _assert_same(got, want, f'chunk {i}')
+        summary = sess.close(timeout=60.0)
+        assert summary['chunks'] == 3
+        assert summary['rounds'] == 9
+        assert summary['failed_chunks'] == 0
+        # full-history decode == one decode over every chunk's history
+        hist = np.concatenate(
+            [np.asarray(r['syndrome_hist']) for r in results], axis=1)
+        np.testing.assert_array_equal(summary['syndrome_hist'], hist)
+        np.testing.assert_array_equal(
+            summary['decoded'],
+            np.asarray(decode_history(hist, dec.scheme)))
+        st = svc.stats()['streaming']
+        assert st['open_sessions'] == 0
+        assert st['sessions_opened'] == 1
+        assert st['rounds_submitted'] == 9
+        assert st['rounds_served'] == 9
+        assert st['round_deadline_misses'] == 0
+        # closed session rejects: the session object and the service
+        with pytest.raises(RuntimeError, match='closed'):
+            sess.submit_rounds(chunks[0])
+        with pytest.raises(RuntimeError, match='closed'):
+            sess.close()
+        with pytest.raises(ValueError, match='not open'):
+            svc.submit_rounds(mp, chunks[0], cfg=cfg, stream=sess.sid)
+        assert svc.close_stream(sess.sid) is False   # idempotent
+
+
+@pytest.mark.serve
+def test_submit_rounds_detached_and_rejections():
+    """A detached chunk (no session) serves under its own fresh sid
+    and never appears in open_sessions; malformed submissions reject
+    before enqueue."""
+    mp, cfg, dec = _rep(3)
+    rng = np.random.default_rng(22)
+    mb = _planes(rng, 3, 4, mp, cfg)
+    with ExecutionService(max_wait_ms=2.0) as svc:
+        got = svc.submit_rounds(mp, mb, cfg=cfg,
+                                decode=dec).result(timeout=300.0)
+        want = jax.tree.map(
+            np.asarray, simulate_rounds(mp, mb, cfg=cfg, decode=dec))
+        _assert_same(got, want, 'detached chunk')
+        assert svc.stats()['streaming']['open_sessions'] == 0
+        with pytest.raises(ValueError, match='rounds, n_shots'):
+            svc.submit_rounds(mp, mb[0], cfg=cfg)
+        with pytest.raises(ValueError, match='not both'):
+            svc.submit_rounds(mp, mb, cfg=cfg, deadline_ms=50.0,
+                              round_deadline_ms=10.0)
+        with pytest.raises(ValueError, match='out of range'):
+            svc.submit_rounds(mp, mb, cfg=cfg,
+                              decode=DecodeSpec('majority', (99,)))
+        with pytest.raises(ValueError, match='not open'):
+            svc.submit_rounds(mp, mb, cfg=cfg, stream=424242)
+
+
+@pytest.mark.serve
+def test_stream_round_deadline_miss_counts_every_round():
+    """Per-round deadlines are honored at scan-chunk boundaries: a
+    chunk expiring in queue raises DeadlineError and counts EVERY
+    round it carried as a miss; the session summary reports the failed
+    chunk without losing the session."""
+    mp, cfg, dec = _rep(3)
+    rng = np.random.default_rng(23)
+    mb = _planes(rng, 4, 3, mp, cfg)
+    # a huge batching window keeps the chunk queued until its
+    # (rounds x round_deadline_ms) deadline expires un-dispatched
+    with ExecutionService(max_batch_programs=64,
+                          max_wait_ms=60_000.0) as svc:
+        sess = svc.open_stream(mp, cfg=cfg, decode=dec,
+                               round_deadline_ms=15.0)
+        h = sess.submit_rounds(mb)
+        with pytest.raises(DeadlineError):
+            h.result(timeout=60.0)
+        summary = sess.close(timeout=60.0)
+        assert summary['failed_chunks'] == 1
+        assert isinstance(summary['errors'][0], DeadlineError)
+        st = svc.stats()['streaming']
+        assert st['round_deadline_misses'] == mb.shape[0]
+        assert st['rounds_served'] == 0
+
+
+@pytest.mark.serve
+def test_stream_session_ttl_expiry():
+    """An idle session past session_ttl_s is swept: sessions_expired
+    advances, a session_expired flight event records the sid, and a
+    late submit rejects typed — an abandoned stream cannot pin its
+    home executor forever."""
+    mp, cfg, dec = _rep(3)
+    rng = np.random.default_rng(24)
+    with ExecutionService(max_wait_ms=2.0, supervise_interval_ms=10.0,
+                          session_ttl_s=0.05) as svc:
+        sess = svc.open_stream(mp, cfg=cfg, decode=dec)
+        deadline = time.monotonic() + 30.0
+        while svc.stats()['streaming']['sessions_expired'] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        st = svc.stats()['streaming']
+        assert st['sessions_expired'] == 1
+        assert st['open_sessions'] == 0
+        events = svc.flight_recorder.events(kind='session_expired')
+        assert events and events[-1]['sid'] == sess.sid
+        with pytest.raises(ValueError, match='not open'):
+            sess.submit_rounds(_planes(rng, 2, 3, mp, cfg))
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+def test_stream_chunk_survives_chaos_crashes():
+    """Two scripted crashes under the ONLY executor while a chunk is
+    in flight: the attempt-token retry machinery re-dispatches the
+    whole scan and the session sees exactly one result, bit-identical
+    — no lost or duplicated round results under a killed dispatch."""
+    mp, cfg, dec = _rep(3)
+    rng = np.random.default_rng(25)
+    chunks = [_planes(rng, r, 3, mp, cfg) for r in (3, 2)]
+    plan = ChaosPlan(seed=0, script=('crash', 'crash'))
+    with ExecutionService(max_wait_ms=2.0, max_queue=1024,
+                          retry_policy=RetryPolicy(max_attempts=6,
+                                                   backoff_s=0.005),
+                          breaker_threshold=2, breaker_cooldown_ms=60.0,
+                          supervise_interval_ms=10.0) as svc:
+        sess = svc.open_stream(mp, cfg=cfg, decode=dec)
+        with ChaosMonkey(svc, plan) as monkey:
+            h = sess.submit_rounds(chunks[0])
+            got = h.result(timeout=300.0)
+        assert monkey.script_exhausted()
+        assert h.retries == 2
+        want = jax.tree.map(
+            np.asarray,
+            simulate_rounds(mp, chunks[0], cfg=cfg, decode=dec))
+        _assert_same(got, want, 'healed chunk')
+        # the session is still live on the healed service: a clean
+        # chunk serves and the summary counts exactly the submitted
+        # rounds (nothing double-completed through the stale attempt)
+        sess.submit_rounds(chunks[1])
+        summary = sess.close(timeout=300.0)
+        assert summary['failed_chunks'] == 0
+        assert summary['rounds'] == 5
+        assert summary['decoded'].shape == (3, 3)
+        assert svc.stats()['streaming']['rounds_served'] == 5
+
+
+# ---------------------------------------------------------------------------
+# fleet: sticky sessions surviving replica loss (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.fleet
+@pytest.mark.serve
+def test_fleet_stream_survives_home_replica_kill():
+    """The acceptance chaos drill at fleet scope: open a stream over
+    replica PROCESSES, SIGKILL the session's home replica mid-stream,
+    and every chunk — before and after the kill — completes
+    bit-identically with no lost or duplicated round results; the
+    session closes with a clean summary."""
+    from distributed_processor_tpu.serve.fleet import Fleet
+    mp, cfg, dec = _rep(3)
+    rng = np.random.default_rng(26)
+    chunks = [_planes(rng, 2, 4, mp, cfg) for _ in range(5)]
+    refs = [jax.tree.map(np.asarray,
+                         simulate_rounds(mp, mb, cfg=cfg, decode=dec))
+            for mb in chunks]
+    with Fleet(2,
+               service={'max_batch_programs': 4, 'max_wait_ms': 5.0,
+                        'max_queue': 256},
+               env={'XLA_FLAGS':
+                    '--xla_force_host_platform_device_count=1'},
+               router_kwargs={'retry_policy':
+                              RetryPolicy(max_attempts=10,
+                                          backoff_s=0.05,
+                                          max_backoff_s=1.0)}) as f:
+        sess = f.open_stream(mp, cfg=cfg, decode=dec)
+        for mb in chunks[:3]:
+            sess.submit_rounds(mb)
+        for i, got in zip(range(3), sess.results(timeout=600.0)):
+            _assert_same(got, refs[i], f'chunk {i} pre-kill')
+        # the whole session is pinned to one home replica; kill it
+        home_rid = f.router._home.get(('stream', sess.sid))
+        assert home_rid is not None, 'stream never homed'
+        f.kill(f.replica_ids().index(home_rid))
+        for mb in chunks[3:]:
+            sess.submit_rounds(mb)
+        for i, got in zip(range(3, 5), sess.results(timeout=600.0)):
+            _assert_same(got, refs[i], f'chunk {i} post-kill')
+        summary = sess.close(timeout=600.0)
+        assert summary['failed_chunks'] == 0
+        assert summary['chunks'] == 5 and summary['rounds'] == 10
+        np.testing.assert_array_equal(
+            summary['decoded'],
+            np.asarray(decode_history(summary['syndrome_hist'],
+                                      dec.scheme)))
+        st = f.router.stats()
+        assert st['streaming']['rounds_submitted'] == 10
+        assert st['streaming']['open_sessions'] == 0

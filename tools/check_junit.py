@@ -74,6 +74,14 @@ NO_SKIP_MODULES = {
         'a skip means the feedback bit-identity contract '
         '(docs/PERF.md "Feedback on the fast engines") stopped being '
         'exercised',
+    'test_qec_stream':
+        'streaming-QEC tests (rounds scan vs sequential bit-identity, '
+        'decoder fuzz vs the brute-force oracle, stream sessions '
+        'surviving chaos kills) run on pure CPU with injected '
+        'measurement planes, with no hardware dependency — a skip '
+        'means the streaming contract (docs/SERVING.md "Streaming '
+        'sessions", docs/PERF.md "Streaming QEC") stopped being '
+        'exercised',
 }
 
 # the multi-device serve suite may skip ONLY on a genuinely
