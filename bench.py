@@ -48,6 +48,12 @@ BENCH_COMPILE_TENANTS/BENCH_COMPILE_PROGRAMS/BENCH_COMPILE_DEPTH/
 BENCH_COMPILE_SHOTS/BENCH_COMPILE_THREADS (the compile front-door row:
 tenants x distinct programs of that RB depth, shots per submit_source
 request, stampede width; defaults 4/4/4/8/8),
+BENCH_TENANT_VICTIMS/BENCH_TENANT_GREEDY/BENCH_TENANT_SHOTS/
+BENCH_TENANT_DEPTH/BENCH_TENANT_WEIGHT/BENCH_TENANT_RATIO (the
+tenant-isolation row: victim request count, greedy backlog factor,
+shots per request, RB depth, victim DRR weight, and the max allowed
+fair-on/fair-off victim-p99 ratio asserted before reporting; defaults
+8/8/8/2/8/1.5),
 BENCH_OBS_REQS/BENCH_OBS_SHOTS/BENCH_OBS_SAMPLE (the observability
 overhead row: workload shape and the intermediate trace-sampling
 fraction, defaults 32/32/0.25; BENCH_OBS=0 skips the row),
@@ -137,7 +143,7 @@ from distributed_processor_tpu.serve.benchmark import (
     availability_under_chaos, compile_front_door,
     continuous_batching_comparison, fleet_failover,
     fleet_observability_overhead, multi_device_scaling,
-    open_loop_latency)
+    open_loop_latency, tenant_isolation)
 from distributed_processor_tpu.sim.interpreter import InterpreterConfig
 from distributed_processor_tpu.sim.physics import (
     ReadoutPhysics, run_physics_batch, prepare_physics_tables)
@@ -1378,6 +1384,9 @@ def _degraded_rerun(attempts):
                  ('BENCH_COMPILE_PROGRAMS', '2'),
                  ('BENCH_COMPILE_DEPTH', '2'),
                  ('BENCH_COMPILE_SHOTS', '8'),
+                 ('BENCH_TENANT_VICTIMS', '4'),
+                 ('BENCH_TENANT_GREEDY', '6'),
+                 ('BENCH_TENANT_SHOTS', '4'),
                  ('BENCH_OBS_REQS', '8'), ('BENCH_OBS_SHOTS', '8'),
                  ('BENCH_OBS_FLEET_REQS', '12'),
                  ('BENCH_OBS_FLEET_SHOTS', '8'),
@@ -1634,6 +1643,24 @@ def _compile_front_door_row():
         seed=int(os.environ.get('BENCH_COMPILE_SEED', 0)),
         stampede_threads=int(os.environ.get('BENCH_COMPILE_THREADS',
                                             8)))
+
+
+def _tenant_isolation_row():
+    """Tenant isolation: a greedy tenant dumps its whole backlog ahead
+    of a victim's trickle, measured fair-off (arrival order) vs
+    fair-on (weighted deficit round-robin).  The row asserts the
+    isolation contract before reporting — zero victim sheds, exact
+    victim billing (metered shots == ground truth), fair-on victim p99
+    within a bounded ratio of fair-off — then reports both victim
+    tails (serve/benchmark.py tenant_isolation)."""
+    return tenant_isolation(
+        n_victim=int(os.environ.get('BENCH_TENANT_VICTIMS', 8)),
+        greedy_factor=int(os.environ.get('BENCH_TENANT_GREEDY', 8)),
+        shots=int(os.environ.get('BENCH_TENANT_SHOTS', 8)),
+        depth=int(os.environ.get('BENCH_TENANT_DEPTH', 2)),
+        seed=int(os.environ.get('BENCH_TENANT_SEED', 0)),
+        victim_weight=float(os.environ.get('BENCH_TENANT_WEIGHT', 8)),
+        max_p99_ratio=float(os.environ.get('BENCH_TENANT_RATIO', 1.5)))
 
 
 def _ici_fabric_row():
@@ -2238,6 +2265,18 @@ def main():
         front_door = {'error': f'{type(e).__name__}: {e}'[:200]}
     artifact.row('compile_front_door', front_door)
 
+    # tenant-isolation row: greedy backlog vs victim trickle, fair-off
+    # vs fair-on — isolation contract (zero victim sheds, exact
+    # billing, bounded p99) asserted inside before any number reports
+    try:
+        tenant_row = _timed_row(_tenant_isolation_row) \
+            if secondaries else None
+    except _RowTimeout as e:
+        tenant_row = {'error': 'timeout', 'detail': str(e)}
+    except Exception as e:      # pragma: no cover - defensive
+        tenant_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+    artifact.row('tenant_isolation', tenant_row)
+
     # observability-overhead row: the continuous-batching workload at
     # trace_sample off / sampled / full — what the flight-deck costs
     # when it is off (nothing) and when it is on (BENCH_OBS_* knobs)
@@ -2371,6 +2410,7 @@ def main():
             'availability_under_chaos': serve_chaos,
             'fleet_failover': fleet_row,
             'compile_front_door': front_door,
+            'tenant_isolation': tenant_row,
             'observability_overhead': obs_row,
             'fleet_observability_overhead': fleet_obs_row,
             'integrity_overhead': integrity_row,

@@ -472,6 +472,47 @@ def cmd_trace_view(args):
         print(format_table(summary))
 
 
+_TENANT_VIEW_COLS = ('tenant', 'weight', 'queued', 'submitted',
+                     'completed', 'failed', 'shed', 'quota_rejected',
+                     'shots', 'device_ms', 'compile_ms', 'bytes_wire')
+
+
+def _print_tenant_view(tenant_rows: dict, as_json: bool) -> None:
+    """``fleet-status --tenants``: fold each replica's
+    ``stats()['tenants']`` block into one fleet-level row per tenant
+    (meters summed — they are monotone billing counters, so summation
+    is exact; ``weight`` is shared config, reported once).  Table by
+    default, the full per-replica breakdown with ``--json``."""
+    agg = {}
+    for per_tenant in tenant_rows.values():
+        for tenant, row in per_tenant.items():
+            a = agg.setdefault(tenant, {c: 0 for c in
+                                        _TENANT_VIEW_COLS[2:]})
+            a['weight'] = row.get('weight', 1.0)
+            for c in _TENANT_VIEW_COLS[2:]:
+                a[c] += row.get(c, 0)
+    if as_json:
+        print(json.dumps({'tenants': agg, 'replicas': tenant_rows},
+                         indent=2))
+        return
+    if not agg:
+        print('no tenant traffic recorded yet')
+        return
+    out = []
+    for tenant in sorted(agg):
+        r = {'tenant': tenant}
+        for c in _TENANT_VIEW_COLS[1:]:
+            v = agg[tenant].get(c, 0)
+            r[c] = round(v, 1) if isinstance(v, float) else v
+        out.append(r)
+    widths = {c: max(len(c), *(len(str(r[c])) for r in out))
+              for c in _TENANT_VIEW_COLS}
+    print('  '.join(c.ljust(widths[c]) for c in _TENANT_VIEW_COLS))
+    for r in out:
+        print('  '.join(str(r[c]).ljust(widths[c])
+                        for c in _TENANT_VIEW_COLS))
+
+
 def cmd_fleet_status(args):
     """Live fleet flight deck: poll each replica DIRECTLY over the
     fleet wire (the same ``gossip`` / ``fleet-metrics`` ops the router
@@ -482,6 +523,7 @@ def cmd_fleet_status(args):
     "Fleet observability")."""
     from .serve.transport import ReplicaClient
     rows, snaps, errors = [], {}, []
+    tenant_rows = {}        # addr -> stats()['tenants'] block
     for addr in args.replica:
         host, _, port = addr.rpartition(':')
         host = host or '127.0.0.1'
@@ -505,6 +547,7 @@ def cmd_fleet_status(args):
             client.close()
         st = g.get('stats', {})
         fl = g.get('flight', {})
+        tenant_rows[addr] = st.get('tenants') or {}
         # mismatches/audits (plus any scrubber quarantines): a nonzero
         # numerator is a silent-data-corruption alarm, not noise
         ig = st.get('integrity') or {}
@@ -524,6 +567,9 @@ def cmd_fleet_status(args):
         for addr, err in errors:
             print(f'fleet-status: {addr}: {err}', file=sys.stderr)
         raise SystemExit('fleet-status: no replica reachable')
+    if args.tenants:
+        _print_tenant_view(tenant_rows, as_json=args.json)
+        return
     if args.prometheus:
         from .obs import merged_prometheus_text
         lines = merged_prometheus_text(snaps, label='replica')
@@ -939,6 +985,14 @@ def main(argv=None):
                    help='print the merged Prometheus exposition '
                         '(every metric with a replica label + fleet '
                         'rollups) instead of the table')
+    p.add_argument('--tenants', action='store_true',
+                   help='per-tenant flight deck instead of the replica '
+                        'table: queued/served/shed/quota-rejected plus '
+                        'the billing meters (shots, device-ms, '
+                        'compile-ms, bytes-on-wire) summed across '
+                        'replicas; combine with --json for the '
+                        'per-replica breakdown (docs/SERVING.md '
+                        '"Tenants")')
     p.add_argument('--json', action='store_true',
                    help='emit the status rows as JSON')
     p.add_argument('--timeout', type=float, default=5.0,

@@ -15,10 +15,11 @@ layers emit into:
 """
 
 from .clock import ClockOffsetEstimator
-from .metrics import (DEFAULT_BUCKETS, Histogram, MetricsRegistry,
-                      default_registry, escape_label_value,
+from .metrics import (DEFAULT_BUCKETS, TENANT_METERS, Histogram,
+                      MetricsRegistry, default_registry,
+                      escape_label_value, merge_tenant_usage,
                       merged_prometheus_text,
-                      prometheus_snapshot_lines)
+                      prometheus_snapshot_lines, tenant_usage)
 from .recorder import FlightRecorder
 from .trace import (STAGE_ORDER, TraceContext, Tracer,
                     chrome_trace_events, write_chrome_trace)
@@ -30,8 +31,11 @@ __all__ = [
     'MetricsRegistry',
     'default_registry',
     'escape_label_value',
+    'merge_tenant_usage',
     'merged_prometheus_text',
     'prometheus_snapshot_lines',
+    'TENANT_METERS',
+    'tenant_usage',
     'FlightRecorder',
     'STAGE_ORDER',
     'TraceContext',

@@ -161,6 +161,50 @@ def merged_prometheus_text(snapshots: dict, label: str = 'replica'
     return lines
 
 
+# billing-grade per-tenant meter suffixes: the ``tenant.<name>.<meter>``
+# counter family the serving tier emits (docs/SERVING.md "Tenants").
+# Fixed set so tenant names containing dots still parse unambiguously —
+# the meter is always the LAST dotted segment and always one of these.
+TENANT_METERS = ('submitted', 'completed', 'failed', 'shed',
+                 'quota_rejected', 'shots', 'device_ms', 'compile_ms',
+                 'bytes_wire')
+
+
+def tenant_usage(snap: dict) -> dict:
+    """Fold the ``tenant.<name>.<meter>`` counter family out of a
+    registry :meth:`MetricsRegistry.snapshot` (or a bare counters dict)
+    into ``{tenant: {meter: value}}`` usage rows, zero-filled over
+    :data:`TENANT_METERS`.  Fleet tooling sums these rows across
+    replica snapshots to get fleet-level billing totals — counters are
+    monotone, so summation is exact."""
+    counters = snap.get('counters', snap) if isinstance(snap, dict) \
+        else {}
+    out = {}
+    for name, val in counters.items():
+        if not isinstance(name, str) or not name.startswith('tenant.'):
+            continue
+        tenant, sep, meter = name[len('tenant.'):].rpartition('.')
+        if not sep or meter not in TENANT_METERS:
+            continue
+        row = out.setdefault(tenant, {m: 0 for m in TENANT_METERS})
+        row[meter] = val
+    return out
+
+
+def merge_tenant_usage(per_process: dict) -> dict:
+    """Sum :func:`tenant_usage` rows across processes: maps
+    ``{process_id: usage_rows}`` → one fleet-level ``{tenant:
+    {meter: total}}`` rollup."""
+    out = {}
+    for rows in per_process.values():
+        for tenant, row in rows.items():
+            agg = out.setdefault(tenant,
+                                 {m: 0 for m in TENANT_METERS})
+            for m in TENANT_METERS:
+                agg[m] += row.get(m, 0)
+    return out
+
+
 class Histogram:
     """Fixed-bucket histogram with a bounded exact-sample window.
 

@@ -23,6 +23,14 @@ failures retry on healthy executors under a bounded
 sheds or rejects work with :class:`OverloadError` instead of letting
 queues grow into missed deadlines.  ``serve.chaos`` injects seeded
 crashes/hangs/slowdowns under ``_run_batch`` to prove all of it.
+
+Shared capacity is tenant-fair (docs/SERVING.md "Tenants"): every
+submission carries a tenant identity end-to-end, the coalescer runs
+weighted deficit round-robin across tenants, admission enforces
+per-tenant quotas with the typed, non-retryable
+:class:`QuotaExceededError`, usage is metered exactly-once into
+billing-grade ``tenant.*`` counters, and per-tenant SLO budgets close
+the loop through the Fleet's :class:`AutoscalePolicy`.
 """
 
 from ..integrity import IntegrityError
@@ -31,10 +39,11 @@ from .bucketspec import BucketSpec
 from .catalog import BucketCatalog
 from .chaos import (ChaosError, ChaosMonkey, ChaosPlan,
                     ChaosThreadDeath, FleetSoakReport, SoakReport,
-                    fleet_soak, soak)
-from .fleet import Fleet
+                    TenantSoakReport, fleet_soak, soak, tenant_soak)
+from .fleet import AutoscalePolicy, Fleet
 from .request import (CancelledError, DeadlineError, ExecutorLostError,
-                      OverloadError, QueueFullError, RequestHandle,
+                      OverloadError, QueueFullError,
+                      QuotaExceededError, RequestHandle,
                       ServiceClosedError, ShutdownError)
 from .router import (ROUTER_THREAD_PREFIX, FleetRouter,
                      is_terminal_error)
@@ -49,6 +58,7 @@ from .transport import (WIRE_THREAD_PREFIX, ReplicaClient,
                         WireCorruptionError)
 
 __all__ = [
+    'AutoscalePolicy',
     'BucketCatalog',
     'BucketSpec',
     'CANARY_THREAD_PREFIX',
@@ -72,6 +82,7 @@ __all__ = [
     'IntegrityError',
     'OverloadError',
     'QueueFullError',
+    'QuotaExceededError',
     'ROUTER_THREAD_PREFIX',
     'ReplicaClient',
     'ReplicaLostError',
@@ -84,6 +95,7 @@ __all__ = [
     'SoakReport',
     'StreamKey',
     'StreamSession',
+    'TenantSoakReport',
     'WARMUP_THREAD_PREFIX',
     'WIRE_THREAD_PREFIX',
     'WireCorruptionError',
@@ -91,4 +103,5 @@ __all__ = [
     'fleet_soak',
     'is_terminal_error',
     'soak',
+    'tenant_soak',
 ]

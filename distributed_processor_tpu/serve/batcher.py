@@ -35,6 +35,16 @@ FIFO arrival as the tiebreak; a bucket becomes ripe when it holds
 ``max_batch_programs`` requests or its oldest member has waited
 ``max_wait_ms`` — the classic continuous-batching latency/throughput
 dial (docs/SERVING.md).
+
+With tenant fair queueing on (docs/SERVING.md "Tenants"), a deficit
+round-robin layer sits ABOVE that order: each ``pop_batch`` replenishes
+every backlogged tenant's credit by its configured weight, serves the
+most-credited tenant first, and charges one credit per claimed request
+— so claim order interleaves tenants by weight instead of strict
+global FIFO, and a greedy tenant's thousandth request cannot starve a
+victim's first.  Within a tenant, (priority desc, arrival asc) order
+is unchanged, and a single-tenant queue reduces exactly to the legacy
+behavior.
 """
 
 from __future__ import annotations
@@ -50,14 +60,30 @@ def bucket_key(mp, cfg) -> BucketSpec:
     return BucketSpec.from_program(mp, cfg)
 
 
+def shed_exempt(req: Request) -> bool:
+    """Work the overload shedder may NEVER evict, regardless of another
+    tenant's admission pressure: in-flight stream chunks (``rounds``/
+    ``sid`` set — killing one round breaks a live session's exactly-
+    once contract) and service-internal work carrying a negative
+    ``seq`` (canary probes, SDC audit re-executions)."""
+    return req.rounds is not None or req.sid is not None or req.seq < 0
+
+
 class Coalescer:
     """Per-bucket pending queues.  NOT thread-safe on its own: every
     method is called under the service's lock — the coalescer is the
     data structure, the service owns the concurrency."""
 
-    def __init__(self, max_batch_programs: int, max_wait_s: float):
+    def __init__(self, max_batch_programs: int, max_wait_s: float,
+                 tenant_weights: dict = None):
         self.max_batch_programs = max_batch_programs
         self.max_wait_s = max_wait_s
+        # weighted fair queueing: None keeps the legacy global
+        # (priority, arrival) claim order; a dict — the service's LIVE
+        # view of configured weights, unknown tenants defaulting to
+        # 1.0 — turns on deficit round-robin across tenants
+        self._weights = tenant_weights
+        self._deficit: dict = {}    # tenant -> accumulated DRR credit
         self._buckets: dict = {}     # key -> list[Request], arrival order
         self._depth = 0
         # buckets that ripened elsewhere and were migrated in by work
@@ -137,28 +163,32 @@ class Coalescer:
         Among ripe buckets the one whose best request has the highest
         priority wins (oldest arrival breaks the tie); within the
         bucket, up to ``max_batch_programs`` requests leave in
-        (priority desc, arrival asc) order.  Every returned request has
-        been atomically claimed — ``cancel()`` on it returns False from
-        here on.
+        (priority desc, arrival asc) order.  With tenant fair queueing
+        on, deficit round-robin picks the serving tenant first and the
+        bucket/claim order interleaves tenants by weight (see module
+        docstring).  Every returned request has been atomically
+        claimed — ``cancel()`` on it returns False from here on.
         """
         if now is None:
             now = time.monotonic()
         expired = self._prune(now)
-        best_key, best_rank = None, None
-        for key, reqs in self._buckets.items():
-            if key not in self._forced \
-                    and not self._ripe(reqs, now, flush):
-                continue
-            head = min(reqs, key=lambda r: (-r.priority, r.seq))
-            rank = (-head.priority, head.seq)
-            if best_rank is None or rank < best_rank:
-                best_key, best_rank = key, rank
-        if best_key is None:
+        ripe = {key: reqs for key, reqs in self._buckets.items()
+                if key in self._forced or self._ripe(reqs, now, flush)}
+        if not ripe:
             return None, [], expired
-        reqs = sorted(self._buckets[best_key],
-                      key=lambda r: (-r.priority, r.seq))
-        take, leave = (reqs[:self.max_batch_programs],
-                       reqs[self.max_batch_programs:])
+        if self._weights is None:
+            best_key, best_rank = None, None
+            for key, reqs in ripe.items():
+                head = min(reqs, key=lambda r: (-r.priority, r.seq))
+                rank = (-head.priority, head.seq)
+                if best_rank is None or rank < best_rank:
+                    best_key, best_rank = key, rank
+            reqs = sorted(self._buckets[best_key],
+                          key=lambda r: (-r.priority, r.seq))
+            take, leave = (reqs[:self.max_batch_programs],
+                           reqs[self.max_batch_programs:])
+        else:
+            best_key, take, leave = self._pop_drr(ripe)
         batch = []
         for r in take:
             tok = r.handle._claim()
@@ -180,6 +210,86 @@ class Coalescer:
         if not batch:       # every candidate was cancelled in the race
             return None, [], expired
         return best_key, batch, expired
+
+    def _weight(self, tenant: str) -> float:
+        try:
+            w = float(self._weights.get(tenant, 1.0))
+        except (TypeError, ValueError):
+            w = 1.0
+        return w if w > 0 else 1.0
+
+    def _pop_drr(self, ripe: dict):
+        """Deficit-round-robin selection: pick the serving tenant, then
+        the bucket holding its best work, then claim up to
+        ``max_batch_programs`` requests interleaving tenants.  Returns
+        ``(key, take, leave)`` for ``pop_batch`` to claim/write back.
+
+        Classic DRR rules: every tenant with ripe backlog earns its
+        weight in credit per visit (capped at weight x batch size so an
+        idle-then-bursting tenant cannot bank unbounded credit), a
+        drained tenant forfeits its credit, and each claimed request
+        costs one credit.  A single-tenant queue degenerates to the
+        legacy (priority desc, arrival asc) order exactly.
+        """
+        oldest = {}
+        for reqs in ripe.values():
+            for r in reqs:
+                if r.tenant not in oldest or r.seq < oldest[r.tenant]:
+                    oldest[r.tenant] = r.seq
+        for t in list(self._deficit):
+            if t not in oldest:
+                del self._deficit[t]
+        cap = float(max(self.max_batch_programs, 1))
+        for t in oldest:
+            w = self._weight(t)
+            # cap floor of 1.0 x batch: a sub-unit weight must still
+            # be able to bank one whole credit, or it could never claim
+            self._deficit[t] = min(self._deficit.get(t, 0.0) + w,
+                                   max(w, 1.0) * cap)
+        serve = min(oldest,
+                    key=lambda t: (-self._deficit[t], oldest[t]))
+        best_key, best_rank = None, None
+        for key, reqs in ripe.items():
+            mine = [r for r in reqs if r.tenant == serve]
+            if not mine:
+                continue
+            head = min(mine, key=lambda r: (-r.priority, r.seq))
+            rank = (-head.priority, head.seq)
+            if best_rank is None or rank < best_rank:
+                best_key, best_rank = key, rank
+        by_t = {}
+        for r in self._buckets[best_key]:
+            by_t.setdefault(r.tenant, []).append(r)
+        for q in by_t.values():
+            q.sort(key=lambda r: (-r.priority, r.seq))
+        torder = sorted(by_t, key=lambda t: (
+            t != serve, -self._deficit.get(t, 0.0),
+            min(r.seq for r in by_t[t])))
+        take = []
+        while len(take) < self.max_batch_programs \
+                and any(by_t.values()):
+            progressed = False
+            for t in torder:
+                q = by_t[t]
+                while q and len(take) < self.max_batch_programs \
+                        and self._deficit.get(t, 0.0) >= 1.0:
+                    take.append(q.pop(0))
+                    self._deficit[t] -= 1.0
+                    progressed = True
+            if not progressed:
+                # credit exhausted with batch slots still open: start
+                # another DRR round for the tenants still backlogged
+                # HERE, so one pop's composition honors the weights
+                # (weight w > 0 guarantees this replenish eventually
+                # banks a whole credit — the loop terminates)
+                for t in torder:
+                    if by_t[t]:
+                        w = self._weight(t)
+                        self._deficit[t] = min(
+                            self._deficit.get(t, 0.0) + w,
+                            max(w, 1.0) * cap)
+        leave = [r for q in by_t.values() for r in q]
+        return best_key, take, leave
 
     def ripe_keys(self, now: float = None, flush: bool = False) -> list:
         """Keys of the buckets a dispatcher could claim right now, best
@@ -234,22 +344,31 @@ class Coalescer:
         self._depth = 0
         return out
 
-    def shed_candidate(self, below_priority: int):
+    def shed_candidate(self, below_priority: int,
+                       tenant_pressure: dict = None):
         """The single most-sheddable queued request strictly below
-        ``below_priority`` — lowest priority first, newest arrival
-        within it (the request that has invested the least waiting) —
-        as ``(key, req)``, or None.  A pure view: the service compares
-        candidates ACROSS executor queues before calling
-        :meth:`remove` on the loser's, then fails it with
-        ``OverloadError`` (the overload-control eviction path)."""
-        worst, worst_key = None, None
+        ``below_priority`` — the most-over-quota tenant first (per the
+        service-supplied ``tenant_pressure`` map, higher = more over
+        quota), then lowest priority, then newest arrival within it
+        (the request that has invested the least waiting) — as
+        ``(key, req)``, or None.  Stream chunks and service-internal
+        work are exempt (:func:`shed_exempt`): another tenant's
+        admission pressure must never break a live session.  A pure
+        view: the service compares candidates ACROSS executor queues
+        before calling :meth:`remove` on the loser's, then fails it
+        with ``OverloadError`` (the overload-control eviction path)."""
+        worst, worst_key, worst_rank = None, None, None
         for key, reqs in self._buckets.items():
             for r in reqs:
                 if r.priority >= below_priority or r.handle.done():
                     continue
-                if worst is None or (r.priority, -r.seq) \
-                        < (worst.priority, -worst.seq):
-                    worst, worst_key = r, key
+                if shed_exempt(r):
+                    continue
+                p = 0.0 if tenant_pressure is None else \
+                    float(tenant_pressure.get(r.tenant, 0.0))
+                rank = (-p, r.priority, -r.seq)
+                if worst_rank is None or rank < worst_rank:
+                    worst, worst_key, worst_rank = r, key, rank
         if worst is None:
             return None
         return worst_key, worst

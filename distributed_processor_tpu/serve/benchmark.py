@@ -626,6 +626,119 @@ def availability_under_chaos(n_reqs: int = 80, rate_hz: float = 60.0,
     }
 
 
+def tenant_isolation(n_victim: int = 8, greedy_factor: int = 8,
+                     n_qubits: int = 2, depth: int = 2,
+                     shots: int = 8, seed: int = 0,
+                     max_batch_programs: int = 4,
+                     max_wait_ms: float = 5.0,
+                     victim_weight: float = 8.0,
+                     max_p99_ratio: float = 1.5,
+                     p99_slack_ms: float = 250.0) -> dict:
+    """Tenant isolation headline: what weighted fair queueing buys the
+    victim of a greedy neighbor (docs/SERVING.md "Tenants").
+
+    One adversarial arrival shape, two fresh services: a greedy tenant
+    dumps its whole backlog (``greedy_factor * n_victim`` requests)
+    into the queue, then a victim tenant submits ``n_victim`` requests
+    behind it.  Fair-OFF (``tenant_fair=False`` — the pre-tenant
+    arrival-order scheduler) makes the victim wait out the entire
+    greedy backlog; fair-ON runs deficit round-robin with the victim
+    weighted ``victim_weight``x, interleaving it into the very next
+    batches.  Both rounds are AOT-warmed first and every victim
+    completion is asserted bit-identical to its solo dispatch.  The
+    fair-ON round must additionally hold the isolation contract before
+    any number is reported: ZERO victim sheds, zero victim quota
+    rejections, EXACTLY ``n_victim * shots`` metered victim shots
+    (billing ground truth), and a victim p99 within ``max_p99_ratio``
+    of the fair-OFF p99 plus ``p99_slack_ms`` (on fast hosts the
+    greedy backlog drains quickly and both tails are small — the bound
+    guards regression, the reported tails are the evidence).
+    """
+    n_greedy = greedy_factor * n_victim
+    mps, bits, cfg = _workload(n_victim, n_qubits, depth, shots, seed)
+    refs = _solo_refs(mps, bits, cfg)
+    tenants = {'greedy': {'weight': 1.0},
+               'victim': {'weight': float(victim_weight)}}
+    rounds = {}
+    for label, fair in (('fair_off', False), ('fair_on', True)):
+        svc = ExecutionService(
+            cfg, max_batch_programs=max_batch_programs,
+            max_wait_ms=max_wait_ms,
+            max_queue=4 * (n_greedy + n_victim),
+            tenants=tenants, tenant_fair=fair)
+        try:
+            _warm_pow2(svc, mps[0], shots,
+                       max_programs=max_batch_programs)
+            t0 = time.perf_counter()
+            greedy_handles = [
+                svc.submit(mps[i % len(mps)], bits[i % len(bits)],
+                           tenant='greedy')
+                for i in range(n_greedy)]
+            victim = []                 # (handle, ref idx, t_submit)
+            for i in range(n_victim):
+                victim.append((svc.submit(mps[i], bits[i],
+                                          tenant='victim'),
+                               i, time.perf_counter()))
+            lat_ms = []
+            for h, i, ts in victim:
+                got = h.result(timeout=600)
+                lat_ms.append((time.perf_counter() - ts) * 1e3)
+                want = refs[i]
+                for k in want:
+                    if not np.array_equal(np.asarray(got[k]),
+                                          np.asarray(want[k])):
+                        raise AssertionError(
+                            f'{label}: victim request {i} diverged '
+                            f'from solo dispatch on {k!r}')
+            for h in greedy_handles:
+                h.result(timeout=600)
+            wall = time.perf_counter() - t0
+            ts = svc.stats()['tenants']
+        finally:
+            svc.shutdown()
+        rounds[label] = {
+            'victim_p50_ms': round(float(np.percentile(lat_ms, 50)), 3),
+            'victim_p99_ms': round(float(np.percentile(lat_ms, 99)), 3),
+            'wall_s': round(wall, 4),
+            'victim': {k: ts['victim'][k] for k in
+                       ('completed', 'shed', 'quota_rejected',
+                        'shots')},
+            'greedy_completed': ts['greedy']['completed'],
+        }
+    on, off = rounds['fair_on'], rounds['fair_off']
+    v = on['victim']
+    if v['shed'] or v['quota_rejected']:
+        raise AssertionError(
+            f"fair-on round shed {v['shed']} / quota-rejected "
+            f"{v['quota_rejected']} victim request(s) — the greedy "
+            f'tenant exported its pain')
+    if v['shots'] != n_victim * shots:
+        raise AssertionError(
+            f"victim metered {v['shots']} shots, ground truth is "
+            f'{n_victim * shots} — billing is not exactly-once')
+    if on['victim_p99_ms'] > (max_p99_ratio * off['victim_p99_ms']
+                              + p99_slack_ms):
+        raise AssertionError(
+            f"fair-on victim p99 {on['victim_p99_ms']}ms exceeds "
+            f"{max_p99_ratio}x the fair-off p99 "
+            f"{off['victim_p99_ms']}ms (+{p99_slack_ms}ms slack) — "
+            f'fair queueing made the victim WORSE')
+    return {
+        'n_victim': n_victim, 'n_greedy': n_greedy,
+        'shots_per_req': shots, 'victim_weight': victim_weight,
+        **rounds,
+        'victim_p99_ratio_on_vs_off': (
+            round(on['victim_p99_ms'] / off['victim_p99_ms'], 3)
+            if off['victim_p99_ms'] > 0 else None),
+        'bit_identical': True,
+        'note': 'greedy backlog submitted first, victim behind it; '
+                'fair-off = arrival order, fair-on = DRR with the '
+                'victim weighted; victim completions bit-checked vs '
+                'solo dispatch; fair-on asserted zero victim sheds, '
+                'exact victim billing, bounded p99 before reporting',
+    }
+
+
 def fleet_failover(n_replicas: int = 2, n_reqs: int = 60,
                    rate_hz: float = 30.0, n_qubits: int = 2,
                    depth: int = 2, shots: int = 8, seed: int = 0,
@@ -982,6 +1095,14 @@ def _main(argv=None):
     f.add_argument('--qubits', type=int, default=2)
     f.add_argument('--seed', type=int, default=0)
     f.add_argument('--stampede', type=int, default=8)
+    t = sub.add_parser('tenants', help='tenant-isolation row')
+    t.add_argument('--victims', type=int, default=8)
+    t.add_argument('--greedy-factor', type=int, default=8)
+    t.add_argument('--shots', type=int, default=8)
+    t.add_argument('--depth', type=int, default=2)
+    t.add_argument('--qubits', type=int, default=2)
+    t.add_argument('--seed', type=int, default=0)
+    t.add_argument('--victim-weight', type=float, default=8.0)
     c = sub.add_parser('chaos', help='availability-under-chaos row')
     c.add_argument('--reqs', type=int, default=80)
     c.add_argument('--rate', type=float, default=60.0)
@@ -1005,6 +1126,11 @@ def _main(argv=None):
             depths=[int(x) for x in args.depths.split(',') if x],
             shots=args.shots, seed=args.seed, devices=args.devices,
             slo=args.slo, warmup_catalog=args.warmup_catalog)
+    elif args.mode == 'tenants':
+        row = tenant_isolation(
+            n_victim=args.victims, greedy_factor=args.greedy_factor,
+            n_qubits=args.qubits, depth=args.depth, shots=args.shots,
+            seed=args.seed, victim_weight=args.victim_weight)
     elif args.mode == 'frontdoor':
         row = compile_front_door(
             n_tenants=args.tenants, n_programs=args.programs,

@@ -323,6 +323,119 @@ def soak(svc, mps, cfg, *, n_requests: int = 100, shots: int = 3,
 
 
 @dataclass
+class TenantSoakReport(SoakReport):
+    """:class:`SoakReport` plus the multi-tenant ledger: ``per_tenant``
+    maps tenant → observed ground truth (submitted / completed / shed /
+    quota_rejected / shots, counted from the caller's side of every
+    handle), and ``meter_mismatches`` lists every disagreement between
+    that ground truth and the service's billing meters
+    (``stats()['tenants']``).  A healthy service under chaos holds
+    ``hung == 0``, ``bit_mismatches == 0`` AND ``meter_mismatches ==
+    []`` — injected crashes and retries may neither lose nor
+    double-count a tenant's usage (docs/SERVING.md "Tenants")."""
+    per_tenant: dict = field(default_factory=dict)
+    meter_mismatches: list = field(default_factory=list)
+
+
+def tenant_soak(svc, mps, cfg, *, tenants, n_requests: int = 100,
+                shots: int = 3, seed: int = 0, greedy: str = None,
+                greedy_factor: int = 4,
+                result_timeout_s: float = 120.0) -> TenantSoakReport:
+    """:func:`soak`, with every submission tagged to a tenant and the
+    billing meters audited against caller-side ground truth.
+
+    Submissions cycle over ``tenants``; when ``greedy`` names one of
+    them, that tenant is scheduled ``greedy_factor`` extra slots per
+    cycle — the adversarial shape: one tenant floods admission while
+    the others trickle.  Greedy overflow is expected to bounce off its
+    own quota (:class:`~.request.QuotaExceededError` counts as
+    ``quota_rejected``, not an error); victim requests must complete.
+
+    After every handle terminates, the report's ``meter_mismatches``
+    records any tenant whose service-side meters disagree with what
+    this driver actually observed: ``completed``, ``shed``,
+    ``quota_rejected`` must match exactly, and ``shots`` must equal
+    ``completed * shots`` — the exactly-once contract: a chaos retry
+    that re-runs a batch may not bill the tenant twice, and a crash
+    that loses an attempt may not bill at all.
+    """
+    tenants = list(tenants)
+    if greedy is not None and greedy not in tenants:
+        raise ValueError(f'greedy tenant {greedy!r} not in {tenants}')
+    cycle = list(tenants)
+    if greedy is not None:
+        cycle = [greedy] * greedy_factor + \
+            [t for t in tenants if t != greedy]
+    rng = np.random.default_rng(seed)
+    bits = {i: rng.integers(0, 2, size=(shots, mp.n_cores,
+                                        cfg.max_meas)).astype(np.int32)
+            for i, mp in enumerate(mps)}
+    refs = {}
+    report = TenantSoakReport()
+    zero = dict(submitted=0, completed=0, shed=0, quota_rejected=0,
+                shots=0)
+    ledger = {t: dict(zero) for t in tenants}
+    pending = []
+    for i in range(n_requests):
+        tenant = cycle[i % len(cycle)]
+        pi = i % len(mps)
+        t0 = time.monotonic()
+        try:
+            handle = svc.submit(mps[pi], bits[pi], cfg=cfg,
+                                tenant=tenant)
+        except Exception as exc:     # noqa: BLE001 - typed refusal
+            report.rejected += 1
+            report.errors[type(exc).__name__] += 1
+            if type(exc).__name__ == 'QuotaExceededError':
+                ledger[tenant]['quota_rejected'] += 1
+            continue
+        report.submitted += 1
+        ledger[tenant]['submitted'] += 1
+        pending.append((pi, tenant, handle, t0))
+    for pi, tenant, handle, t0 in pending:
+        assert isinstance(handle, RequestHandle)
+        try:
+            got = handle.result(timeout=result_timeout_s)
+        except TimeoutError:
+            report.hung += 1
+            continue
+        except Exception as exc:     # noqa: BLE001 - typed failure
+            report.errors[type(exc).__name__] += 1
+            if type(exc).__name__ == 'OverloadError':
+                ledger[tenant]['shed'] += 1
+            continue
+        report.completed += 1
+        report.retries += handle.retries
+        report.latencies_s.append(time.monotonic() - t0)
+        ledger[tenant]['completed'] += 1
+        ledger[tenant]['shots'] += shots
+        if pi not in refs:
+            refs[pi] = jax.tree.map(
+                np.asarray, simulate_batch(mps[pi], bits[pi], cfg=cfg))
+        want = refs[pi]
+        same = set(got) == set(want) and all(
+            np.array_equal(np.asarray(got[k]), np.asarray(want[k]))
+            for k in want)
+        if not same:
+            report.bit_mismatches += 1
+    report.per_tenant = ledger
+    metered = svc.stats().get('tenants', {})
+    for t, truth in ledger.items():
+        row = metered.get(t)
+        if row is None:
+            if any(truth.values()):
+                report.meter_mismatches.append(
+                    f'{t}: no meter row for active tenant')
+            continue
+        for k in ('completed', 'shed', 'quota_rejected', 'shots'):
+            if row.get(k) != truth[k]:
+                report.meter_mismatches.append(
+                    f'{t}.{k}: metered {row.get(k)} != observed '
+                    f'{truth[k]}')
+    return report
+
+
+@dataclass
 class FleetSoakReport(SoakReport):
     """:class:`SoakReport` plus the timeline a fleet soak needs:
     ``actions`` records each chaos action as ``(t_rel_s, name, idx)``

@@ -26,6 +26,17 @@ Chaos hooks (``kill`` / ``wedge`` / ``unwedge``) drive the fleet soak:
 SIGKILL exercises connection-loss failover, SIGSTOP exercises the
 gossip-staleness path (the TCP connection stays open while the process
 makes no progress), SIGCONT exercises heartbeat re-admission.
+
+SLO-driven elasticity (docs/FLEET.md "Autoscaling",
+docs/SERVING.md "Tenants"): with ``autoscale=`` configured, the
+monitor thread closes the loop from the router's SLO watch — replica
+count scales UP on sustained breach (fleet-wide stage or per-tenant
+``'tenant:<name>'`` budget) and DOWN on sustained slack, through
+:class:`AutoscalePolicy`'s hysteresis band (sustain windows + action
+cooldown) so a noisy p99 cannot flap the population.  Scale-up lands
+warm because new replicas replay the shared tiers; scale-down fails
+the victim's in-flight work over through the ordinary
+``remove_replica`` path before the process dies.
 """
 
 from __future__ import annotations
@@ -40,10 +51,80 @@ import tempfile
 import threading
 import time
 
+from ..utils import profiling
 from .router import ROUTER_THREAD_PREFIX, FleetRouter
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+class AutoscalePolicy:
+    """Hysteresis band between the SLO level signal and scaling acts.
+
+    Pure decision logic (no threads, no clock of its own) so tests
+    drive it with synthetic time: feed ``decide(breached, n, now)``
+    the router's current breach level and population each tick; it
+    answers ``'up'`` / ``'down'`` / ``None``.  An action requires the
+    signal to SUSTAIN (``breach_s`` of continuous breach, ``slack_s``
+    of continuous slack) AND the cooldown since the last action to
+    have elapsed — two independent anti-flap guards, so one noisy p99
+    sample can neither scale up nor immediately undo a scale-up.
+    Population stays inside ``[min_replicas, max_replicas]``.
+    """
+
+    def __init__(self, *, min_replicas: int = 1, max_replicas: int = 4,
+                 breach_sustain_s: float = 1.0,
+                 slack_sustain_s: float = 5.0,
+                 cooldown_s: float = 2.0):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(
+                f'need 1 <= min_replicas <= max_replicas; got '
+                f'[{min_replicas}, {max_replicas}]')
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.breach_sustain_s = float(breach_sustain_s)
+        self.slack_sustain_s = float(slack_sustain_s)
+        self.cooldown_s = float(cooldown_s)
+        self._breach_since = None
+        self._slack_since = None
+        self._last_action_t = None
+
+    def decide(self, breached: bool, n: int, now: float):
+        if breached:
+            self._slack_since = None
+            if self._breach_since is None:
+                self._breach_since = now
+            if (now - self._breach_since >= self.breach_sustain_s
+                    and self._cool(now) and n < self.max_replicas):
+                self._act(now)
+                return 'up'
+            return None
+        self._breach_since = None
+        if self._slack_since is None:
+            self._slack_since = now
+        if (now - self._slack_since >= self.slack_sustain_s
+                and self._cool(now) and n > self.min_replicas):
+            self._act(now)
+            return 'down'
+        return None
+
+    def _cool(self, now: float) -> bool:
+        return self._last_action_t is None \
+            or now - self._last_action_t >= self.cooldown_s
+
+    def _act(self, now: float) -> None:
+        # an action consumes the sustained window: the signal must
+        # re-sustain before the NEXT action, on top of the cooldown
+        self._last_action_t = now
+        self._breach_since = None
+        self._slack_since = None
+
+    def snapshot(self) -> dict:
+        return {'min_replicas': self.min_replicas,
+                'max_replicas': self.max_replicas,
+                'breach_sustain_s': self.breach_sustain_s,
+                'slack_sustain_s': self.slack_sustain_s,
+                'cooldown_s': self.cooldown_s}
 
 
 class _ReplicaProc:
@@ -80,7 +161,8 @@ class Fleet:
                  ready_timeout_s: float = 300.0,
                  name: str = None, router_kwargs: dict = None,
                  trace_sample: float = 0.0, slo_budgets: dict = None,
-                 integrity: bool = False):
+                 integrity: bool = False, tenants: dict = None,
+                 autoscale=None):
         if n_replicas < 1:
             raise ValueError('n_replicas must be >= 1')
         self.name = name or 'fleet'
@@ -113,6 +195,21 @@ class Fleet:
             # "Integrity"): submit-time program CRC verified by the
             # replica, replica-stamped result digest verified here
             router_kwargs.setdefault('integrity', True)
+        if tenants:
+            # one tenant config for the whole fleet: every replica
+            # enforces the same weights/quotas, so a tenant cannot
+            # route around its limits by landing on another replica
+            # (docs/SERVING.md "Tenants")
+            self._service.setdefault('tenants', dict(tenants))
+        # SLO-driven elasticity: dict of AutoscalePolicy kwargs, an
+        # AutoscalePolicy instance, or True for defaults; None = off
+        if autoscale is True:
+            autoscale = AutoscalePolicy()
+        elif isinstance(autoscale, dict):
+            autoscale = AutoscalePolicy(**autoscale)
+        self._autoscale = autoscale
+        self._scale_ups = 0
+        self._scale_downs = 0
         self.router = FleetRouter(name=self.name, **router_kwargs)
         self._lock = threading.Lock()
         self._closing = False
@@ -235,7 +332,9 @@ class Fleet:
                 slots = list(self._replicas)
             for slot in slots:
                 proc = slot.proc
-                if proc is None or proc.poll() is None:
+                # proc None = a scale-up slot whose first spawn
+                # failed; retry it like a death
+                if proc is not None and proc.poll() is None:
                     continue
                 if self._closing or not self._respawn:
                     continue
@@ -243,13 +342,87 @@ class Fleet:
                 with self._lock:
                     if self._closing:
                         return
+                    if slot not in self._replicas:
+                        continue    # scaled away during the backoff
                 slot.respawns += 1
                 try:
                     self._spawn(slot)
                 except RuntimeError:
                     # spawn failed (e.g. mid-shutdown): retry next tick
                     pass
+            self._autoscale_tick()
             time.sleep(self._monitor_interval_s)
+
+    def _autoscale_tick(self) -> None:
+        """One elasticity decision on the monitor cadence: integrate
+        the router's SLO level signal through the policy's hysteresis
+        and apply at most one single-step scaling action."""
+        policy = self._autoscale
+        if policy is None or self._closing:
+            return
+        with self._lock:
+            n = len(self._replicas)
+        act = policy.decide(self.router.slo_breached(), n,
+                            time.monotonic())
+        if act == 'up':
+            self.scale_to(n + 1, reason='slo-breach')
+        elif act == 'down':
+            self.scale_to(n - 1, reason='slo-slack')
+
+    def scale_to(self, n: int, reason: str = 'manual') -> int:
+        """Set the replica population to ``n``: spawn fresh replicas
+        (they land warm off the shared tiers) or retire the
+        highest-index ones — a retired replica's in-flight work fails
+        over through :meth:`~.router.FleetRouter.remove_replica`
+        BEFORE its process dies, so scale-down loses nothing.  Returns
+        the new population.  Edge-triggered ``autoscale_up`` /
+        ``autoscale_down`` flight events make every scaling act
+        visible in the incident timeline."""
+        n = max(1, int(n))
+        with self._lock:
+            if self._closing:
+                return len(self._replicas)
+            cur = len(self._replicas)
+            if n == cur:
+                return cur
+            if n > cur:
+                grown = [_ReplicaProc(f'r{i}') for i in range(cur, n)]
+                self._replicas.extend(grown)
+                victims = []
+                self._scale_ups += 1
+            else:
+                grown = []
+                victims = self._replicas[n:]
+                del self._replicas[n:]
+                self._scale_downs += 1
+        direction = 'up' if grown else 'down'
+        profiling.counter_inc(f'fleet.autoscale_{direction}')
+        self.router.flight_recorder.record(
+            f'autoscale_{direction}', reason=reason,
+            n_from=cur, n_to=n)
+        for slot in grown:
+            try:
+                self._spawn(slot)
+            except RuntimeError:
+                pass            # monitor retries on its next tick
+        for slot in victims:
+            self.router.remove_replica(slot.rid)
+            proc = slot.proc
+            if proc is None:
+                continue
+            try:
+                os.kill(proc.pid, signal.SIGCONT)   # unwedge first
+            except OSError:
+                pass
+            proc.terminate()
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+            if proc.stdout is not None:
+                proc.stdout.close()
+        return n
 
     # -- chaos hooks -----------------------------------------------------
 
@@ -329,6 +502,13 @@ class Fleet:
                     'wedged': s.wedged,
                     'respawns': s.respawns,
                 } for s in self._replicas}
+            snap['autoscale'] = {
+                'enabled': self._autoscale is not None,
+                'scale_ups': self._scale_ups,
+                'scale_downs': self._scale_downs,
+                'policy': self._autoscale.snapshot()
+                if self._autoscale is not None else None,
+            }
         snap['shared_dir'] = self.shared_dir
         return snap
 

@@ -67,6 +67,21 @@ class OverloadError(RuntimeError):
     "overload control")."""
 
 
+class QuotaExceededError(ValueError):
+    """Admission control: THIS TENANT is over one of its configured
+    limits (max queued requests, shots/s, or compile-submissions/s —
+    docs/SERVING.md "Tenants").
+
+    Distinct from :class:`OverloadError` on purpose: an overload shed
+    says "the service is busy, back off and retry" while a quota
+    rejection says "your contract forbids this rate, retrying verbatim
+    cannot succeed".  Subclasses :class:`ValueError` so the fault
+    taxonomy (``is_infrastructure_error``) classifies it program-side:
+    the retry/failover machinery surfaces it to the caller immediately
+    instead of burning attempts on other replicas.
+    """
+
+
 class ExecutorLostError(RuntimeError):
     """The executor running this request's batch was lost (dispatcher
     thread died, or a dispatch hung past the watchdog) and the retry
@@ -90,6 +105,11 @@ class ServiceClosedError(RuntimeError):
 
 
 _QUEUED, _DISPATCHED, _DONE = 'queued', 'dispatched', 'done'
+
+# the tenant every unattributed submission is normalized onto at the
+# admission boundary — single-tenant deployments never name a tenant
+# and simply ARE the default tenant (docs/SERVING.md "Tenants")
+DEFAULT_TENANT = 'default'
 
 
 class RequestHandle:
@@ -124,6 +144,12 @@ class RequestHandle:
         # of the tracing-off path) or the obs.trace.TraceContext the
         # serving layers append lifecycle spans to
         self._trace = None
+        # exactly-once resolution hook: the service installs a callback
+        # at admission and it fires on the SINGLE winning transition to
+        # done — including the submitter-side cancel() path that never
+        # re-enters the service — so per-tenant outstanding counts can
+        # never drift.  Called as cb(ok: bool) outside the handle lock.
+        self._on_done = None
 
     # -- submitter side -------------------------------------------------
 
@@ -169,6 +195,18 @@ class RequestHandle:
 
     # -- service side ---------------------------------------------------
 
+    def _set_on_done(self, cb) -> bool:
+        """Install the exactly-once resolution callback.  Returns False
+        — NOT installed — when the handle already resolved (e.g. a
+        submit_source handle cancelled during its compile), so the
+        installer knows its accounting will never be balanced by the
+        callback and must not open it."""
+        with self._lock:
+            if self._state == _DONE:
+                return False
+            self._on_done = cb
+            return True
+
     def _claim(self):
         """Dispatcher: move queued -> dispatched.  Returns the attempt
         token (a truthy int) the claimer must present to ``_fulfill``/
@@ -209,6 +247,7 @@ class RequestHandle:
         if self._trace is not None:
             self._trace.instant('done', outcome='ok')
         self._event.set()
+        self._notify_done(True)
         return True
 
     def _fail(self, exc: BaseException, only_queued: bool = False,
@@ -224,7 +263,19 @@ class RequestHandle:
         if self._trace is not None:
             self._trace.instant('done', outcome=type(exc).__name__)
         self._event.set()
+        self._notify_done(False)
         return True
+
+    def _notify_done(self, ok: bool) -> None:
+        # pop-then-call: the slot is cleared before invocation so even
+        # a re-entrant resolution attempt from inside the callback
+        # cannot fire it twice
+        cb, self._on_done = self._on_done, None
+        if cb is not None:
+            try:
+                cb(ok)
+            except Exception:
+                pass        # accounting must never poison resolution
 
 
 @dataclass
@@ -276,6 +327,11 @@ class Request:
     rounds: int = None
     decode: object = None
     sid: int = None
+    # tenant identity (docs/SERVING.md "Tenants"): every request
+    # belongs to exactly one tenant; unattributed traffic lands on the
+    # 'default' tenant at admission so the fair queue and the meters
+    # never see None
+    tenant: str = 'default'
 
     def expired(self, now: float) -> bool:
         """Whether the deadline has passed as of ``now`` (False when no

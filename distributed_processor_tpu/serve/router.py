@@ -311,12 +311,13 @@ class FleetRouter:
     def submit(self, mp, meas_bits=None, *, shots: int = None,
                init_regs=None, cfg=None, priority: int = 0,
                deadline_ms: float = None,
-               fault_mode: str = None) -> RequestHandle:
+               fault_mode: str = None,
+               tenant: str = None) -> RequestHandle:
         payload = dict(mp=mp, meas_bits=meas_bits, shots=shots,
                        init_regs=init_regs,
                        cfg=cfg if cfg is not None else self._default_cfg,
                        priority=priority, deadline_ms=deadline_ms,
-                       fault_mode=fault_mode)
+                       fault_mode=fault_mode, tenant=tenant)
         if self._integrity:
             payload['_crc'] = program_digest(mp)
         return self._enqueue('submit', payload,
@@ -326,13 +327,14 @@ class FleetRouter:
                       meas_bits=None, init_regs=None, cfg=None,
                       priority: int = 0, deadline_ms: float = None,
                       fault_mode: str = None, n_qubits: int = 8,
-                      pad_to: int = None) -> RequestHandle:
+                      pad_to: int = None,
+                      tenant: str = None) -> RequestHandle:
         payload = dict(program=program, qchip=qchip, shots=shots,
                        meas_bits=meas_bits, init_regs=init_regs,
                        cfg=cfg if cfg is not None else self._default_cfg,
                        priority=priority, deadline_ms=deadline_ms,
                        fault_mode=fault_mode, n_qubits=n_qubits,
-                       pad_to=pad_to)
+                       pad_to=pad_to, tenant=tenant)
         # no machine program yet, so no bucket: least-loaded placement
         return self._enqueue('submit_source', payload, None)
 
@@ -340,7 +342,8 @@ class FleetRouter:
 
     def open_stream(self, mp, *, cfg=None, decode=None,
                     round_deadline_ms: float = None, priority: int = 0,
-                    fault_mode: str = None) -> StreamSession:
+                    fault_mode: str = None,
+                    tenant: str = None) -> StreamSession:
         """Open a fleet-served streaming session: every round chunk is
         one ``submit_rounds`` wire frame and every result one
         incremental resolve frame, so the stream rides the ordinary
@@ -361,14 +364,16 @@ class FleetRouter:
                                     router=self.name)
         return StreamSession(self, mp, sid, cfg=cfg, decode=decode,
                              round_deadline_ms=round_deadline_ms,
-                             priority=priority, fault_mode=fault_mode)
+                             priority=priority, fault_mode=fault_mode,
+                             tenant=tenant)
 
     def submit_rounds(self, mp, meas_bits, *, init_regs=None, cfg=None,
                       decode=None, priority: int = 0,
                       deadline_ms: float = None,
                       round_deadline_ms: float = None,
                       fault_mode: str = None,
-                      stream: int = None) -> RequestHandle:
+                      stream: int = None,
+                      tenant: str = None) -> RequestHandle:
         """Route one R-round chunk (``meas_bits`` ``[rounds, n_shots,
         n_cores, n_meas]``) to the stream's home replica — or
         least-loaded placement for a detached (``stream=None``)
@@ -391,7 +396,7 @@ class FleetRouter:
                        decode=decode, priority=priority,
                        deadline_ms=deadline_ms,
                        round_deadline_ms=round_deadline_ms,
-                       fault_mode=fault_mode)
+                       fault_mode=fault_mode, tenant=tenant)
         if self._integrity:
             payload['_crc'] = program_digest(mp)
         handle = self._enqueue('submit_rounds', payload, key)
@@ -577,6 +582,12 @@ class FleetRouter:
                 self._stitch(freq, rid, piggyback, t_resp)
             self._latency_h.observe(lat_ms)
             self._observe_stage('total', lat_ms)
+            # per-tenant latency rides the same stage-histogram
+            # machinery as execution stages, so SLO budgets keyed
+            # 'tenant:<name>' work in _check_slo unchanged
+            # (docs/SERVING.md "Tenants")
+            tenant = freq.payload.get('tenant') or 'default'
+            self._observe_stage(f'tenant:{tenant}', lat_ms)
             profiling.counter_inc('fleet.completed')
             freq.handle._fulfill(payload)
             return
@@ -950,6 +961,15 @@ class FleetRouter:
             self.flight_recorder.record(
                 'slo_breach', stage=stage, p50_ms=round(p50, 3),
                 p99_ms=round(p99, 3), budget=dict(budget))
+
+    def slo_breached(self) -> bool:
+        """True while ANY configured SLO budget (fleet-wide stage or
+        per-tenant ``'tenant:<name>'``) is currently breached — the
+        level signal the fleet autoscaler integrates over time
+        (docs/FLEET.md "Autoscaling"); the flight events stay
+        edge-triggered."""
+        with self._lock:
+            return any(self._slo_state.values())
 
     # -- fleet observability (docs/OBSERVABILITY.md) ---------------------
 

@@ -76,12 +76,13 @@ from ..sim.interpreter import (ENGINES, InterpreterConfig, FaultError,
                                resolve_engine, simulate_batch,
                                simulate_multi_batch, simulate_rounds)
 from ..utils import profiling
-from .batcher import Coalescer, bucket_key
+from .batcher import Coalescer, bucket_key, shed_exempt
 from .bucketspec import BucketSpec
 from .catalog import BucketCatalog
-from .request import (CancelledError, DeadlineError, ExecutorLostError,
-                      OverloadError, QueueFullError, Request,
-                      RequestHandle, ServiceClosedError, ShutdownError)
+from .request import (DEFAULT_TENANT, CancelledError, DeadlineError,
+                      ExecutorLostError, OverloadError, QueueFullError,
+                      QuotaExceededError, Request, RequestHandle,
+                      ServiceClosedError, ShutdownError)
 from .stream import StreamKey, StreamSession
 from .supervise import (HEALTH_LIVE, HEALTH_PROBING, HEALTH_QUARANTINED,
                         CircuitBreaker, RetryPolicy)
@@ -204,6 +205,43 @@ def _pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+class _TokenBucket:
+    """Per-tenant admission rate limiter (docs/SERVING.md "Tenants").
+
+    The bucket starts FULL at ``capacity`` (one burst's worth) and
+    refills continuously at ``rate`` tokens/s; ``try_take`` is called
+    under the service's lock, so no locking of its own."""
+
+    __slots__ = ('rate', 'capacity', 'tokens', 't')
+
+    def __init__(self, rate: float, capacity: float = None):
+        self.rate = float(rate)
+        self.capacity = float(capacity) if capacity is not None \
+            else max(self.rate, 1.0)
+        self.tokens = self.capacity
+        self.t = time.monotonic()
+
+    def try_take(self, n: float, now: float = None) -> bool:
+        if now is None:
+            now = time.monotonic()
+        self.tokens = min(self.capacity,
+                          self.tokens + (now - self.t) * self.rate)
+        self.t = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+def _tenant_zero() -> dict:
+    """One tenant's fresh accounting block — the exact key set the
+    frozen manifest in tests/test_obs.py pins (plus 'weight', merged
+    in by stats())."""
+    return {'queued': 0, 'submitted': 0, 'completed': 0, 'failed': 0,
+            'shed': 0, 'quota_rejected': 0, 'shots': 0,
+            'device_ms': 0.0, 'compile_ms': 0.0, 'bytes_wire': 0}
+
+
 def _bucket_label(key: BucketSpec) -> str:
     """Human/JSON-able label for a bucket key: the shape part only
     (cores x instruction bucket).  Distinct cfg/geometry variants of
@@ -241,10 +279,11 @@ class _DeviceExecutor:
 
     def __init__(self, svc: 'ExecutionService', idx: int, device,
                  max_batch_programs: int, max_wait_s: float,
-                 breaker: CircuitBreaker):
+                 breaker: CircuitBreaker, tenant_weights: dict = None):
         self.idx = idx
         self.device = device
-        self.q = Coalescer(max_batch_programs, max_wait_s)
+        self.q = Coalescer(max_batch_programs, max_wait_s,
+                           tenant_weights=tenant_weights)
         self.busy = False            # a batch is executing right now
         # -- supervision state (all under the service's cv) --------------
         self.health = HEALTH_LIVE
@@ -384,6 +423,25 @@ class ExecutionService:
         be met is rejected early instead of queueing to expire.
         Default None = off (the bounded queue / QueueFullError is
         then the only admission control, exactly as before).
+    tenants:
+        Per-tenant policy (docs/SERVING.md "Tenants"): a JSON-able
+        dict ``{name: {'weight': 1.0, 'max_queued': None,
+        'shots_per_s': None, 'shots_burst': None, 'compiles_per_s':
+        None, 'compiles_burst': None}}``.  ``weight`` biases the
+        deficit-round-robin fair queue; the quota/rate keys arm
+        admission-time limits that raise the typed, non-retryable
+        :class:`QuotaExceededError` (distinct from
+        :class:`OverloadError`: "your contract forbids this", not
+        "back off and retry").  Tenants not listed get weight 1.0 and
+        no quotas — but ARE still metered.  Default None = no
+        configured tenants; everything lands on the 'default' tenant.
+    tenant_fair:
+        Deficit-round-robin fair queueing across tenants in every
+        coalescer (claim order interleaves tenants by weight instead
+        of strict global FIFO; within a tenant, (priority, arrival)
+        order is unchanged).  Default True; a single-tenant queue
+        behaves identically either way.  Off restores the legacy
+        global order — the ``tenant_isolation`` bench's baseline.
 
     ``warmup_catalog`` names a learned bucket catalog file
     (serve/catalog.py): every bucket this service dispatches is
@@ -453,7 +511,9 @@ class ExecutionService:
                  audit_sample: float = 0.0,
                  audit_mode: str = 'flag',
                  scrub_interval_s: float = None,
-                 session_ttl_s: float = None):
+                 session_ttl_s: float = None,
+                 tenants: dict = None,
+                 tenant_fair: bool = True):
         if max_batch_programs < 1:
             raise ValueError('max_batch_programs must be >= 1')
         if max_queue < 1:
@@ -534,11 +594,49 @@ class ExecutionService:
         self._max_est_wait_s = None if max_est_wait_ms is None \
             else max_est_wait_ms / 1e3
         self._cv = threading.Condition()
+        # -- tenant isolation fabric (docs/SERVING.md "Tenants") ---------
+        # policy is parsed before the executors exist so every
+        # coalescer shares ONE live weights dict (service-owned, read
+        # under the cv like everything else)
+        self._tenant_cfg = {}
+        self._tenant_weights = {}
+        for tname, spec in (tenants or {}).items():
+            spec = dict(spec or {})
+            w = float(spec.get('weight', 1.0))
+            if w <= 0:
+                raise ValueError(
+                    f'tenant {tname!r}: weight must be > 0; got {w!r}')
+            for k in ('max_queued', 'shots_per_s', 'compiles_per_s'):
+                v = spec.get(k)
+                if v is not None and v <= 0:
+                    raise ValueError(
+                        f'tenant {tname!r}: {k} must be positive or '
+                        f'None; got {v!r}')
+            self._tenant_cfg[str(tname)] = spec
+            self._tenant_weights[str(tname)] = w
+        self._tenant_fair = bool(tenant_fair)
+        # name -> accounting block (_tenant_zero) — configured tenants
+        # eagerly so stats()/fleet-status show them before first
+        # traffic, everyone else lazily at first sight
+        self._tenant_state = {t: _tenant_zero()
+                              for t in self._tenant_cfg}
+        self._tenant_shots_tb = {
+            t: _TokenBucket(s['shots_per_s'], s.get('shots_burst'))
+            for t, s in self._tenant_cfg.items()
+            if s.get('shots_per_s') is not None}
+        self._tenant_compile_tb = {
+            t: _TokenBucket(s['compiles_per_s'],
+                            s.get('compiles_burst'))
+            for t, s in self._tenant_cfg.items()
+            if s.get('compiles_per_s') is not None}
         self._executors = [
             _DeviceExecutor(self, i, d, max_batch_programs,
                             max_wait_ms / 1e3,
                             CircuitBreaker(breaker_threshold,
-                                           breaker_cooldown_ms / 1e3))
+                                           breaker_cooldown_ms / 1e3),
+                            tenant_weights=(self._tenant_weights
+                                            if self._tenant_fair
+                                            else None))
             for i, d in enumerate(dev_list)]
         self._stealing = bool(work_stealing) and len(self._executors) > 1
         self._home = {}                        # bucket_key -> executor idx
@@ -690,7 +788,8 @@ class ExecutionService:
     def submit(self, mp, meas_bits=None, *, shots: int = None,
                init_regs=None, cfg: InterpreterConfig = None,
                priority: int = 0, deadline_ms: float = None,
-               fault_mode: str = None, _handle: RequestHandle = None):
+               fault_mode: str = None, tenant: str = None,
+               _handle: RequestHandle = None):
         """Queue one program for execution; returns its
         :class:`RequestHandle` immediately.
 
@@ -702,7 +801,9 @@ class ExecutionService:
         relative-to-now deadline enforced at batch boundaries;
         ``fault_mode`` overrides the cfg's ('strict' raises
         :class:`FaultError` on THIS handle only, batch-mates are
-        unaffected).
+        unaffected).  ``tenant`` names the submitting tenant
+        (docs/SERVING.md "Tenants": fair queueing, quotas, metering);
+        None lands on the 'default' tenant.
         """
         if meas_bits is None:
             if shots is None:
@@ -758,6 +859,7 @@ class ExecutionService:
                     f'{isa.N_REGS}]; got {tuple(init_regs.shape)}')
         deadline = None if deadline_ms is None \
             else time.monotonic() + deadline_ms / 1e3
+        tenant = str(tenant) if tenant else DEFAULT_TENANT
         key = bucket_key(mp, cfg)
         with self._cv:
             if self._closing:
@@ -768,6 +870,9 @@ class ExecutionService:
                 profiling.counter_inc('serve.rejected')
                 raise QueueFullError(
                     f'queue full ({self.max_queue} requests pending)')
+            # tenant quota BEFORE overload control: an over-quota
+            # submission must never shed another tenant's queued work
+            self._admit_tenant_locked(tenant, shots=n_shots)
             self._admit_overload_locked(priority, deadline)
             # _handle: submit_source hands over the outer handle it
             # already returned to the tenant, so the dispatcher fulfills
@@ -777,7 +882,9 @@ class ExecutionService:
             req = Request(mp=mp, meas_bits=meas_bits,
                           init_regs=init_regs, cfg=cfg, strict=strict,
                           n_shots=n_shots, priority=priority,
-                          deadline=deadline, seq=next(self._seq), **hkw)
+                          deadline=deadline, seq=next(self._seq),
+                          tenant=tenant, **hkw)
+            self._open_tenant_locked(req)
             # tracing: submit_source already made the sampling call
             # for its outer handle; everything else draws here.  With
             # sampling off maybe_start returns None without allocating
@@ -806,8 +913,8 @@ class ExecutionService:
 
     def open_stream(self, mp, *, cfg: InterpreterConfig = None,
                     decode=None, round_deadline_ms: float = None,
-                    priority: int = 0,
-                    fault_mode: str = None) -> StreamSession:
+                    priority: int = 0, fault_mode: str = None,
+                    tenant: str = None) -> StreamSession:
         """Open a long-lived streaming session for ``mp``: returns a
         :class:`~.stream.StreamSession` whose ``submit_rounds`` chunks
         dispatch as device-resident R-round scans
@@ -828,7 +935,8 @@ class ExecutionService:
         self.flight_recorder.record('stream_open', sid=sid)
         return StreamSession(self, mp, sid, cfg=cfg, decode=decode,
                              round_deadline_ms=round_deadline_ms,
-                             priority=priority, fault_mode=fault_mode)
+                             priority=priority, fault_mode=fault_mode,
+                             tenant=tenant)
 
     def close_stream(self, sid: int) -> bool:
         """Deregister an open session (idempotent; the TTL sweep and
@@ -847,6 +955,7 @@ class ExecutionService:
                       priority: int = 0, deadline_ms: float = None,
                       round_deadline_ms: float = None,
                       fault_mode: str = None, stream: int = None,
+                      tenant: str = None,
                       _handle: RequestHandle = None):
         """Queue one R-round streaming chunk; returns its
         :class:`RequestHandle` immediately.  ``meas_bits`` is
@@ -919,6 +1028,7 @@ class ExecutionService:
         # keeps the rounds=1 normalized cfg so every chunk of the
         # session shares one sticky key regardless of chunk length
         rcfg = replace(cfg, rounds=rounds)
+        tenant = str(tenant) if tenant else DEFAULT_TENANT
         with self._cv:
             if self._closing:
                 raise ServiceClosedError(
@@ -942,13 +1052,18 @@ class ExecutionService:
                 profiling.counter_inc('serve.rejected')
                 raise QueueFullError(
                     f'queue full ({self.max_queue} requests pending)')
+            # shot-rounds are the billed unit of a streaming chunk:
+            # an R-round B-shot chunk draws R x B from the bucket
+            self._admit_tenant_locked(tenant, shots=rounds * n_shots)
             self._admit_overload_locked(priority, deadline)
             hkw = {} if _handle is None else {'handle': _handle}
             req = Request(mp=mp, meas_bits=meas_bits,
                           init_regs=init_regs, cfg=rcfg, strict=strict,
                           n_shots=n_shots, priority=priority,
                           deadline=deadline, seq=next(self._seq),
-                          rounds=rounds, decode=decode, sid=sid, **hkw)
+                          rounds=rounds, decode=decode, sid=sid,
+                          tenant=tenant, **hkw)
+            self._open_tenant_locked(req)
             ctx = req.handle._trace if _handle is not None \
                 else self._tracer.maybe_start()
             if ctx is not None:
@@ -1012,7 +1127,7 @@ class ExecutionService:
                       deadline_ms: float = None, fault_mode: str = None,
                       n_qubits: int = 8, pad_to: int = None,
                       channel_configs=None, fpga_config=None,
-                      compiler_flags=None,
+                      compiler_flags=None, tenant: str = None,
                       _handle: RequestHandle = None):
         """Submit PROGRAM SOURCE — a dict-instruction list or OpenQASM 3
         text — instead of a pre-built MachineProgram; returns a
@@ -1044,10 +1159,15 @@ class ExecutionService:
         if ctx is not None:
             handle._trace = ctx
             ctx.instant('submit_source')
+        tenant = str(tenant) if tenant else DEFAULT_TENANT
         with self._cv:
             if self._closing:
                 raise ServiceClosedError(
                     f'service {self.name!r} is shut down')
+            # compile-rate gate at the front door, SYNCHRONOUS: an
+            # over-rate tenant is told no before a compile worker is
+            # ever tied up on its program
+            self._admit_tenant_locked(tenant, compile_sub=True)
             if self._compile_pool is None:
                 self._compile_pool = ThreadPoolExecutor(
                     max_workers=self._compile_workers,
@@ -1068,13 +1188,19 @@ class ExecutionService:
                     fpga_config=fpga_config,
                     compiler_flags=compiler_flags, n_qubits=n_qubits,
                     pad_to=pad_to)
+                t_done = time.monotonic()
+                # compile-ms is billed to the submitting tenant even
+                # on a cache hit (the hit costs ~0 ms — the meter is
+                # wall time spent, not a flat fee)
+                self._meter_compile(tenant, (t_done - t_c) * 1e3)
                 if handle._trace is not None:
-                    handle._trace.span('compile', t_c, time.monotonic(),
+                    handle._trace.span('compile', t_c, t_done,
                                        status=_status)
                 self.submit(mp, meas_bits, shots=shots,
                             init_regs=init_regs, cfg=cfg,
                             priority=priority, deadline_ms=deadline_ms,
-                            fault_mode=fault_mode, _handle=handle)
+                            fault_mode=fault_mode, tenant=tenant,
+                            _handle=handle)
             except BaseException as e:
                 handle._fail(e)
             finally:
@@ -1093,6 +1219,109 @@ class ExecutionService:
                 f'service {self.name!r} is shut down') from e
         profiling.counter_inc('serve.source_submitted')
         return handle
+
+    # -- tenant isolation fabric (docs/SERVING.md "Tenants") -------------
+
+    def _tenant_locked(self, tenant: str) -> dict:
+        ts = self._tenant_state.get(tenant)
+        if ts is None:
+            ts = self._tenant_state[tenant] = _tenant_zero()
+        return ts
+
+    def _admit_tenant_locked(self, tenant: str, *, shots: int = 0,
+                             compile_sub: bool = False) -> None:
+        """Admission-time quota gate: max queued requests, shots/s and
+        compile-submissions/s token buckets.  Raises the typed,
+        non-retryable :class:`QuotaExceededError`; tenants with no
+        configured policy pass through untouched (still metered)."""
+        spec = self._tenant_cfg.get(tenant)
+        if spec is None:
+            return
+        ts = self._tenant_locked(tenant)
+        mq = spec.get('max_queued')
+        if mq is not None and not compile_sub and ts['queued'] >= mq:
+            self._reject_quota_locked(
+                tenant, ts, f'max_queued={mq} requests already pending')
+        if shots:
+            tb = self._tenant_shots_tb.get(tenant)
+            if tb is not None and not tb.try_take(shots):
+                self._reject_quota_locked(
+                    tenant, ts,
+                    f'shots/s rate limit ({tb.rate:g}/s, burst '
+                    f'{tb.capacity:g}) cannot cover {shots} shots')
+        if compile_sub:
+            ctb = self._tenant_compile_tb.get(tenant)
+            if ctb is not None and not ctb.try_take(1):
+                self._reject_quota_locked(
+                    tenant, ts,
+                    f'compile-submissions/s rate limit '
+                    f'({ctb.rate:g}/s, burst {ctb.capacity:g}) '
+                    f'exhausted')
+
+    def _reject_quota_locked(self, tenant: str, ts: dict,
+                             why: str) -> None:
+        ts['quota_rejected'] += 1
+        profiling.counter_inc(f'tenant.{tenant}.quota_rejected')
+        self.flight_recorder.record('quota_reject', tenant=tenant,
+                                    reason=why)
+        raise QuotaExceededError(
+            f'tenant {tenant!r} over quota: {why} — quota rejections '
+            f'are not retryable (distinct from OverloadError '
+            f'backpressure; see docs/SERVING.md "Tenants")')
+
+    def _open_tenant_locked(self, req: Request) -> None:
+        """Open one request's tenant accounting: count the submission
+        and install the exactly-once resolution callback that closes
+        it (outstanding count down, completed/failed up) on WHATEVER
+        path resolves the handle — fulfill, fail, shed, deadline, or
+        a submitter-side cancel that never re-enters the service."""
+        tenant = req.tenant
+        ts = self._tenant_locked(tenant)
+        ts['submitted'] += 1
+        profiling.counter_inc(f'tenant.{tenant}.submitted')
+
+        def _done(ok: bool, _ts=ts, _t=tenant):
+            with self._cv:
+                _ts['queued'] -= 1
+                _ts['completed' if ok else 'failed'] += 1
+            profiling.counter_inc(
+                f'tenant.{_t}.completed' if ok
+                else f'tenant.{_t}.failed')
+
+        if req.handle._set_on_done(_done):
+            ts['queued'] += 1
+        # else: the handle resolved before admission finished (e.g. a
+        # submit_source handle cancelled mid-compile) — the callback
+        # will never fire, so the outstanding count never opened
+
+    def _tenant_pressure_locked(self) -> dict:
+        """How far over its admission quota each tenant is (queued /
+        max_queued) — the shed selector's primary rank: the most-
+        over-quota tenant's newest work is evicted first.  Tenants
+        with no max_queued quota carry no pressure (0.0 implied)."""
+        out = {}
+        for t, ts in self._tenant_state.items():
+            mq = (self._tenant_cfg.get(t) or {}).get('max_queued')
+            if mq:
+                out[t] = ts['queued'] / float(mq)
+        return out
+
+    def _meter_compile(self, tenant: str, ms: float) -> None:
+        with self._cv:
+            self._tenant_locked(tenant)['compile_ms'] += ms
+        profiling.counter_inc(f'tenant.{tenant}.compile_ms',
+                              int(round(ms)))
+
+    def meter_wire(self, tenant: str, nbytes: int) -> None:
+        """Billing-grade bytes-on-wire metering hook for the fleet
+        transport: the replica server calls this with each submit-op
+        request frame's size and its response frame's size, attributed
+        to the frame's tenant (docs/OBSERVABILITY.md)."""
+        tenant = str(tenant) if tenant else DEFAULT_TENANT
+        nbytes = int(nbytes)
+        with self._cv:
+            self._tenant_locked(tenant)['bytes_wire'] += nbytes
+        profiling.counter_inc(f'tenant.{tenant}.bytes_wire', nbytes)
 
     def _admit_overload_locked(self, priority: int, deadline) -> None:
         """Overload control (``max_est_wait_ms``): estimate how long
@@ -1143,22 +1372,30 @@ class ExecutionService:
 
     def _shed_locked(self, below_priority: int):
         """Evict the globally most-sheddable queued/parked request
-        strictly below ``below_priority`` (lowest priority, newest
-        arrival — least invested), failing it with
-        :class:`OverloadError`.  Returns the shed request or None."""
+        strictly below ``below_priority`` — the most-over-quota
+        tenant's newest work first (``_tenant_pressure_locked``), then
+        lowest priority, newest arrival (least invested) — failing it
+        with :class:`OverloadError`.  Stream chunks and service-
+        internal work are exempt (``batcher.shed_exempt``): another
+        tenant's admission pressure never breaks a live session or an
+        audit.  Returns the shed request or None."""
+        pressure = self._tenant_pressure_locked()
         best = None                      # (rank, executor-or-None, key, req)
         for ex in self._executors:
-            cand = ex.q.shed_candidate(below_priority)
+            cand = ex.q.shed_candidate(below_priority, pressure)
             if cand is None:
                 continue
             key, req = cand
-            rank = (req.priority, -req.seq)
+            rank = (-pressure.get(req.tenant, 0.0),
+                    req.priority, -req.seq)
             if best is None or rank < best[0]:
                 best = (rank, ex, key, req)
         for _, key, req in self._parked:
-            if req.priority >= below_priority or req.handle.done():
+            if req.priority >= below_priority or req.handle.done() \
+                    or shed_exempt(req):
                 continue
-            rank = (req.priority, -req.seq)
+            rank = (-pressure.get(req.tenant, 0.0),
+                    req.priority, -req.seq)
             if best is None or rank < best[0]:
                 best = (rank, None, key, req)
         if best is None:
@@ -1175,8 +1412,12 @@ class ExecutionService:
                 f'a higher-priority request arrived')):
             self._shed += 1
             profiling.counter_inc('serve.shed')
+            ts = self._tenant_locked(req.tenant)
+            ts['shed'] += 1
+            profiling.counter_inc(f'tenant.{req.tenant}.shed')
             self.flight_recorder.record('shed', req=req.seq,
-                                        priority=req.priority)
+                                        priority=req.priority,
+                                        tenant=req.tenant)
         return req
 
     # -- routing / stealing ----------------------------------------------
@@ -1714,6 +1955,7 @@ class ExecutionService:
                     return
         t_run = time.monotonic()
         completed = failed = served_rounds = 0
+        served = []     # token-valid resolutions: the billable set
         for req, res in zip(batch, results):
             # every completion presents the attempt token: if this
             # dispatch was declared hung and the request retried
@@ -1724,9 +1966,11 @@ class ExecutionService:
                     if req.handle._fail(FaultError(counts),
                                         token=req.claim_token):
                         failed += 1
+                        served.append(req)
                     continue
             if req.handle._fulfill(res, token=req.claim_token):
                 completed += 1
+                served.append(req)
                 if req.rounds is not None:
                     served_rounds += req.rounds
         now = time.monotonic()
@@ -1769,6 +2013,20 @@ class ExecutionService:
                 self._latency_h.observe(lat_ms)
                 profiling.registry().observe('serve.latency_ms',
                                              lat_ms)
+            # usage metering, exactly-once by construction: only the
+            # token-valid resolutions above are billed, so a chaos
+            # kill + retry can neither lose nor double-count a
+            # request's usage (a stale straggler's write was a no-op
+            # and never reached `served`)
+            per_prog_ms = per_prog * 1e3
+            for req in served:
+                ts = self._tenant_locked(req.tenant)
+                sh = req.n_shots * (req.rounds or 1)
+                ts['shots'] += sh
+                ts['device_ms'] += per_prog_ms
+                profiling.counter_inc(f'tenant.{req.tenant}.shots', sh)
+                profiling.counter_inc(f'tenant.{req.tenant}.device_ms',
+                                      int(round(per_prog_ms)))
         profiling.counter_inc('serve.dispatches')
         profiling.counter_inc('serve.programs_dispatched', len(batch))
         profiling.counter_inc('serve.batch_ms',
@@ -2346,6 +2604,14 @@ class ExecutionService:
                     'submitted': self._source_submitted,
                     'pending_compile': len(self._source_handles),
                 },
+                # per-tenant accounting (docs/SERVING.md "Tenants"):
+                # queued/served/shed/quota-rejected plus the billing
+                # meters; configured tenants appear even before their
+                # first request, unconfigured ones at first sight
+                'tenants': {
+                    t: dict(ts,
+                            weight=self._tenant_weights.get(t, 1.0))
+                    for t, ts in sorted(self._tenant_state.items())},
                 'devices': devices,
             }
             cache = self._compile_cache

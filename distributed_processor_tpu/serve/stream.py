@@ -73,7 +73,7 @@ class StreamSession:
 
     def __init__(self, target, mp, sid: int, *, cfg=None, decode=None,
                  round_deadline_ms: float = None, priority: int = 0,
-                 fault_mode: str = None):
+                 fault_mode: str = None, tenant: str = None):
         self._target = target
         self.mp = mp
         self.sid = sid
@@ -82,6 +82,10 @@ class StreamSession:
         self.round_deadline_ms = round_deadline_ms
         self.priority = priority
         self.fault_mode = fault_mode
+        # tenant identity is a SESSION property: every chunk inherits
+        # it (docs/SERVING.md "Tenants"), so a stream's rounds are
+        # metered and fair-queued under the tenant that opened it
+        self.tenant = tenant
         self._chunks = []          # (rounds, handle) in submit order
         self._yielded = 0
         self._closed = False
@@ -100,7 +104,8 @@ class StreamSession:
             self.mp, meas_bits, init_regs=init_regs, cfg=self.cfg,
             decode=self.decode, priority=self.priority,
             round_deadline_ms=self.round_deadline_ms,
-            fault_mode=self.fault_mode, stream=self.sid)
+            fault_mode=self.fault_mode, stream=self.sid,
+            tenant=self.tenant)
         self._chunks.append((int(meas_bits.shape[0]), handle))
         return handle
 

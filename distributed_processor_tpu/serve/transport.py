@@ -121,13 +121,16 @@ def install_wire_corruptor(fn):
     return prev
 
 
-def send_frame(sock: socket.socket, obj, lock: threading.Lock) -> None:
+def send_frame(sock: socket.socket, obj, lock: threading.Lock) -> int:
     """Pickle ``obj`` and write one CRC-stamped length-prefixed frame.
     ``lock`` serializes concurrent writers (responses from the waiter
-    pool interleave with reader-thread error replies)."""
+    pool interleave with reader-thread error replies).  Returns the
+    total bytes written (header + payload) so callers can meter
+    bytes-on-wire per tenant (docs/SERVING.md "Tenants")."""
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     with lock:
         sock.sendall(_HDR.pack(len(data), zlib.crc32(data)) + data)
+    return _HDR.size + len(data)
 
 
 def recv_frame(sock: socket.socket):
@@ -135,6 +138,14 @@ def recv_frame(sock: socket.socket):
     on an oversized declared length or a CRC mismatch, plain
     ConnectionError on EOF / mid-frame truncation.  The payload is
     only unpickled after its checksum passes."""
+    obj, _n = recv_frame_sized(sock)
+    return obj
+
+
+def recv_frame_sized(sock: socket.socket):
+    """Like :func:`recv_frame` but returns ``(obj, nbytes)`` where
+    ``nbytes`` counts header + payload as received — the server side
+    uses it to attribute request bytes to the submitting tenant."""
     head = _recv_exact(sock, _HDR.size)
     n, crc = _HDR.unpack(head)
     if n > _MAX_FRAME:
@@ -150,7 +161,7 @@ def recv_frame(sock: socket.socket):
         raise WireCorruptionError(
             f'frame CRC mismatch ({n} bytes): payload corrupted on '
             f'the wire')
-    return pickle.loads(data)
+    return pickle.loads(data), _HDR.size + n
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -226,8 +237,9 @@ class ReplicaServer:
         wlock = threading.Lock()
         try:
             while True:
-                req_id, op, payload = recv_frame(conn)
-                self._dispatch(conn, wlock, req_id, op, payload)
+                (req_id, op, payload), nbytes = recv_frame_sized(conn)
+                self._dispatch(conn, wlock, req_id, op, payload,
+                               nbytes)
         except (ConnectionError, OSError, EOFError,
                 pickle.UnpicklingError):
             pass                           # router went away
@@ -239,10 +251,16 @@ class ReplicaServer:
             except OSError:
                 pass
 
-    def _dispatch(self, conn, wlock, req_id, op, payload) -> None:
+    def _dispatch(self, conn, wlock, req_id, op, payload,
+                  nbytes: int = 0) -> None:
         try:
             if op in ('submit', 'submit_source', 'submit_rounds'):
                 t_recv = time.monotonic()
+                # request-frame bytes bill to the submitting tenant
+                # (docs/SERVING.md "Tenants"); response bytes are
+                # metered when the resolve reply is sent
+                tenant = payload.get('tenant')
+                self._svc.meter_wire(tenant, nbytes)
                 # `_trace` = the router's sampling decision for this
                 # request: open a forced replica-side context so the
                 # spans recorded here ship back on the resolve reply
@@ -282,7 +300,7 @@ class ReplicaServer:
                     handle = self._svc.submit_source(**kw)
                 self._pool.submit(self._send_on_resolve, conn, wlock,
                                   req_id, handle, t_recv,
-                                  want_crc is not None)
+                                  want_crc is not None, tenant)
                 return
             if op == 'close_stream':
                 self._reply(conn, wlock, req_id, True, {
@@ -334,7 +352,8 @@ class ReplicaServer:
 
     def _send_on_resolve(self, conn, wlock, req_id, handle,
                          t_recv: float = None,
-                         want_digest: bool = False) -> None:
+                         want_digest: bool = False,
+                         tenant: str = None) -> None:
         # blocks until the service resolves the handle: shutdown
         # force-fails every unresolved handle, so this always returns
         try:
@@ -362,19 +381,28 @@ class ReplicaServer:
                         'mono_recv': t_recv,
                         'mono_send': time.monotonic()},
                         'result': result}
-                self._reply(conn, wlock, req_id, True, result)
+                n = self._reply(conn, wlock, req_id, True, result)
             else:
-                self._reply(conn, wlock, req_id, False,
-                            _picklable_error(exc))
+                n = self._reply(conn, wlock, req_id, False,
+                                _picklable_error(exc))
+            # response bytes bill to the same tenant as the request
+            self._svc.meter_wire(tenant, n)
         except (ConnectionError, OSError):
             pass                           # router gone: drop response
 
     @staticmethod
-    def _reply(conn, wlock, req_id, ok, payload) -> None:
-        send_frame(conn, (req_id, ok, payload), wlock)
+    def _reply(conn, wlock, req_id, ok, payload) -> int:
+        return send_frame(conn, (req_id, ok, payload), wlock)
 
     def close(self) -> None:
         self._closing = True
+        try:
+            # shutdown() wakes a concurrently-blocked accept() (close()
+            # alone does not on Linux), so the accept thread always
+            # joins instead of outliving the server
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

@@ -436,6 +436,7 @@ _STATS_KEYS = {
     'executor_deaths', 'hangs', 'canary', 'est_wait_ms', 'compile',
     'source', 'devices', 'compile_cache', 'latency_p50_ms',
     'latency_p99_ms', 'latency_samples', 'integrity', 'streaming',
+    'tenants',
 }
 _WARMUP_KEYS = {'aot_compiled', 'replayed', 'in_progress'}
 _HEALTH_KEYS = {'live', 'quarantined', 'probing'}
@@ -457,6 +458,11 @@ _STREAMING_KEYS = {'open_sessions', 'rounds_in_flight',
                    'rounds_submitted', 'rounds_served',
                    'round_deadline_misses', 'sessions_opened',
                    'sessions_expired'}
+# per-tenant stats block (docs/SERVING.md "Tenants"): the billing
+# surface — admission outcomes plus the four usage meters
+_TENANT_KEYS = {'queued', 'submitted', 'completed', 'failed', 'shed',
+                'quota_rejected', 'shots', 'device_ms', 'compile_ms',
+                'bytes_wire', 'weight'}
 # serve.* counters the service maintains in the global registry
 _SERVE_COUNTERS = {
     'serve.submitted', 'serve.dispatches',
@@ -486,6 +492,9 @@ def test_stats_key_manifest_is_byte_compatible():
     for label, row in snap['compile']['per_bucket'].items():
         assert set(row) == {'cold', 'warm', 'cold_ms_mean',
                             'warm_ms_mean', 'compile_ms_est'}
+    for tenant, row in snap['tenants'].items():
+        assert set(row) == _TENANT_KEYS
+    assert 'default' in snap['tenants']    # untagged traffic is billed
     assert snap['latency_samples'] == 3
 
 
@@ -542,6 +551,29 @@ def test_stream_counter_names_preserved():
     for name in _STREAM_COUNTERS:
         assert after.get(name, 0) > before[name], \
             f'counter {name!r} did not advance under a streamed session'
+
+
+# tenant.* counter family (docs/SERVING.md "Tenants"): billing-grade
+# per-tenant meters on the global registry, so the fleet rollup sums
+# them across replicas for free.  Frozen per-tenant suffixes; the
+# family is tenant-name parameterized.
+_TENANT_COUNTER_SUFFIXES = {
+    'submitted', 'completed', 'shots', 'device_ms',
+}
+
+
+def test_tenant_counter_names_preserved():
+    rng = np.random.default_rng(11)
+    names = {f'tenant.acme.{s}' for s in _TENANT_COUNTER_SUFFIXES}
+    before = {k: profiling.counter_get(k) for k in names}
+    with ExecutionService(_CFG, max_batch_programs=4,
+                          max_wait_ms=2.0) as svc:
+        h = svc.submit(_mp(), _bits(rng), tenant='acme')
+        h.result(timeout=60)
+    after = profiling.counters()
+    for name in names:
+        assert after.get(name, 0) > before[name], \
+            f'counter {name!r} did not advance under a tenant request'
 
 
 def test_compile_cache_counters_on_registry():

@@ -82,6 +82,12 @@ NO_SKIP_MODULES = {
         'means the streaming contract (docs/SERVING.md "Streaming '
         'sessions", docs/PERF.md "Streaming QEC") stopped being '
         'exercised',
+    'test_tenants':
+        'tenant isolation tests (DRR fair queueing, admission quotas, '
+        'usage metering, shed exemption, autoscale hysteresis) run on '
+        'the forced CPU mesh + localhost sockets with no hardware '
+        'dependency — a skip means the tenant-fairness contract '
+        '(docs/SERVING.md "Tenants") stopped being exercised',
 }
 
 # the multi-device serve suite may skip ONLY on a genuinely

@@ -40,6 +40,18 @@ retried-to-correct — zero silently-wrong bits, zero hangs.
 
     python tools/servechaos.py --corrupt --quick
     python tools/servechaos.py --corrupt --fleet 2 --quick
+
+``--tenants N`` tags every submission to one of N tenants and audits
+the billing meters against caller-side ground truth after the soak
+(docs/SERVING.md "Tenants"); ``--greedy`` makes tenant ``t0`` flood
+admission (extra slots per cycle, weight 1, a queued-requests cap)
+while the others trickle at weight 4.  Pass bar on top of the serving
+contract: the service's ``tenant.*`` meters must match what this
+driver observed EXACTLY — chaos retries may neither lose nor
+double-bill a tenant's usage — and with ``--greedy`` no victim
+request may be shed.
+
+    python tools/servechaos.py --tenants 3 --greedy --quick
 """
 
 import argparse
@@ -118,18 +130,36 @@ def main(argv=None) -> int:
                     help='soak a fleet of N replica processes '
                          '(SIGKILL/SIGSTOP chaos) instead of the '
                          'in-process service')
+    ap.add_argument('--tenants', type=int, default=0, metavar='N',
+                    help='multi-tenant soak: tag every submission to '
+                         'one of N tenants and audit the billing '
+                         'meters against caller-side ground truth '
+                         '(exactly-once under chaos retries; '
+                         'docs/SERVING.md "Tenants")')
+    ap.add_argument('--greedy', action='store_true',
+                    help='with --tenants: tenant t0 floods admission '
+                         '(extra submission slots, weight 1, queued '
+                         'cap) while the others trickle at weight 4; '
+                         'adds the isolation pass bar — zero victim '
+                         'sheds, greedy overflow typed against its '
+                         'own quota')
     ap.add_argument('--rate-hz', type=float, default=30.0,
                     help='fleet-mode submission pacing (default 30)')
     args = ap.parse_args(argv)
 
+    if args.greedy and not args.tenants:
+        ap.error('--greedy needs --tenants N')
     if args.fleet:
+        if args.tenants:
+            ap.error('--tenants runs against the in-process service; '
+                     'drop --fleet')
         return _fleet_mode(args)
 
     from distributed_processor_tpu.serve import (ChaosMonkey, ChaosPlan,
                                                  ExecutionService,
                                                  RetryPolicy)
     from distributed_processor_tpu.serve.benchmark import _workload
-    from distributed_processor_tpu.serve.chaos import soak
+    from distributed_processor_tpu.serve.chaos import soak, tenant_soak
 
     n = args.n if args.n is not None else (60 if args.quick else 200)
     p_crash = args.p_crash * (0.5 if args.quick else 1.0)
@@ -145,6 +175,14 @@ def main(argv=None) -> int:
     # strict mode so tainted bits are failed-and-retried, never served
     integrity_kwargs = dict(audit_sample=1.0, audit_mode='strict') \
         if args.corrupt else {}
+    names, greedy, tenant_kwargs = None, None, {}
+    if args.tenants:
+        names = [f't{i}' for i in range(max(2, args.tenants))]
+        greedy = names[0] if args.greedy else None
+        tcfg = {t: {'weight': 4.0} for t in names}
+        if greedy is not None:
+            tcfg[greedy] = {'weight': 1.0, 'max_queued': max(8, n // 8)}
+        tenant_kwargs = {'tenants': tcfg}
     t0 = time.monotonic()
     with ExecutionService(
             cfg, max_batch_programs=4, max_wait_ms=5.0,
@@ -154,11 +192,18 @@ def main(argv=None) -> int:
             breaker_cooldown_ms=100.0,
             supervise_interval_ms=10.0,
             trace_sample=1.0 if args.trace_out else 0.0,
-            trace_keep=4 * n, **integrity_kwargs) as svc:
+            trace_keep=4 * n, **integrity_kwargs,
+            **tenant_kwargs) as svc:
         with ChaosMonkey(svc, plan) as monkey:
-            report = soak(svc, mps, cfg, n_requests=n,
-                          shots=args.shots, seed=args.seed,
-                          result_timeout_s=120.0)
+            if names is not None:
+                report = tenant_soak(svc, mps, cfg, tenants=names,
+                                     n_requests=n, shots=args.shots,
+                                     seed=args.seed, greedy=greedy,
+                                     result_timeout_s=120.0)
+            else:
+                report = soak(svc, mps, cfg, n_requests=n,
+                              shots=args.shots, seed=args.seed,
+                              result_timeout_s=120.0)
         stats = svc.stats()
         flight = svc.flight_recorder
         if args.flight_out:
@@ -194,6 +239,9 @@ def main(argv=None) -> int:
             'tail': flight.events()[-20:],
         },
     }
+    if names is not None:
+        out['tenants'] = report.per_tenant
+        out['meter_mismatches'] = report.meter_mismatches
     failures = []
     if report.hung:
         failures.append(f'{report.hung} handle(s) HUNG past the '
@@ -201,9 +249,23 @@ def main(argv=None) -> int:
     if report.bit_mismatches:
         failures.append(f'{report.bit_mismatches} completion(s) not '
                         f'bit-identical to the solo run')
-    if report.terminated() != report.submitted:
-        failures.append(f'{report.submitted - report.terminated()} '
-                        f'handle(s) neither completed nor typed-failed')
+    # every ACCEPTED handle must terminate: typed submit refusals are
+    # counted in errors too, so net them out of the terminated total
+    if report.terminated() - report.rejected != report.submitted:
+        missing = report.submitted + report.rejected \
+            - report.terminated()
+        failures.append(f'{missing} handle(s) neither completed nor '
+                        f'typed-failed')
+    if names is not None:
+        for msg in report.meter_mismatches:
+            failures.append(f'billing meter mismatch: {msg}')
+        if greedy is not None:
+            for t in names:
+                if t != greedy and report.per_tenant[t]['shed']:
+                    failures.append(
+                        f'victim tenant {t} had '
+                        f'{report.per_tenant[t]["shed"]} request(s) '
+                        f'shed under greedy pressure')
     if args.corrupt:
         n_corrupt = int(out['injected'].get('corrupt', 0))
         min_corrupt = args.min_corrupt if args.min_corrupt is not None \
