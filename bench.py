@@ -140,7 +140,7 @@ from distributed_processor_tpu.pipeline import compile_to_machine
 from distributed_processor_tpu.models import (
     active_reset, rb_program, make_default_qchip, couplings_from_qchip)
 from distributed_processor_tpu.serve.benchmark import (
-    availability_under_chaos, compile_front_door,
+    availability_under_chaos, calibration_loop, compile_front_door,
     continuous_batching_comparison, fleet_failover,
     fleet_observability_overhead, multi_device_scaling,
     open_loop_latency, tenant_isolation)
@@ -1416,7 +1416,11 @@ def _degraded_rerun(attempts):
                  # and chunk counts shrink
                  ('BENCH_QEC_SHOTS', '64'),
                  ('BENCH_QEC_ROUNDS', '32'),
-                 ('BENCH_QEC_CHUNKS', '6')):
+                 ('BENCH_QEC_CHUNKS', '6'),
+                 # calibration_loop row at CPU size: fewer shots per
+                 # candidate — steps-to-converge, the epoch flush and
+                 # the warm-hit assertion are shot-count independent
+                 ('BENCH_CALIB_SHOTS', '2')):
         env.setdefault(k, v)
     print('preflight failed on the accelerator backend; rerunning the '
           'bench DEGRADED on CPU (JAX_PLATFORMS=cpu)', file=sys.stderr)
@@ -2359,6 +2363,28 @@ def main():
         qec_row = None
     artifact.row('qec_streaming', qec_row)
 
+    # calibration-loop row: closed-loop gradient descent through the
+    # serve tier — convergence to the drifted device truth, live-qchip
+    # writeback and the exact stale-epoch flush ASSERTED before any
+    # timing reports; plus the cold/warm rerun pair pinning the
+    # compile cache's warm hit fraction at 1.0 (BENCH_CALIB_* knobs;
+    # BENCH_CALIB_SHOTS=0 skips it)
+    if secondaries and int(os.environ.get('BENCH_CALIB_SHOTS', 8)):
+        try:
+            calib_row = _timed_row(lambda: calibration_loop(
+                knob=os.environ.get('BENCH_CALIB_KNOB', 'amplitude'),
+                n_qubits=int(os.environ.get('BENCH_CALIB_QUBITS', 2)),
+                shots=int(os.environ.get('BENCH_CALIB_SHOTS', 8)),
+                true_x90=float(
+                    os.environ.get('BENCH_CALIB_TRUE_X90', 0.52))))
+        except _RowTimeout as e:
+            calib_row = {'error': 'timeout', 'detail': str(e)}
+        except Exception as e:  # pragma: no cover - defensive
+            calib_row = {'error': f'{type(e).__name__}: {e}'[:200]}
+    else:
+        calib_row = None
+    artifact.row('calibration_loop', calib_row)
+
     shots_per_sec = total_shots / elapsed
     bit1_frac = float(np.sum(np.asarray(res[2]))) / (batch * C)
     result = {
@@ -2416,6 +2442,7 @@ def main():
             'integrity_overhead': integrity_row,
             'ici_fabric': ici_row,
             'qec_streaming': qec_row,
+            'calibration_loop': calib_row,
             'preflight': preflight,
             'utilization': utilization,
             'pallas_compiled': pallas_compiled,

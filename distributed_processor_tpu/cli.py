@@ -706,6 +706,46 @@ def cmd_qec_stream(args):
     }, indent=2))
 
 
+def cmd_calibrate(args):
+    """Closed-loop calibration driver (docs/CALIBRATION.md): run one
+    knob's gradient-descent loop through an in-process
+    ``ExecutionService`` — candidate programs through the compile
+    front door, gradient steps from the differentiable physics model,
+    convergence written back to the live qchip (flushing exactly the
+    stale compile-cache epoch).  Prints the step count, loss
+    trajectory and final parameters as JSON; exits nonzero on a
+    diverged loop."""
+    from .calib import calibrate
+    from .models import make_default_qchip
+    from .serve import ExecutionService
+    from .sim.grad import LossSpec
+    spec = None
+    if args.knob == 'amplitude':
+        # the device-truth X90 amplitude the loop estimates: defaults
+        # drifted from the nominal 0.48 so the writeback is a real
+        # retune, not a no-op
+        spec = LossSpec(knob='amplitude', x90_amp=args.true_x90)
+    qchip = make_default_qchip(args.qubits)
+    svc = ExecutionService()
+    try:
+        result = calibrate(svc, qchip, knob=args.knob,
+                           qubit=f'Q{args.qubit}', spec=spec,
+                           start=args.start, lr=args.lr,
+                           max_steps=args.steps, shots=args.shots,
+                           tenant=args.tenant, n_qubits=args.qubits)
+        snap = svc.stats()['calibration']
+    finally:
+        svc.shutdown()
+    out = result.to_dict()
+    out['losses'] = [round(v, 8) for v in out['losses']]
+    out['service'] = snap
+    print(json.dumps(out, indent=2))
+    if result.diverged:
+        raise SystemExit(
+            f"calibrate: {args.knob} loop diverged after "
+            f"{result.steps} steps: {result.detail.get('reason')}")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog='dproc-tpu',
                                  description=__doc__.split('\n')[0])
@@ -1041,6 +1081,36 @@ def main(argv=None):
     p.add_argument('--key', type=int, default=7,
                    help='seed for the injected measurement planes')
     p.set_defaults(fn=cmd_qec_stream)
+
+    p = sub.add_parser('calibrate',
+                       help='gradient-descent knob tuning through the '
+                            'serve tier: candidate programs via the '
+                            'compile front door, writeback to the live '
+                            'qchip on convergence')
+    p.add_argument('--knob', choices=['amplitude', 'drag',
+                                      'readout_window'],
+                   default='amplitude')
+    p.add_argument('--qubit', type=int, default=0,
+                   help='qubit index to tune')
+    p.add_argument('--qubits', type=int, default=argparse.SUPPRESS,
+                   help='qchip size override, placeable after the '
+                        'subcommand (default: the global --qubits)')
+    p.add_argument('--start', type=float, default=None,
+                   help='initial parameter guess (default: per-knob)')
+    p.add_argument('--lr', type=float, default=None,
+                   help='gradient-descent step size (default: '
+                        'per-knob; a too-large value demonstrates the '
+                        'diverged path and the nonzero exit)')
+    p.add_argument('--steps', type=int, default=None,
+                   help='step budget before the loop counts as '
+                        'diverged')
+    p.add_argument('--shots', type=int, default=8)
+    p.add_argument('--true-x90', type=float, default=0.52,
+                   help='device-truth X90 amplitude of the amplitude '
+                        "knob's forward model (drifted from the "
+                        'nominal 0.48 so the writeback is a retune)')
+    p.add_argument('--tenant', help='tenant identity for the session')
+    p.set_defaults(fn=cmd_calibrate)
 
     p = sub.add_parser('trace', help='instruction trace (1 shot)')
     p.add_argument('program')

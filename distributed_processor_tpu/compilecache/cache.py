@@ -87,6 +87,8 @@ class CompileCache:
         self._singleflight_waits = 0
         self._invalidations = 0         # epoch flush events
         self._invalidated_entries = 0   # entries flushed by them
+        self._writeback_flushes = 0     # ... triggered by a live-qchip
+        #                                 mutation (calibration writer)
         self._validation_rejects = 0
         # optional FlightRecorder (set by ExecutionService) — epoch
         # invalidations land in the serving tier's incident timeline
@@ -232,6 +234,14 @@ class CompileCache:
                 flush = prev
             self._lineage[id(qchip)] = qchip_fp
         if flush is not None:
+            # a lineage-triggered flush means a LIVE qchip was written
+            # between submissions — the calibration-writeback signature
+            # (calib/loops.py); counted separately from explicit
+            # invalidate_epoch calls so dashboards can tell retunes
+            # from administrative flushes
+            with self._lock:
+                self._writeback_flushes += 1
+            profiling.counter_inc('compilecache.writeback_flushes')
             self.invalidate_epoch(flush)
 
     def invalidate_epoch(self, qchip_fp: str) -> int:
@@ -272,6 +282,7 @@ class CompileCache:
                 'singleflight_waits': self._singleflight_waits,
                 'invalidations': self._invalidations,
                 'invalidated_entries': self._invalidated_entries,
+                'writeback_flushes': self._writeback_flushes,
                 'validation_rejects': self._validation_rejects,
                 'persistent': self._store.path if self._store else None,
             }

@@ -1057,6 +1057,114 @@ def compile_front_door(n_tenants: int = 4, n_programs: int = 4,
     }
 
 
+def calibration_loop(knob: str = 'amplitude', n_qubits: int = 2,
+                     shots: int = 8, true_x90: float = 0.52,
+                     lr: float = None, max_steps: int = None) -> dict:
+    """Closed-loop gradient calibration through the serve tier, timed
+    (docs/CALIBRATION.md).
+
+    Three runs of one knob's gradient-descent loop (calib/loops.py) on
+    a live qchip whose device truth drifted (``true_x90`` vs the
+    nominal 0.48 for the amplitude knob):
+
+    1. **writeback run** — the headline: candidates through
+       ``submit_source`` under a ``CalibrationSession``, convergence
+       ASSERTED before any number reports (tuned value within 5e-3 of
+       the truth, stale compile-cache epoch flushed by the
+       post-writeback probe, exactly one lineage ``writeback_flush``);
+    2. **cold rerun** — the same loop, no writeback, compiling its
+       candidate ladder fresh under the post-writeback epoch;
+    3. **warm rerun** — identical to (2): every candidate must re-hit
+       the compile cache (the warm hit fraction is asserted == 1.0,
+       the trajectory asserted identical to the cold rerun's).
+
+    The row reports steps-to-converge, per-run wall time, the warm hit
+    fraction and warm speedup, and the service's calibration session
+    accounting.
+    """
+    from ..calib import calibrate
+    from ..sim.grad import LossSpec
+    spec = (LossSpec(knob='amplitude', x90_amp=true_x90)
+            if knob == 'amplitude' else None)
+    qchip = make_default_qchip(n_qubits)
+    svc = ExecutionService()
+    try:
+        t0 = time.perf_counter()
+        res = calibrate(svc, qchip, knob=knob, qubit='Q0', spec=spec,
+                        lr=lr, max_steps=max_steps, shots=shots,
+                        n_qubits=n_qubits)
+        t_loop = time.perf_counter() - t0
+        if not res.converged:
+            raise AssertionError(
+                f'{knob} loop failed to converge in {res.steps} steps: '
+                f'{res.detail.get("reason")}')
+        if knob == 'amplitude' and \
+                abs(res.params['amp'] - true_x90) > 5e-3:
+            raise AssertionError(
+                f'converged amp {res.params["amp"]:.5f} not within '
+                f'5e-3 of the device truth {true_x90}')
+        if res.fp_before == res.fp_after:
+            raise AssertionError('writeback did not move the '
+                                 'calibration epoch')
+        if not 1 <= res.flushed <= res.steps:
+            raise AssertionError(
+                f'post-writeback probe flushed {res.flushed} entries '
+                f'for a {res.steps}-step loop')
+        cache = svc.compile_cache
+        if cache.stats()['writeback_flushes'] != 1:
+            raise AssertionError(
+                f'{cache.stats()["writeback_flushes"]} lineage '
+                f'writeback flushes for one writeback')
+
+        # cold/warm rerun pair under the post-writeback epoch: the
+        # trajectory depends only on (start, lr, spec), so the reruns
+        # retrace the same candidate ladder — first compiles it,
+        # second must re-hit every rung
+        t0 = time.perf_counter()
+        cold = calibrate(svc, qchip, knob=knob, qubit='Q0', spec=spec,
+                         lr=lr, max_steps=max_steps, shots=shots,
+                         n_qubits=n_qubits, write_back=False)
+        t_cold = time.perf_counter() - t0
+        hits0 = cache.stats()['hits']
+        t0 = time.perf_counter()
+        warm = calibrate(svc, qchip, knob=knob, qubit='Q0', spec=spec,
+                         lr=lr, max_steps=max_steps, shots=shots,
+                         n_qubits=n_qubits, write_back=False)
+        t_warm = time.perf_counter() - t0
+        warm_hit_fraction = \
+            (cache.stats()['hits'] - hits0) / max(warm.steps, 1)
+        if warm.losses != cold.losses:
+            raise AssertionError('warm rerun trajectory diverged from '
+                                 'the cold rerun')
+        if warm_hit_fraction < 1.0:
+            raise AssertionError(
+                f'warm rerun hit only {warm_hit_fraction:.2f} of its '
+                f'candidate compiles — the calibration ladder fell '
+                f'out of the cache')
+        calib_stats = svc.stats()['calibration']
+    finally:
+        svc.shutdown()
+    return {
+        'knob': knob, 'n_qubits': n_qubits, 'shots': shots,
+        'steps_to_converge': res.steps,
+        'converged_params': {k: round(v, 6)
+                             for k, v in res.params.items()},
+        'loss_first': res.losses[0], 'loss_final': res.losses[-1],
+        'epoch_entries_flushed': res.flushed,
+        'writeback_flushes': 1,
+        'loop_s': round(t_loop, 4),
+        'cold_rerun_s': round(t_cold, 4),
+        'warm_rerun_s': round(t_warm, 4),
+        'warm_hit_fraction': warm_hit_fraction,
+        'warm_speedup': round(t_cold / t_warm, 2) if t_warm else None,
+        'sessions': calib_stats,
+        'note': 'asserted before reporting: convergence to the drifted '
+                'device truth, epoch moved by writeback, exactly the '
+                'stale epoch flushed (one lineage flush), warm rerun '
+                '100% cache hits with an identical trajectory',
+    }
+
+
 def _main(argv=None):
     """Standalone entry: ``python -m distributed_processor_tpu.serve.
     benchmark scaling|openloop ...`` prints one JSON row — bench.py

@@ -703,6 +703,15 @@ class ExecutionService:
         self._stream_round_misses = 0
         self._stream_sessions_opened = 0
         self._stream_sessions_expired = 0
+        # -- calibration traffic class (docs/SERVING.md "Calibration
+        # sessions"; guarded by _cv's lock).  _calib_sessions maps an
+        # open sid -> last-activity instant; sids draw from the same
+        # sequence as streams so a sid names one session of either kind
+        self._calib_sessions = {}
+        self._calib_sessions_opened = 0
+        self._calib_steps = 0
+        self._calib_converged = 0
+        self._calib_diverged = 0
         # -- integrity fabric (docs/ROBUSTNESS.md "Integrity") -----------
         # audit_sample=1/N re-executes every Nth completed batch on a
         # different engine (and device when the pool has one) before
@@ -949,6 +958,66 @@ class ExecutionService:
         if known:
             profiling.counter_inc('serve.stream.sessions_closed')
         return known
+
+    # -- calibration traffic class (docs/SERVING.md "Calibration
+    # sessions") ---------------------------------------------------------
+
+    def open_calibration(self, *, knob: str = 'amplitude',
+                         tenant: str = None, priority: int = 0):
+        """Open a calibration session: returns a
+        :class:`~..calib.session.CalibrationSession` whose per-step
+        candidate programs ride the ordinary ``submit_source`` front
+        door under the session's tenant identity.  The service counts
+        the session's steps and its terminal transition
+        (``stats()['calibration']``, ``serve.calib.*`` counters);
+        convergence/divergence land in the flight recorder."""
+        from ..calib.session import CalibrationSession
+        with self._cv:
+            if self._closing:
+                raise ServiceClosedError(
+                    f'service {self.name!r} is shut down')
+            sid = next(self._stream_seq)
+            self._calib_sessions[sid] = time.monotonic()
+            self._calib_sessions_opened += 1
+        profiling.counter_inc('serve.calib.sessions_opened')
+        self.flight_recorder.record('calib_open', sid=sid, knob=knob)
+        return CalibrationSession(self, sid, knob=knob, tenant=tenant,
+                                  priority=priority)
+
+    def close_calibration(self, sid: int) -> bool:
+        """Deregister an open calibration session (idempotent).
+        Outstanding candidate handles are unaffected — they are
+        ordinary requests and complete on their own lifecycle."""
+        with self._cv:
+            known = self._calib_sessions.pop(sid, None) is not None
+        if known:
+            profiling.counter_inc('serve.calib.sessions_closed')
+        return known
+
+    def calib_event(self, sid: int, kind: str, **info) -> None:
+        """Observability sink for a session's loop: ``kind`` is
+        ``'step' | 'converged' | 'diverged'``.  Steps advance the
+        session's activity instant and the step counters; the terminal
+        kinds additionally land in the flight recorder (a diverged
+        calibration is an incident-timeline event)."""
+        if kind not in ('step', 'converged', 'diverged'):
+            raise ValueError(
+                f"calib event kind must be 'step', 'converged' or "
+                f"'diverged'; got {kind!r}")
+        with self._cv:
+            if kind == 'step':
+                self._calib_steps += 1
+            elif kind == 'converged':
+                self._calib_converged += 1
+            else:
+                self._calib_diverged += 1
+            if sid in self._calib_sessions:
+                self._calib_sessions[sid] = time.monotonic()
+        profiling.counter_inc(f'serve.calib.{kind}s' if kind == 'step'
+                              else f'serve.calib.{kind}')
+        if kind != 'step':
+            self.flight_recorder.record(f'calib_{kind}', sid=sid,
+                                        **info)
 
     def submit_rounds(self, mp, meas_bits, *, init_regs=None,
                       cfg: InterpreterConfig = None, decode=None,
@@ -2589,6 +2658,17 @@ class ExecutionService:
                     'sessions_opened': self._stream_sessions_opened,
                     'sessions_expired': self._stream_sessions_expired,
                 },
+                # calibration traffic (docs/SERVING.md "Calibration
+                # sessions"): loop steps ride submit_source, so shots/
+                # compiles are already under the ordinary counters —
+                # this block is the session-lifecycle view
+                'calibration': {
+                    'open_sessions': len(self._calib_sessions),
+                    'sessions_opened': self._calib_sessions_opened,
+                    'steps': self._calib_steps,
+                    'converged': self._calib_converged,
+                    'diverged': self._calib_diverged,
+                },
                 'est_wait_ms': None if est_s is None
                 else float(est_s * 1e3),
                 'compile': {
@@ -2696,10 +2776,12 @@ class ExecutionService:
             if not self._closing:
                 self._closing = True
                 self._drain = drain
-                # streaming sessions close with the service; their
-                # outstanding chunks drain or fail with the rest
+                # streaming/calibration sessions close with the
+                # service; their outstanding chunks/candidates drain
+                # or fail with the rest
                 self._sessions.clear()
                 self._stream_keys.clear()
+                self._calib_sessions.clear()
                 if not drain:
                     exc = ShutdownError(
                         f'service {self.name!r} shut down without '

@@ -1,0 +1,435 @@
+"""Differentiable physics + gradient-based calibration
+(docs/CALIBRATION.md, docs/SERVING.md "Calibration sessions").
+
+The contract, pinned here:
+
+* **Gradient correctness**: ``sim.grad.grad_loss`` agrees with central
+  finite differences on every knob's smooth loss to ``RTOL`` (the
+  pinned tolerance below); the hard discrimination threshold
+  (:func:`~.physics._acc_to_bit`'s ``proj > 0``) has an EXACTLY-zero
+  gradient; the straight-through surrogate is the exact hard bit
+  forward and the documented sigmoid surrogate backward; the score-
+  function estimator is unbiased on sampled branch bits; and
+  ``grad_loss_batch`` (vmap over candidates) is bit-identical to the
+  sequential per-candidate path.
+* **Compile-front-door stress**: N amplitude-varying candidates are N
+  distinct content keys (no aliasing), a repeated calibration burst
+  re-hits its own entries with zero evictions (no LRU thrash), and a
+  live-qchip writeback flushes EXACTLY the stale epoch's entries —
+  other qchips' entries stay warm — counted by the new
+  ``writeback_flushes`` stat.
+* **Closed loops through serve**: gradient descent on the amplitude,
+  DRAG and readout-window knobs converges with candidates submitted
+  through ``ExecutionService.submit_source`` under a
+  ``CalibrationSession``, writes the tuned value back to the live
+  ``QChip`` (fingerprint changes, round-trips through ``to_dict``),
+  and a diverged loop is a counted observable outcome
+  (``stats()['calibration']['diverged']``), never a writeback.
+
+This module is listed in tools/check_junit.py NO_SKIP_MODULES: it runs
+on pure CPU (jnp forward models + the serve tier's CPU interpreter)
+and has no legitimate skip condition.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_processor_tpu.calib import CalibrationSession, calibrate
+from distributed_processor_tpu.compilecache import CompileCache
+from distributed_processor_tpu.models import make_default_qchip
+from distributed_processor_tpu.models.experiments import rabi_program
+from distributed_processor_tpu.qchip import QChip
+from distributed_processor_tpu.serve import ExecutionService
+from distributed_processor_tpu.sim.grad import (AMP_SCALE, LossSpec,
+                                                PARAM_NAME, grad_loss,
+                                                grad_loss_batch,
+                                                hard_threshold,
+                                                score_function_grad,
+                                                st_threshold)
+
+pytestmark = pytest.mark.calib
+
+# THE pinned finite-difference tolerance (ISSUE 20 acceptance): the
+# analytic gradient of every smooth loss must agree with central
+# differences to this relative tolerance at every probe point below.
+RTOL = 0.02
+
+RESULT_TIMEOUT = 300.0
+
+
+def _fd(pname, x, spec, eps):
+    """Central finite difference of the calibration loss, evaluated
+    through the same float32 ``grad_loss`` front door the loops use."""
+    lp, _ = grad_loss({pname: x + eps}, spec)
+    lm, _ = grad_loss({pname: x - eps}, spec)
+    return (float(lp) - float(lm)) / (2.0 * eps)
+
+
+# ---------------------------------------------------------------------------
+# gradient correctness (tentpole (a))
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('x', [0.30, 0.45, 0.65])
+def test_fd_agreement_amplitude(x):
+    spec = LossSpec(knob='amplitude', x90_amp=0.48)
+    _, grads = grad_loss({'amp': x}, spec)
+    g = float(grads['amp'])
+    fd = _fd('amp', x, spec, eps=1e-3)
+    assert g == pytest.approx(fd, rel=RTOL)
+
+
+@pytest.mark.parametrize('alpha', [0.2, 0.6, 1.5])
+def test_fd_agreement_drag(alpha):
+    # the loop-default spec: at the gate's nominal -270 MHz detuning
+    # the gaussian's spectral weight underflows float32 and both the
+    # analytic and FD gradients are exactly zero — the softer model
+    # detuning keeps the loss in float32 range (docs/CALIBRATION.md)
+    spec = LossSpec(knob='drag', drag_delta=-30e6)
+    _, grads = grad_loss({'alpha': alpha}, spec)
+    g = float(grads['alpha'])
+    fd = _fd('alpha', alpha, spec, eps=1e-2)
+    assert g == pytest.approx(fd, rel=RTOL)
+
+
+@pytest.mark.parametrize('start', [48.0, 160.0, 280.0])
+def test_fd_agreement_readout_window(start):
+    spec = LossSpec(knob='readout_window', window_edge=8.0)
+    _, grads = grad_loss({'window_start': start}, spec)
+    g = float(grads['window_start'])
+    fd = _fd('window_start', start, spec, eps=1.0)
+    assert g == pytest.approx(fd, rel=RTOL)
+
+
+def test_hard_threshold_gradient_exactly_zero():
+    """The exact discrimination bit is piecewise constant: its gradient
+    is identically zero — INCLUDING at the boundary — which is exactly
+    why the loops never differentiate through it (pinned as documented
+    behavior, not a bug)."""
+    proj = jnp.array([-2.0, -1e-6, 0.0, 1e-6, 2.0], jnp.float32)
+
+    def loss(scale):
+        return jnp.sum(hard_threshold(scale * proj))
+
+    g = jax.grad(loss)(jnp.float32(1.0))
+    assert float(g) == 0.0
+
+
+def test_st_threshold_forward_is_hard_bit_backward_is_surrogate():
+    proj = jnp.array([-3.0, -0.5, 0.0, 0.5, 3.0], jnp.float32)
+    np.testing.assert_array_equal(np.asarray(st_threshold(proj)),
+                                  np.asarray(hard_threshold(proj)))
+    temp = 0.7
+    g = jax.grad(
+        lambda p: jnp.sum(st_threshold(p, jnp.float32(temp))))(proj)
+    sg = jax.nn.sigmoid(proj / temp)
+    np.testing.assert_allclose(np.asarray(g),
+                               np.asarray(sg * (1 - sg) / temp),
+                               rtol=1e-6)
+    # temp is an estimator knob, not a physical parameter: zero grad
+    gt = jax.grad(
+        lambda t: jnp.sum(st_threshold(proj, t)))(jnp.float32(temp))
+    assert float(gt) == 0.0
+
+
+def test_score_function_grad_unbiased():
+    """REINFORCE on sampled branch bits: for f(b) = 2b + 1,
+    d/dp E[f] = f(1) - f(0) = 2 exactly; the estimator's mean over a
+    seeded 20k-sample draw must land within 0.2 of it."""
+    rng = np.random.default_rng(20)
+    p = 0.3
+    bits = (rng.random(20000) < p).astype(np.float32)
+    f_vals = 2.0 * bits + 1.0
+    est = float(score_function_grad(p, bits, f_vals))
+    assert abs(est - 2.0) < 0.2
+
+
+@pytest.mark.parametrize('knob,vals', [
+    ('amplitude', np.linspace(0.2, 0.8, 9)),
+    ('readout_window', np.linspace(16.0, 400.0, 7)),
+])
+def test_grad_loss_batch_bit_identical_to_sequential(knob, vals):
+    """The calibration burst evaluates its whole candidate population
+    in one vmap dispatch; that dispatch must be bit-identical to the
+    sequential per-candidate path (same contract as the serving tier's
+    batched-vs-sequential pins)."""
+    spec = (LossSpec(knob='readout_window', window_edge=8.0)
+            if knob == 'readout_window' else LossSpec(knob=knob))
+    pname = PARAM_NAME[knob]
+    vals = np.asarray(vals, np.float32)
+    b_loss, b_grads = grad_loss_batch({pname: vals}, spec)
+    for i, v in enumerate(vals):
+        loss, grads = grad_loss({pname: v}, spec)
+        assert np.array_equal(np.asarray(b_loss)[i], np.asarray(loss))
+        assert np.array_equal(np.asarray(b_grads[pname])[i],
+                              np.asarray(grads[pname]))
+
+
+# ---------------------------------------------------------------------------
+# compile front door under calibration traffic (satellites 1 + 2)
+# ---------------------------------------------------------------------------
+
+def test_candidate_amplitudes_are_distinct_cache_keys():
+    """N amplitude-varying candidates -> N distinct content keys, all
+    compiled (miss) once and re-hit byte-for-byte on resubmission."""
+    cache = CompileCache(capacity=64)
+    qchip = make_default_qchip(2)
+    amps = np.linspace(0.1, 0.9, 16)
+    keys, statuses = set(), []
+    for a in amps:
+        _, status, key = cache.get_or_compile(
+            rabi_program('Q0', float(a)), qchip, n_qubits=2)
+        keys.add(key)
+        statuses.append(status)
+    assert len(keys) == len(amps)
+    assert statuses == ['miss'] * len(amps)
+    for a in amps:
+        _, status, key = cache.get_or_compile(
+            rabi_program('Q0', float(a)), qchip, n_qubits=2)
+        assert status == 'hit' and key in keys
+    snap = cache.stats()
+    assert snap['misses'] == len(amps)
+    assert snap['hits'] == len(amps)
+    assert snap['evictions'] == 0
+
+
+def test_calibration_burst_no_lru_thrash_through_service():
+    """A calibration burst (nearly-identical candidate programs) must
+    not thrash the service's LRU: the second identical burst is all
+    hits, zero new program compiles, zero evictions.  (Executor jit
+    compiles are NOT pinned here: bound-bucket shapes depend on
+    coalescing occupancy, which is timing-dependent.)"""
+    qchip = make_default_qchip(2)
+    amps = np.linspace(0.2, 0.65, 10)
+    with ExecutionService() as svc:
+        for h in [svc.submit_source(rabi_program('Q0', float(a)), qchip,
+                                    shots=2, n_qubits=2)
+                  for a in amps]:
+            h.result(timeout=RESULT_TIMEOUT)
+        s1 = svc.compile_cache.stats()
+        for h in [svc.submit_source(rabi_program('Q0', float(a)), qchip,
+                                    shots=2, n_qubits=2)
+                  for a in amps]:
+            h.result(timeout=RESULT_TIMEOUT)
+        s2 = svc.compile_cache.stats()
+    assert s1['evictions'] == 0 and s2['evictions'] == 0
+    assert s2['misses'] == s1['misses']
+    assert s2['hits'] >= s1['hits'] + len(amps)
+
+
+def test_fingerprint_roundtrip_and_exact_stale_epoch_flush():
+    """The PR 9 regression pin with a REAL writer: a live-qchip
+    mutation (the writeback signature) flushes exactly the stale
+    epoch's entries on the next submission — the other qchip's entry
+    stays warm — and the fingerprint round-trips through
+    ``to_dict``/reload both before and after the writeback."""
+    qa = make_default_qchip(2)
+    qb = make_default_qchip(2)
+    # qb is a different calibration epoch (different readout tune)
+    qb.gates['Q1read'].contents[0].amp = 0.3
+    cache = CompileCache(capacity=64)
+    prog_a = rabi_program('Q0', 0.3)
+    prog_b = rabi_program('Q1', 0.5)
+    fp_a1 = qa.fingerprint()
+    assert QChip(qa.to_dict()).fingerprint() == fp_a1
+    assert qb.fingerprint() != fp_a1
+    _, st_a, _ = cache.get_or_compile(prog_a, qa, n_qubits=2)
+    _, st_b, key_b = cache.get_or_compile(prog_b, qb, n_qubits=2)
+    assert st_a == 'miss' and st_b == 'miss'
+    snap0 = cache.stats()
+    assert snap0['writeback_flushes'] == 0
+
+    # the calibration writeback: retune one gate amplitude in place
+    qa.gates['Q0X90'].contents[0].amp = 0.51
+    fp_a2 = qa.fingerprint()
+    assert fp_a2 != fp_a1
+    assert QChip(qa.to_dict()).fingerprint() == fp_a2
+
+    _, st_a2, key_a2 = cache.get_or_compile(prog_a, qa, n_qubits=2)
+    assert st_a2 == 'miss'   # new epoch, new key, recompiled
+    snap = cache.stats()
+    assert snap['writeback_flushes'] == 1
+    # EXACTLY the stale epoch: qa had one entry under fp_a1
+    assert snap['invalidated_entries'] - snap0['invalidated_entries'] == 1
+    # ... and qb's entry survived the flush
+    _, st_b2, key_b2 = cache.get_or_compile(prog_b, qb, n_qubits=2)
+    assert st_b2 == 'hit' and key_b2 == key_b
+    assert key_a2 != key_b
+
+
+# ---------------------------------------------------------------------------
+# closed loops through the serve tier (tentpole (b)/(c))
+# ---------------------------------------------------------------------------
+
+def test_closed_loop_amplitude_converges_and_writes_back():
+    """The flagship loop (ISSUE 20 acceptance): the device truth
+    drifted to x90 = 0.52 while the qchip still says 0.48; the loop
+    must find the truth through serve-tier candidate submissions,
+    write it back to the live qchip, and flush exactly the stale
+    compile-cache epoch via lineage tracking."""
+    spec = LossSpec(knob='amplitude', x90_amp=0.52)
+    qchip = make_default_qchip(2)
+    assert qchip.gates['Q0X90'].contents[0].amp == pytest.approx(0.48)
+    with ExecutionService() as svc:
+        res = calibrate(svc, qchip, knob='amplitude', qubit='Q0',
+                        spec=spec, shots=4, n_qubits=2,
+                        result_timeout=RESULT_TIMEOUT)
+        snap = svc.stats()
+        cache_snap = svc.compile_cache.stats()
+    assert res.converged and not res.diverged
+    assert res.params['amp'] == pytest.approx(0.52, abs=5e-3)
+    # loss trajectory descended
+    assert res.losses[-1] < res.losses[0]
+    # writeback landed on the LIVE qchip and moved its epoch
+    assert qchip.gates['Q0X90'].contents[0].amp == \
+        pytest.approx(res.params['amp'])
+    assert res.fp_before != res.fp_after
+    assert res.fp_after == qchip.fingerprint()
+    # the post-writeback probe flushed the stale epoch: at least one
+    # entry (the candidates compiled under fp_before), at most one per
+    # step, through the lineage (writeback) path exactly once
+    assert 1 <= res.flushed <= res.steps
+    assert cache_snap['writeback_flushes'] == 1
+    # session accounting: one converged session, fully closed
+    assert snap['calibration']['sessions_opened'] == 1
+    assert snap['calibration']['converged'] == 1
+    assert snap['calibration']['diverged'] == 0
+    assert snap['calibration']['open_sessions'] == 0
+    assert snap['calibration']['steps'] == res.steps
+    assert res.session['state'] == 'converged'
+
+
+def test_closed_loop_readout_window_converges_and_writes_back():
+    """Second acceptance knob: readout-window placement descends the
+    soft-window SNR model to its interior optimum (the window fully
+    rung up but not yet falling off the record) and writes the start
+    back as the read pulses' t0."""
+    qchip = make_default_qchip(2)
+    for pulse in qchip.gates['Q0read'].contents:
+        assert pulse.t0 == pytest.approx(0.0)
+    with ExecutionService() as svc:
+        res = calibrate(svc, qchip, knob='readout_window', qubit='Q0',
+                        shots=4, n_qubits=2,
+                        result_timeout=RESULT_TIMEOUT)
+        snap = svc.stats()
+    assert res.converged, res.detail
+    start = res.params['window_start']
+    # optimum sits near horizon - width = 320 samples (soft edges and
+    # the ring-up tail shift it slightly)
+    assert 260.0 <= start <= 400.0
+    assert res.losses[-1] < res.losses[0]
+    for pulse in qchip.gates['Q0read'].contents:
+        assert pulse.t0 == pytest.approx(start * 1e-9)
+    assert res.fp_before != res.fp_after
+    assert 1 <= res.flushed <= res.steps
+    assert snap['calibration']['converged'] == 1
+
+
+def test_closed_loop_drag_converges():
+    """DRAG-coefficient loop: spectral-leakage descent lands near the
+    derivative-cancellation point alpha ~ 1 and writes the tuned alpha
+    into the gate's envelope paradict."""
+    qchip = make_default_qchip(2)
+    with ExecutionService() as svc:
+        res = calibrate(svc, qchip, knob='drag', qubit='Q0',
+                        shots=4, n_qubits=2,
+                        result_timeout=RESULT_TIMEOUT)
+    assert res.converged, res.detail
+    assert res.params['alpha'] == pytest.approx(1.0, abs=0.25)
+    assert res.losses[-1] < res.losses[0]
+    gate = qchip.gates['Q0X90'].contents[0]
+    assert gate.env['paradict']['alpha'] == \
+        pytest.approx(res.params['alpha'])
+    assert res.fp_before != res.fp_after
+
+
+def test_diverged_loop_is_counted_and_never_writes_back():
+    """Divergence is a counted, observable outcome: a hopeless step
+    size blows the loop out of bounds, the session lands in the
+    ``diverged`` counter, and the live qchip is UNTOUCHED (no
+    writeback, no epoch change)."""
+    qchip = make_default_qchip(2)
+    fp0 = qchip.fingerprint()
+    with ExecutionService() as svc:
+        res = calibrate(svc, qchip, knob='amplitude', qubit='Q0',
+                        lr=5.0, shots=2, n_qubits=2,
+                        result_timeout=RESULT_TIMEOUT)
+        snap = svc.stats()
+    assert res.diverged and not res.converged
+    assert res.detail['reason']
+    assert res.fp_before is None and res.fp_after is None
+    assert res.flushed is None
+    assert qchip.fingerprint() == fp0
+    assert qchip.gates['Q0X90'].contents[0].amp == pytest.approx(0.48)
+    assert snap['calibration']['diverged'] == 1
+    assert snap['calibration']['converged'] == 0
+    assert snap['calibration']['open_sessions'] == 0
+    assert res.session['state'] == 'diverged'
+
+
+def test_session_rejects_use_after_terminal():
+    """Session lifecycle hygiene: a terminal session refuses further
+    terminal transitions and a closed session refuses steps."""
+    qchip = make_default_qchip(2)
+    with ExecutionService() as svc:
+        sess = svc.open_calibration(knob='amplitude')
+        h = sess.submit_step(rabi_program('Q0', 0.3), qchip, shots=2,
+                             n_qubits=2)
+        h.result(timeout=RESULT_TIMEOUT)
+        sess.mark_converged({'amp': 0.3})
+        with pytest.raises(RuntimeError):
+            sess.mark_diverged('too late')
+        sess.close()
+        with pytest.raises(RuntimeError):
+            sess.submit_step(rabi_program('Q0', 0.3), qchip, shots=2,
+                             n_qubits=2)
+        assert svc.stats()['calibration']['open_sessions'] == 0
+    assert isinstance(sess, CalibrationSession)
+
+
+def test_executed_amp_word_closes_the_loop():
+    """The loop linearizes at the AS-EXECUTED amplitude: the candidate
+    word read back from rec_amp quantizes to round(amp * AMP_SCALE)."""
+    from distributed_processor_tpu.calib.loops import _executed_amp
+    qchip = make_default_qchip(2)
+    amp = 0.337
+    with ExecutionService() as svc:
+        h = svc.submit_source(rabi_program('Q0', amp), qchip, shots=2,
+                              n_qubits=2)
+        res = h.result(timeout=RESULT_TIMEOUT)
+    x_exec = _executed_amp(res, amp)
+    assert x_exec == pytest.approx(amp, abs=1.0 / AMP_SCALE)
+    assert x_exec == int(round(amp * AMP_SCALE)) / AMP_SCALE
+    # a word the service never played is a loop bug and raises
+    with pytest.raises(RuntimeError):
+        _executed_amp(res, 0.9991)
+
+
+# ---------------------------------------------------------------------------
+# CLI (satellite 5)
+# ---------------------------------------------------------------------------
+
+def test_cli_calibrate_smoke(capsys):
+    from distributed_processor_tpu.cli import main
+    main(['calibrate', '--qubits', '2', '--shots', '2'])
+    out = json.loads(capsys.readouterr().out)
+    assert out['knob'] == 'amplitude'
+    assert out['converged'] is True
+    assert out['params']['amp'] == pytest.approx(0.52, abs=5e-3)
+    assert len(out['losses']) == out['steps']
+    assert out['service']['converged'] == 1
+
+
+def test_cli_calibrate_exits_nonzero_on_divergence(capsys):
+    from distributed_processor_tpu.cli import main
+    with pytest.raises(SystemExit) as exc:
+        main(['calibrate', '--qubits', '2', '--shots', '2',
+              '--lr', '5.0'])
+    assert 'diverged' in str(exc.value)
+    out = json.loads(capsys.readouterr().out)
+    assert out['diverged'] is True
+    assert out['service']['diverged'] == 1

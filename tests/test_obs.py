@@ -436,7 +436,7 @@ _STATS_KEYS = {
     'executor_deaths', 'hangs', 'canary', 'est_wait_ms', 'compile',
     'source', 'devices', 'compile_cache', 'latency_p50_ms',
     'latency_p99_ms', 'latency_samples', 'integrity', 'streaming',
-    'tenants',
+    'tenants', 'calibration',
 }
 _WARMUP_KEYS = {'aot_compiled', 'replayed', 'in_progress'}
 _HEALTH_KEYS = {'live', 'quarantined', 'probing'}
@@ -458,6 +458,8 @@ _STREAMING_KEYS = {'open_sessions', 'rounds_in_flight',
                    'rounds_submitted', 'rounds_served',
                    'round_deadline_misses', 'sessions_opened',
                    'sessions_expired'}
+_CALIBRATION_KEYS = {'open_sessions', 'sessions_opened', 'steps',
+                     'converged', 'diverged'}
 # per-tenant stats block (docs/SERVING.md "Tenants"): the billing
 # surface — admission outcomes plus the four usage meters
 _TENANT_KEYS = {'queued', 'submitted', 'completed', 'failed', 'shed',
@@ -487,6 +489,7 @@ def test_stats_key_manifest_is_byte_compatible():
     assert set(snap['source']) == _SOURCE_KEYS
     assert set(snap['integrity']) == _INTEGRITY_KEYS
     assert set(snap['streaming']) == _STREAMING_KEYS
+    assert set(snap['calibration']) == _CALIBRATION_KEYS
     for dev in snap['devices']:
         assert set(dev) == _DEVICE_KEYS
     for label, row in snap['compile']['per_bucket'].items():
@@ -551,6 +554,39 @@ def test_stream_counter_names_preserved():
     for name in _STREAM_COUNTERS:
         assert after.get(name, 0) > before[name], \
             f'counter {name!r} did not advance under a streamed session'
+
+
+# serve.calib.* counters (docs/SERVING.md "Calibration sessions"),
+# separate from _SERVE_COUNTERS for the same reason as the stream set:
+# only a calibration session advances them
+_CALIB_COUNTERS = {
+    'serve.calib.sessions_opened', 'serve.calib.sessions_closed',
+    'serve.calib.steps', 'serve.calib.converged',
+}
+
+
+def test_calib_counter_names_preserved():
+    from distributed_processor_tpu.models import make_default_qchip
+    from distributed_processor_tpu.models.experiments import rabi_program
+    qchip = make_default_qchip(2)
+    before = {k: profiling.counter_get(k) for k in _CALIB_COUNTERS}
+    with ExecutionService() as svc:
+        with svc.open_calibration(knob='amplitude') as sess:
+            h = sess.submit_step(rabi_program('Q0', 0.3), qchip,
+                                 shots=2, n_qubits=2)
+            h.result(timeout=120)
+            sess.note_loss(0.1)
+            sess.mark_converged({'amp': 0.3})
+        snap = svc.stats()
+    assert set(snap['calibration']) == _CALIBRATION_KEYS
+    assert snap['calibration']['sessions_opened'] >= 1
+    assert snap['calibration']['steps'] >= 1
+    assert snap['calibration']['converged'] >= 1
+    assert snap['calibration']['open_sessions'] == 0
+    after = profiling.counters()
+    for name in _CALIB_COUNTERS:
+        assert after.get(name, 0) > before[name], \
+            f'counter {name!r} did not advance under a calibration'
 
 
 # tenant.* counter family (docs/SERVING.md "Tenants"): billing-grade

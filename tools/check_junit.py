@@ -88,6 +88,13 @@ NO_SKIP_MODULES = {
         'the forced CPU mesh + localhost sockets with no hardware '
         'dependency — a skip means the tenant-fairness contract '
         '(docs/SERVING.md "Tenants") stopped being exercised',
+    'test_calib':
+        'calibration tests (finite-difference gradient agreement, '
+        'straight-through boundary behavior, closed serve-tier loops '
+        'with live-qchip writeback and stale-epoch flush) run on pure '
+        'CPU with no hardware dependency — a skip means the '
+        'differentiable-physics contract (docs/CALIBRATION.md) '
+        'stopped being exercised',
 }
 
 # the multi-device serve suite may skip ONLY on a genuinely
